@@ -1,0 +1,18 @@
+"""transmogrifai_tpu — a TPU-native AutoML framework for structured data.
+
+A ground-up JAX/XLA re-design with the capabilities of TransmogrifAI (Salesforce's
+Spark-based AutoML library): typed features, a lazy stage DAG, automatic per-type feature
+engineering (Transmogrifier), automatic feature validation (SanityChecker,
+RawFeatureFilter), automatic model selection with cross-validation, evaluators, and model
+explainability — executing on row-sharded device arrays under ``jit`` over a
+``jax.sharding.Mesh`` instead of Spark executors.
+"""
+
+__version__ = "0.1.0"
+
+from .types import *  # noqa: F401,F403 — feature type hierarchy
+from .features.feature import Feature, FeatureHistory
+from .features.builder import FeatureBuilder
+from .data.dataset import Column, Dataset
+
+__all__ = ["Feature", "FeatureHistory", "FeatureBuilder", "Column", "Dataset"]

@@ -1,0 +1,182 @@
+"""FeatureBuilder — typed factory for raw features.
+
+Reference: features/.../FeatureBuilder.scala:48-349 (per-type constructors :52-178,
+fromSchema/fromDataFrame :191-230, extract/aggregate/window -> FeatureGeneratorStage).
+
+Usage::
+
+    age  = FeatureBuilder.Real("age").extract(lambda r: r["age"]).as_predictor()
+    surv = FeatureBuilder.RealNN("survived").extract(lambda r: r["survived"]).as_response()
+    # or auto-infer all features from a pandas/columnar frame:
+    features, ds = FeatureBuilder.from_dataframe(df, response="survived")
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type
+
+import numpy as np
+
+from ..types import (
+    Binary,
+    FeatureType,
+    Integral,
+    PickList,
+    Real,
+    RealNN,
+    Text,
+    feature_type_by_name,
+    all_feature_types,
+)
+from .feature import Feature, _NamedExtract
+from .generator import FeatureGeneratorStage
+
+
+class _TypedBuilder:
+    """Builder for one named feature of a fixed type."""
+
+    def __init__(self, name: str, ftype: Type[FeatureType]):
+        self.name = name
+        self.ftype = ftype
+        self._extract_fn: Optional[Callable[[Any], Any]] = None
+        self._aggregator = None
+        self._window_ms: Optional[int] = None
+
+    def extract(self, fn: Callable[[Any], Any]) -> "_TypedBuilder":
+        self._extract_fn = fn
+        return self
+
+    def extract_field(self, key: Optional[str] = None) -> "_TypedBuilder":
+        """Extract a named field from dict/attr records (serializable by name)."""
+        self._extract_fn = _NamedExtract(key or self.name)
+        return self
+
+    def aggregate(self, aggregator) -> "_TypedBuilder":
+        self._aggregator = aggregator
+        return self
+
+    def window(self, window_ms: int) -> "_TypedBuilder":
+        self._window_ms = window_ms
+        return self
+
+    def _build(self, is_response: bool) -> Feature:
+        fn = self._extract_fn or _NamedExtract(self.name)
+        stage = FeatureGeneratorStage(
+            extract_fn=fn,
+            ftype=self.ftype,
+            output_name=self.name,
+            is_response=is_response,
+            aggregator=self._aggregator,
+            aggregate_window_ms=self._window_ms,
+        )
+        return stage.get_output()
+
+    def as_predictor(self) -> Feature:
+        return self._build(is_response=False)
+
+    def as_response(self) -> Feature:
+        return self._build(is_response=True)
+
+
+class _FeatureBuilderMeta(type):
+    def __getattr__(cls, type_name: str):
+        if type_name.startswith("_"):
+            raise AttributeError(type_name)
+        try:
+            ftype = feature_type_by_name(type_name)
+        except Exception:
+            raise AttributeError(
+                f"FeatureBuilder has no feature type {type_name!r}"
+            ) from None
+        return lambda name: _TypedBuilder(name, ftype)
+
+
+class FeatureBuilder(metaclass=_FeatureBuilderMeta):
+    """``FeatureBuilder.<TypeName>(name)`` for any of the 45 registered types."""
+
+    @staticmethod
+    def of(name: str, ftype: Type[FeatureType]) -> _TypedBuilder:
+        return _TypedBuilder(name, ftype)
+
+    # -- schema inference (fromDataFrame/fromSchema equivalents) --------------
+    @staticmethod
+    def from_dataframe(df, response: Optional[str] = None,
+                       response_type: Type[FeatureType] = RealNN,
+                       ftypes: Optional[Dict[str, Type[FeatureType]]] = None,
+                       ) -> Tuple[List[Feature], "Dataset"]:
+        """Infer typed features from a pandas DataFrame; returns (features, Dataset).
+
+        The response column (if named) becomes a ``RealNN`` response feature; remaining
+        columns map by dtype: float -> Real, int -> Integral, bool -> Binary,
+        object/str -> Text (or the override in ``ftypes``).
+        """
+        from ..data.dataset import Column, Dataset
+
+        ftypes = dict(ftypes or {})
+        features: List[Feature] = []
+        cols: Dict[str, Column] = {}
+        for name in df.columns:
+            series = df[name]
+            if name == response:
+                ftype = ftypes.get(name, response_type)
+                is_response = True
+            else:
+                ftype = ftypes.get(name) or _infer_ftype(series)
+                is_response = False
+            stage = FeatureGeneratorStage(
+                extract_fn=_NamedExtract(name),
+                ftype=ftype,
+                output_name=name,
+                is_response=is_response,
+            )
+            features.append(stage.get_output())
+            cols[name] = Column.from_values(ftype, _clean_series(series, ftype))
+        return features, Dataset(cols)
+
+    @staticmethod
+    def from_schema(schema: Dict[str, str], response: Optional[str] = None
+                    ) -> List[Feature]:
+        """Build raw features from {name: feature_type_name} mapping."""
+        out = []
+        for name, tname in schema.items():
+            ftype = feature_type_by_name(tname)
+            stage = FeatureGeneratorStage(
+                extract_fn=_NamedExtract(name),
+                ftype=ftype,
+                output_name=name,
+                is_response=(name == response),
+            )
+            out.append(stage.get_output())
+        return out
+
+
+def _infer_ftype(series) -> Type[FeatureType]:
+    import pandas as pd
+
+    dt = series.dtype
+    if pd.api.types.is_bool_dtype(dt):
+        return Binary
+    if pd.api.types.is_integer_dtype(dt):
+        return Integral
+    if pd.api.types.is_float_dtype(dt):
+        # all-integral floats with few distinct values still treated as Real;
+        return Real
+    # object: decide PickList vs Text by cardinality heuristic at read time is the
+    # SmartTextVectorizer's job; raw features stay Text
+    return Text
+
+
+def _clean_series(series, ftype: Type[FeatureType]) -> List[Any]:
+    import pandas as pd
+
+    out = []
+    for v in series.tolist():
+        if v is None or (isinstance(v, float) and np.isnan(v)) or v is pd.NA:
+            out.append(None)
+        elif ftype.kind.value == "text" and not isinstance(v, str):
+            out.append(str(v))
+        elif ftype.kind.value == "int" and isinstance(v, float):
+            out.append(int(v))
+        else:
+            out.append(v)
+    return out

@@ -1,0 +1,195 @@
+"""Typed feature DAG nodes.  Reference: features/.../FeatureLike.scala:48-466, Feature.scala.
+
+A ``Feature`` is a lazy, typed node in the lineage DAG: it knows its ``origin_stage`` (the
+stage that produces it) and that stage's input features (``parents``).  Workflows traverse
+this DAG backwards from result features to raw features, then schedule stages layer-by-layer
+(workflow/dag.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import TYPE_CHECKING, Dict, Iterable, List, Optional, Tuple, Type
+
+from ..types import FeatureType
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..stages.base import PipelineStage
+
+_uid_counter = itertools.count()
+
+
+def feature_uid() -> str:
+    """Reference: FeatureUID — unique id for each feature node."""
+    return f"Feature_{next(_uid_counter):012x}"
+
+
+class Feature:
+    """A typed node in the feature lineage DAG (FeatureLike[O] equivalent)."""
+
+    __slots__ = (
+        "name",
+        "ftype",
+        "is_response",
+        "origin_stage",
+        "parents",
+        "uid",
+        "distributions",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        ftype: Type[FeatureType],
+        is_response: bool,
+        origin_stage: Optional["PipelineStage"],
+        parents: Tuple["Feature", ...] = (),
+        uid: Optional[str] = None,
+        distributions: Tuple = (),
+    ):
+        if not issubclass(ftype, FeatureType):
+            raise TypeError(f"ftype must be a FeatureType subclass, got {ftype!r}")
+        self.name = name
+        self.ftype = ftype
+        self.is_response = is_response
+        self.origin_stage = origin_stage
+        self.parents = tuple(parents)
+        self.uid = uid or feature_uid()
+        self.distributions = tuple(distributions)
+
+    # -- identity -----------------------------------------------------------
+    def __hash__(self) -> int:
+        return hash(self.uid)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Feature) and other.uid == self.uid
+
+    def __repr__(self) -> str:
+        kind = "response" if self.is_response else "predictor"
+        return f"Feature<{self.ftype.__name__}>({self.name!r}, {kind}, uid={self.uid})"
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def is_raw(self) -> bool:
+        return len(self.parents) == 0
+
+    @property
+    def origin_stage_name(self) -> str:
+        return self.origin_stage.operation_name if self.origin_stage else "raw"
+
+    # -- DAG wiring (reference transformWith overloads :210-300) ------------
+    def transform_with(self, stage: "PipelineStage", *others: "Feature") -> "Feature":
+        """Apply a stage to this feature (and optional co-inputs); returns the output feature."""
+        stage.set_input(self, *others)
+        return stage.get_output()
+
+    # -- traversal ----------------------------------------------------------
+    def raw_features(self) -> List["Feature"]:
+        """All raw ancestors (deduplicated, stable order)."""
+        seen: Dict[str, Feature] = {}
+        self._collect_raw(seen)
+        return list(seen.values())
+
+    def _collect_raw(self, seen: Dict[str, "Feature"]) -> None:
+        if self.is_raw:
+            seen.setdefault(self.uid, self)
+        else:
+            for p in self.parents:
+                p._collect_raw(seen)
+
+    def parent_stages(self) -> Dict["PipelineStage", int]:
+        """Stage -> max distance from this feature.  Reference: FeatureLike.parentStages().
+
+        Distance 0 is this feature's own origin stage; used by the DAG scheduler to place
+        stages into execution layers.
+        """
+        distances: Dict["PipelineStage", int] = {}
+        frontier: List[Tuple[Feature, int]] = [(self, 0)]
+        while frontier:
+            feat, dist = frontier.pop()
+            stage = feat.origin_stage
+            if stage is None:
+                continue
+            prev = distances.get(stage)
+            if prev is None or dist > prev:
+                distances[stage] = dist
+                for p in feat.parents:
+                    frontier.append((p, dist + 1))
+        return distances
+
+    def all_features(self) -> List["Feature"]:
+        """Every feature in this feature's ancestry including itself (deduplicated)."""
+        seen: Dict[str, Feature] = {}
+        stack = [self]
+        while stack:
+            f = stack.pop()
+            if f.uid in seen:
+                continue
+            seen[f.uid] = f
+            stack.extend(f.parents)
+        return list(seen.values())
+
+    def pretty_parent_stages(self, indent: int = 0) -> str:
+        """Human-readable lineage tree.  Reference: prettyParentStages."""
+        lines = [f"{'  ' * indent}{'+-- ' if indent else ''}{self.origin_stage_name} -> "
+                 f"{self.name} ({self.ftype.__name__})"]
+        for p in self.parents:
+            lines.append(p.pretty_parent_stages(indent + 1))
+        return "\n".join(lines)
+
+    def as_raw(self, is_response: Optional[bool] = None) -> "Feature":
+        """A raw copy of this feature (drops lineage).  Reference: FeatureLike.asRaw."""
+        from .generator import FeatureGeneratorStage
+
+        resp = self.is_response if is_response is None else is_response
+        stage = FeatureGeneratorStage(
+            extract_fn=_NamedExtract(self.name),
+            ftype=self.ftype,
+            output_name=self.name,
+            is_response=resp,
+        )
+        return stage.get_output()
+
+    def history(self) -> "FeatureHistory":
+        origins = sorted(f.name for f in self.raw_features()) if not self.is_raw else [self.name]
+        stages = sorted({s.operation_name for s in self.parent_stages() if s is not None})
+        return FeatureHistory(origin_features=origins, stages=stages)
+
+
+class _NamedExtract:
+    """Extract function reading a named field from a record dict (serializable by name)."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key: str):
+        self.key = key
+
+    def __call__(self, record):
+        if isinstance(record, dict):
+            return record.get(self.key)
+        return getattr(record, self.key, None)
+
+    def __repr__(self):
+        return f"_NamedExtract({self.key!r})"
+
+
+class FeatureHistory:
+    """Provenance of a derived feature.  Reference: utils/.../FeatureHistory.scala."""
+
+    __slots__ = ("origin_features", "stages")
+
+    def __init__(self, origin_features: Iterable[str], stages: Iterable[str]):
+        self.origin_features = list(origin_features)
+        self.stages = list(stages)
+
+    def to_dict(self) -> dict:
+        return {"originFeatures": self.origin_features, "stages": self.stages}
+
+    def merge(self, other: "FeatureHistory") -> "FeatureHistory":
+        return FeatureHistory(
+            sorted(set(self.origin_features) | set(other.origin_features)),
+            sorted(set(self.stages) | set(other.stages)),
+        )
+
+    def __repr__(self):
+        return f"FeatureHistory(origins={self.origin_features}, stages={self.stages})"

@@ -1,0 +1,336 @@
+"""Stage framework: params, transformers, estimators.
+
+Reference: features/.../stages/OpPipelineStages.scala:55-552 (OpPipelineStageBase, arity traits),
+base/unary/UnaryTransformer.scala … base/sequence/SequenceTransformer.scala, OpPipelineStageParams.scala.
+
+TPU-first re-design: stages operate on whole *columns* (host object arrays or device tensors),
+never row-by-row.  ``Transformer.transform_columns`` is the single compute entry point; the
+workflow engine fuses all device transformers in a layer into one jitted program.  A fitted
+``Estimator`` returns a model Transformer that shares the estimator's uid and output feature
+(Spark-ML convention — substitution during scoring is a uid lookup).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+from ..features.feature import Feature
+from ..types import FeatureType, OPVector
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..data.dataset import Column, Dataset
+
+_stage_uid_counter = itertools.count()
+
+
+def stage_uid(cls_name: str) -> str:
+    return f"{cls_name}_{next(_stage_uid_counter):012x}"
+
+
+class Param:
+    """Typed stage parameter with default + optional validator.
+
+    Reference: Spark ``Param``/``ParamMap`` (the per-stage flag system, SURVEY §5.6).
+    Declared as class attributes on stages; values resolved instance > default.
+    """
+
+    __slots__ = ("name", "default", "doc", "validator")
+
+    def __init__(self, default: Any = None, doc: str = "", validator: Optional[Callable] = None):
+        self.name: str = ""  # filled by __set_name__
+        self.default = default
+        self.doc = doc
+        self.validator = validator
+
+    def __set_name__(self, owner, name):
+        self.name = name
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        return obj._param_values.get(self.name, self.default)
+
+    def __set__(self, obj, value):
+        if self.validator is not None and not self.validator(value):
+            raise ValueError(f"Invalid value for param {self.name!r}: {value!r}")
+        obj._param_values[self.name] = value
+
+
+class PipelineStage:
+    """Base of all stages (OpPipelineStageBase equivalent)."""
+
+    # --- class-level schema -------------------------------------------------
+    #: expected input feature types, one per input (fixed-arity stages)
+    input_types: Tuple[Type[FeatureType], ...] = ()
+    #: for sequence stages: the single repeated input type (variable arity)
+    sequence_input_type: Optional[Type[FeatureType]] = None
+    #: minimum number of sequence inputs
+    min_sequence_inputs: int = 1
+    #: output feature type (override _output_ftype for input-dependent types)
+    output_type: Type[FeatureType] = OPVector
+    #: whether this stage may legally consume a response feature as a non-label input
+    allow_label_as_input: bool = False
+    #: whether the output should be flagged as a response feature
+    output_is_response: bool = False
+
+    def __init__(self, operation_name: Optional[str] = None, uid: Optional[str] = None, **params):
+        self._param_values: Dict[str, Any] = {}
+        self.operation_name = operation_name or _default_op_name(type(self).__name__)
+        self.uid = uid or stage_uid(type(self).__name__)
+        self._input_features: Tuple[Feature, ...] = ()
+        self._output_feature: Optional[Feature] = None
+        cls_params = self._class_params()
+        for k, v in params.items():
+            if k not in cls_params:
+                raise TypeError(f"{type(self).__name__} has no param {k!r}")
+            setattr(self, k, v)
+
+    # --- params -------------------------------------------------------------
+    @classmethod
+    def _class_params(cls) -> Dict[str, Param]:
+        out: Dict[str, Param] = {}
+        for klass in reversed(cls.__mro__):
+            for k, v in vars(klass).items():
+                if isinstance(v, Param):
+                    out[k] = v
+        return out
+
+    def get_params(self) -> Dict[str, Any]:
+        """All param values (defaults resolved) — the serde payload."""
+        return {name: getattr(self, name) for name in self._class_params()}
+
+    def set_params(self, **kwargs) -> "PipelineStage":
+        cls_params = self._class_params()
+        for k, v in kwargs.items():
+            if k not in cls_params:
+                raise TypeError(f"{type(self).__name__} has no param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    # --- input wiring -------------------------------------------------------
+    def set_input(self, *features: Feature) -> "PipelineStage":
+        self._check_input_schema(features)
+        self._input_features = tuple(features)
+        self._output_feature = None
+        return self
+
+    def _check_input_schema(self, features: Sequence[Feature]) -> None:
+        """Schema validation at stage boundaries (reference OpPipelineStages.scala:112-141)."""
+        if self.sequence_input_type is not None:
+            fixed = len(self.input_types)
+            if len(features) < fixed + self.min_sequence_inputs:
+                raise ValueError(
+                    f"{type(self).__name__} expects at least {fixed + self.min_sequence_inputs}"
+                    f" inputs, got {len(features)}"
+                )
+            for expected, f in zip(self.input_types, features[:fixed]):
+                self._check_type(expected, f)
+            for f in features[fixed:]:
+                self._check_type(self.sequence_input_type, f)
+        else:
+            if len(features) != len(self.input_types):
+                raise ValueError(
+                    f"{type(self).__name__} expects {len(self.input_types)} inputs,"
+                    f" got {len(features)}"
+                )
+            for expected, f in zip(self.input_types, features):
+                self._check_type(expected, f)
+        if not self.allow_label_as_input:
+            for f in features:
+                if f.is_response and not self._is_label_slot(f, features):
+                    raise ValueError(
+                        f"{type(self).__name__} received response feature {f.name!r} as input; "
+                        "response features may only feed label-aware stages"
+                    )
+
+    def _is_label_slot(self, feature: Feature, features: Sequence[Feature]) -> bool:
+        """Fixed-arity label-aware stages override; default: no label slots."""
+        return False
+
+    @staticmethod
+    def _check_type(expected: Type[FeatureType], f: Feature) -> None:
+        if not issubclass(f.ftype, expected):
+            raise TypeError(
+                f"Feature {f.name!r} has type {f.ftype.__name__}, expected {expected.__name__}"
+            )
+
+    @property
+    def inputs(self) -> Tuple[Feature, ...]:
+        return self._input_features
+
+    @property
+    def input_names(self) -> List[str]:
+        return [f.name for f in self._input_features]
+
+    # --- output -------------------------------------------------------------
+    def _output_ftype(self) -> Type[FeatureType]:
+        return self.output_type
+
+    def make_output_name(self) -> str:
+        base = "-".join(f.name for f in self._input_features) or "raw"
+        return f"{base}_{self.operation_name}_{self.uid.rsplit('_', 1)[-1]}"
+
+    def get_output(self) -> Feature:
+        if self._output_feature is None:
+            if not self._input_features:
+                raise ValueError(f"{type(self).__name__}.get_output() before set_input()")
+            self._output_feature = Feature(
+                name=self.make_output_name(),
+                ftype=self._output_ftype(),
+                is_response=self.output_is_response,
+                origin_stage=self,
+                parents=self._input_features,
+            )
+        return self._output_feature
+
+    @property
+    def output_name(self) -> str:
+        return self.get_output().name
+
+    # --- misc ---------------------------------------------------------------
+    def copy(self) -> "PipelineStage":
+        """Fresh instance with same params/attrs and the SAME uid/output feature.
+
+        Used by cross-validation to fit per-fold copies (OpCrossValidation.scala:106-112).
+        Shallow-copies the instance so stages with constructor state (lambdas, types)
+        survive; param values get an independent dict so per-fold mutation is isolated.
+        """
+        import copy as _copy
+
+        clone = _copy.copy(self)
+        clone._param_values = dict(self._param_values)
+        return clone
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(uid={self.uid})"
+
+
+def _default_op_name(cls_name: str) -> str:
+    return cls_name[0].lower() + cls_name[1:]
+
+
+# ---------------------------------------------------------------------------
+# Transformers
+# ---------------------------------------------------------------------------
+
+class Transformer(PipelineStage):
+    """A stage with no fit step: pure column function."""
+
+    is_model: bool = False  # True when produced by an Estimator.fit
+
+    def transform_columns(self, cols: List["Column"], dataset: "Dataset") -> "Column":
+        raise NotImplementedError
+
+    def transform(self, dataset: "Dataset") -> "Dataset":
+        cols = [dataset[f.name] for f in self.inputs]
+        out = self.transform_columns(cols, dataset)
+        return dataset.with_column(self.output_name, out)
+
+    # -- local scoring path (reference OpTransformer.transformKeyValue) ------
+    def transform_values(self, values: Sequence[Any]) -> Any:
+        """Single-row transform: typed input values -> output value.
+
+        Default implementation round-trips through a 1-row dataset; stages with a cheap
+        scalar path may override.
+        """
+        from ..data.dataset import Dataset
+
+        ds = Dataset.from_features(
+            {f.name: [v] for f, v in zip(self.inputs, values)},
+            {f.name: f.ftype for f in self.inputs},
+        )
+        col = self.transform_columns([ds[f.name] for f in self.inputs], ds)
+        return col.to_values(self._output_ftype())[0]
+
+
+class Estimator(PipelineStage):
+    """A stage that must observe data before it can transform (fit -> model)."""
+
+    def fit_columns(self, cols: List["Column"], dataset: "Dataset") -> Transformer:
+        raise NotImplementedError
+
+    def fit(self, dataset: "Dataset") -> Transformer:
+        cols = [dataset[f.name] for f in self.inputs]
+        model = self.fit_columns(cols, dataset)
+        return self._bind_model(model)
+
+    def _bind_model(self, model: Transformer) -> Transformer:
+        """Model shares uid/inputs/output feature with its estimator (Spark-ML convention)."""
+        model.uid = self.uid
+        model.operation_name = self.operation_name
+        model._input_features = self._input_features
+        model._output_feature = self.get_output()
+        model.is_model = True
+        return model
+
+
+# ---------------------------------------------------------------------------
+# Arity-typed bases (OpPipelineStage1..4, N equivalents)
+# ---------------------------------------------------------------------------
+
+class UnaryTransformer(Transformer):
+    """1 input -> 1 output."""
+
+
+class BinaryTransformer(Transformer):
+    """2 inputs -> 1 output."""
+
+
+class TernaryTransformer(Transformer):
+    """3 inputs -> 1 output."""
+
+
+class QuaternaryTransformer(Transformer):
+    """4 inputs -> 1 output."""
+
+
+class SequenceTransformer(Transformer):
+    """N same-typed inputs -> 1 output."""
+
+
+class UnaryEstimator(Estimator):
+    pass
+
+
+class BinaryEstimator(Estimator):
+    pass
+
+
+class TernaryEstimator(Estimator):
+    pass
+
+
+class SequenceEstimator(Estimator):
+    pass
+
+
+class BinarySequenceEstimator(Estimator):
+    """1 fixed input + N same-typed inputs (e.g. label + features)."""
+
+
+class UnaryLambdaTransformer(UnaryTransformer):
+    """Host elementwise transformer from a per-value function (for string/object columns).
+
+    Reference: UnaryTransformer's ``transformFn: I => O``.  Only for host-kind columns —
+    numeric work should use vectorized stages so it can fuse on device.
+    """
+
+    def __init__(self, fn: Callable[[Any], Any], input_type: Type[FeatureType],
+                 output_type: Type[FeatureType], operation_name: Optional[str] = None,
+                 fn_name: Optional[str] = None, **kw):
+        self.input_types = (input_type,)
+        self.output_type = output_type
+        super().__init__(operation_name=operation_name or fn_name or "lambda", **kw)
+        self.fn = fn
+        self.fn_name = fn_name
+
+    def transform_columns(self, cols, dataset):
+        from ..data.dataset import Column
+
+        col = cols[0]
+        in_t = self.input_types[0]
+        out_t = self.output_type
+        values = [self.fn(v) for v in col.to_values(in_t)]
+        return Column.from_values(out_t, [v.value if isinstance(v, FeatureType) else v
+                                          for v in values])

@@ -1,0 +1,58 @@
+"""DAG scheduling — layer stages by max distance to the result sinks.
+
+Reference: core/.../utils/stages/FitStagesUtil.computeDAG (FitStagesUtil.scala:173-198).
+A stage's layer is its maximum distance from any result feature; layers execute from the
+deepest (closest to raw features) to distance 0.  All stages within a layer are independent,
+so their device transforms can fuse into a single XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict, List, Sequence
+
+from ..features.feature import Feature
+from ..features.generator import FeatureGeneratorStage
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..stages.base import PipelineStage
+
+
+def compute_dag(result_features: Sequence[Feature]) -> List[List["PipelineStage"]]:
+    """Layered DAG of non-generator stages, dependency layers first."""
+    distances: Dict["PipelineStage", int] = {}
+    for f in result_features:
+        for stage, dist in f.parent_stages().items():
+            prev = distances.get(stage)
+            if prev is None or dist > prev:
+                distances[stage] = dist
+    items = [
+        (stage, dist)
+        for stage, dist in distances.items()
+        if not isinstance(stage, FeatureGeneratorStage)
+    ]
+    if not items:
+        return []
+    max_dist = max(dist for _, dist in items)
+    layers: List[List["PipelineStage"]] = [[] for _ in range(max_dist + 1)]
+    for stage, dist in items:
+        layers[max_dist - dist].append(stage)
+    # stable order within a layer: by uid for determinism
+    for layer in layers:
+        layer.sort(key=lambda s: s.uid)
+    return [layer for layer in layers if layer]
+
+
+def raw_feature_generators(result_features: Sequence[Feature]) -> List[FeatureGeneratorStage]:
+    """All FeatureGeneratorStages reachable from the result features (dedup, stable order)."""
+    seen: Dict[str, FeatureGeneratorStage] = {}
+    for f in result_features:
+        for raw in f.raw_features():
+            stage = raw.origin_stage
+            if isinstance(stage, FeatureGeneratorStage):
+                seen.setdefault(stage.uid, stage)
+    return sorted(seen.values(), key=lambda s: s.raw_name)
+
+
+def all_stages(result_features: Sequence[Feature]) -> List["PipelineStage"]:
+    """Every non-generator stage in execution order (flattened layers)."""
+    return [s for layer in compute_dag(result_features) for s in layer]
