@@ -7,12 +7,23 @@ collective semantics on one host.  Must run before jax initializes its backends.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment points JAX at a real TPU (axon):
+# tests emulate a multi-chip mesh with 8 virtual CPU devices.
+#
+# NOTE: the environment may pre-import jax at interpreter start (axon sitecustomize),
+# which snapshots JAX_PLATFORMS before this file runs — so setting os.environ is not
+# enough; jax.config.update must be used after import.  XLA_FLAGS is still read at
+# backend-init time, which has not happened yet here.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,150 @@
+"""SanityChecker tests (SURVEY §2.8)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.checkers.sanity import SanityChecker
+from transmogrifai_tpu.data.dataset import Column
+from transmogrifai_tpu.types import OPVector, RealNN
+from transmogrifai_tpu.utils import stats as npstats
+from transmogrifai_tpu.utils.vector_metadata import (
+    VectorColumnMetadata,
+    VectorMetadata,
+)
+
+
+def _vec_ds(x, y, meta_cols):
+    meta = VectorMetadata("features", meta_cols).reindexed()
+    return Dataset({
+        "label": Column.from_values(RealNN, list(map(float, y))),
+        "features": Column.vector(np.asarray(x, dtype=np.float32), meta),
+    })
+
+
+def _wire(stage):
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    vec = FeatureBuilder.OPVector("features").extract_field().as_predictor()
+    out = label.transform_with(stage, vec)
+    return out
+
+
+class TestStats:
+    def test_cramers_v_perfect_association(self):
+        cont = np.array([[50, 0], [0, 50]], dtype=float)
+        assert npstats.cramers_v(cont) == pytest.approx(1.0)
+
+    def test_cramers_v_independent(self):
+        cont = np.array([[25, 25], [25, 25]], dtype=float)
+        assert npstats.cramers_v(cont) == pytest.approx(0.0)
+
+    def test_rule_confidence(self):
+        cont = np.array([[40, 0], [10, 50]], dtype=float)
+        conf, support = npstats.max_rule_confidences(cont)
+        assert conf[0] == pytest.approx(1.0)
+        assert support[0] == pytest.approx(0.4)
+
+    def test_pearson(self):
+        rng = np.random.default_rng(0)
+        y = rng.normal(size=500)
+        x = np.column_stack([y * 2 + rng.normal(scale=0.01, size=500),
+                             rng.normal(size=500)])
+        corr = npstats.pearson_with_label(x, y)
+        assert corr[0] > 0.99 and abs(corr[1]) < 0.2
+
+    def test_spearman_monotonic(self):
+        y = np.arange(100, dtype=float)
+        x = np.exp(y / 10)[:, None]  # monotonic but nonlinear
+        assert npstats.spearman_with_label(x, y)[0] == pytest.approx(1.0)
+
+
+class TestSanityChecker:
+    def test_drops_zero_variance_and_leaky(self):
+        rng = np.random.default_rng(1)
+        n = 400
+        y = (rng.random(n) > 0.5).astype(float)
+        good = rng.normal(size=n) + 0.3 * y
+        const = np.full(n, 3.0)
+        leak = y * 2.0 - 1.0  # perfectly correlated with label
+        x = np.column_stack([good, const, leak])
+        meta_cols = [
+            VectorColumnMetadata("good", "Real"),
+            VectorColumnMetadata("const", "Real"),
+            VectorColumnMetadata("leak", "Real"),
+        ]
+        ds = _vec_ds(x, y, meta_cols)
+        stage = SanityChecker()
+        out = _wire(stage)
+        model = stage.fit(ds)
+        assert model.kept_indices == [0]
+        reasons = model.summary.dropped
+        assert any("variance" in r for r in reasons.values())
+        assert any("corr(label)" in r for r in reasons.values())
+        ds2 = model.transform(ds)
+        col = ds2[out.name]
+        assert col.data.shape == (n, 1)
+        assert col.meta.columns[0].parent_feature == "good"
+
+    def test_drops_high_cramers_v_group(self):
+        rng = np.random.default_rng(2)
+        n = 600
+        y = (rng.random(n) > 0.5).astype(float)
+        # categorical group perfectly aligned with label (2 indicator cols)
+        ind_pos = y
+        ind_neg = 1.0 - y
+        noise = rng.normal(size=n)
+        x = np.column_stack([ind_pos, ind_neg, noise])
+        meta_cols = [
+            VectorColumnMetadata("cat", "PickList", grouping="cat", indicator_value="A"),
+            VectorColumnMetadata("cat", "PickList", grouping="cat", indicator_value="B"),
+            VectorColumnMetadata("noise", "Real"),
+        ]
+        ds = _vec_ds(x, y, meta_cols)
+        # raise max_correlation so the drop can only come from Cramér's V
+        stage = SanityChecker(max_correlation=1.1, max_cramers_v=0.9)
+        _wire(stage)
+        model = stage.fit(ds)
+        assert model.kept_indices == [2]
+        assert all("Cram" in r for n_, r in model.summary.dropped.items())
+
+    def test_keeps_moderate_associations(self):
+        rng = np.random.default_rng(3)
+        n = 500
+        y = (rng.random(n) > 0.5).astype(float)
+        x = np.column_stack([
+            rng.normal(size=n) + 0.5 * y,
+            rng.normal(size=n),
+        ])
+        meta_cols = [VectorColumnMetadata("a", "Real"), VectorColumnMetadata("b", "Real")]
+        ds = _vec_ds(x, y, meta_cols)
+        stage = SanityChecker()
+        _wire(stage)
+        model = stage.fit(ds)
+        assert model.kept_indices == [0, 1]
+        assert model.summary.label_distinct == 2
+        # summary carries per-column stats
+        assert len(model.summary.stats) == 2
+        assert model.summary.stats[0].corr_label > 0.1
+
+    def test_all_dropped_raises(self):
+        n = 100
+        y = np.ones(n)
+        x = np.zeros((n, 1))
+        ds = _vec_ds(x, y, [VectorColumnMetadata("z", "Real")])
+        stage = SanityChecker()
+        _wire(stage)
+        with pytest.raises(ValueError, match="dropped every feature"):
+            stage.fit(ds)
+
+    def test_spearman_mode(self):
+        rng = np.random.default_rng(4)
+        n = 300
+        y = rng.normal(size=n)
+        x = np.column_stack([np.exp(y), rng.normal(size=n)])
+        ds = _vec_ds(x, y, [VectorColumnMetadata("m", "Real"),
+                            VectorColumnMetadata("r", "Real")])
+        stage = SanityChecker(correlation_type="spearman", max_correlation=0.99)
+        _wire(stage)
+        model = stage.fit(ds)
+        # monotonic transform of label -> spearman ~1 -> dropped as leaky
+        assert 0 not in model.kept_indices

@@ -1,0 +1,235 @@
+"""Vectorizer + Transmogrifier tests (SURVEY §2.7)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.ops.combiner import VectorsCombiner
+from transmogrifai_tpu.ops.dates import DateToUnitCircleVectorizer
+from transmogrifai_tpu.ops.maps import NumericMapVectorizer, TextMapPivotVectorizer
+from transmogrifai_tpu.ops.numeric import (
+    BinaryVectorizer,
+    NumericVectorizer,
+    RealNNVectorizer,
+)
+from transmogrifai_tpu.ops.onehot import MultiPickListVectorizer, OneHotVectorizer
+from transmogrifai_tpu.ops.text_smart import SmartTextVectorizer
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.types import (
+    Binary,
+    Date,
+    Geolocation,
+    Integral,
+    MultiPickList,
+    PickList,
+    Real,
+    RealMap,
+    RealNN,
+    Text,
+    TextMap,
+)
+from transmogrifai_tpu.utils.vector_metadata import NULL_INDICATOR, OTHER_INDICATOR
+
+
+def _feat(name, ftype):
+    return FeatureBuilder.of(name, ftype).extract_field().as_predictor()
+
+
+class TestNumericVectorizer:
+    def test_mean_impute_and_null_track(self):
+        a, b = _feat("a", Real), _feat("b", Real)
+        stage = NumericVectorizer(fill_strategy="mean")
+        out = a.transform_with(stage, b)
+        ds = Dataset.from_features(
+            {"a": [1.0, None, 3.0], "b": [10.0, 20.0, 30.0]},
+            {"a": Real, "b": Real},
+        )
+        model = stage.fit(ds)
+        col = model.transform(ds)[out.name]
+        # layout: [a, a_null, b, b_null]
+        np.testing.assert_allclose(
+            col.data,
+            [[1, 0, 10, 0], [2, 1, 20, 0], [3, 0, 30, 0]],
+        )
+        names = col.meta.column_names()
+        assert len(names) == 4
+        assert col.meta.columns[1].is_null_indicator
+
+    def test_mode_impute_integral(self):
+        a = _feat("n", Integral)
+        stage = NumericVectorizer(fill_strategy="mode")
+        a.transform_with(stage)
+        ds = Dataset.from_features({"n": [5, 5, 7, None]}, {"n": Integral})
+        model = stage.fit(ds)
+        col = model.transform(ds)[stage.output_name]
+        assert col.data[3, 0] == 5.0 and col.data[3, 1] == 1.0
+
+    def test_realnn_passthrough(self):
+        a = _feat("x", RealNN)
+        stage = RealNNVectorizer()
+        a.transform_with(stage)
+        ds = Dataset.from_features({"x": [1.0, 2.0]}, {"x": RealNN})
+        col = stage.transform(ds)[stage.output_name]
+        np.testing.assert_allclose(col.data, [[1.0], [2.0]])
+
+    def test_binary(self):
+        a = _feat("flag", Binary)
+        stage = BinaryVectorizer()
+        a.transform_with(stage)
+        ds = Dataset.from_features({"flag": [True, False, None]}, {"flag": Binary})
+        col = stage.transform(ds)[stage.output_name]
+        np.testing.assert_allclose(col.data, [[1, 0], [0, 0], [0, 1]])
+
+
+class TestOneHot:
+    def test_topk_other_null(self):
+        a = _feat("color", PickList)
+        stage = OneHotVectorizer(top_k=2, min_support=2)
+        a.transform_with(stage)
+        values = ["red"] * 5 + ["blue"] * 3 + ["green"] * 2 + ["teal"] + [None]
+        ds = Dataset.from_features({"color": values}, {"color": PickList})
+        model = stage.fit(ds)
+        col = model.transform(ds)[stage.output_name]
+        # vocab: red, blue (top-2 with support>=2); green(2) beyond top_k -> OTHER
+        names = col.meta.column_names()
+        assert col.data.shape == (12, 4)
+        assert col.data[0].tolist() == [1, 0, 0, 0]     # red
+        assert col.data[5].tolist() == [0, 1, 0, 0]     # blue
+        assert col.data[8].tolist() == [0, 0, 1, 0]     # green -> OTHER
+        assert col.data[11].tolist() == [0, 0, 0, 1]    # null
+        assert col.meta.columns[2].indicator_value == OTHER_INDICATOR
+        assert col.meta.columns[3].indicator_value == NULL_INDICATOR
+
+    def test_clean_text_normalizes(self):
+        a = _feat("c", PickList)
+        stage = OneHotVectorizer(top_k=5, min_support=1, clean_text=True)
+        a.transform_with(stage)
+        ds = Dataset.from_features({"c": ["Male ", "Male", "Male?"]}, {"c": PickList})
+        model = stage.fit(ds)
+        col = model.transform(ds)[stage.output_name]
+        # punctuation/whitespace normalize to the same level (case is preserved,
+        # matching reference TextUtils.cleanString semantics)
+        assert col.data[:, 0].sum() == 3.0
+
+    def test_multipicklist(self):
+        a = _feat("tags", MultiPickList)
+        stage = MultiPickListVectorizer(top_k=3, min_support=1)
+        a.transform_with(stage)
+        ds = Dataset.from_features(
+            {"tags": [{"x", "y"}, {"x"}, set()]}, {"tags": MultiPickList}
+        )
+        model = stage.fit(ds)
+        col = model.transform(ds)[stage.output_name]
+        # vocab ordered by count: x(2), y(1); cols [x, y, OTHER, NULL]
+        assert col.data[0].tolist() == [1, 1, 0, 0]
+        assert col.data[2].tolist() == [0, 0, 0, 1]
+
+
+class TestSmartText:
+    def test_categorical_decision(self):
+        a = _feat("cat", Text)
+        stage = SmartTextVectorizer(max_cardinality=10, min_support=1, top_k=5)
+        a.transform_with(stage)
+        ds = Dataset.from_features(
+            {"cat": ["aa", "bb", "aa", "cc"] * 3}, {"cat": Text}
+        )
+        model = stage.fit(ds)
+        assert model.is_categorical == [True]
+        col = model.transform(ds)[stage.output_name]
+        assert col.data.shape[1] == 3 + 1 + 1  # 3 levels + OTHER + NULL
+
+    def test_free_text_hashing(self):
+        a = _feat("txt", Text)
+        stage = SmartTextVectorizer(max_cardinality=3, num_hashes=16)
+        a.transform_with(stage)
+        texts = [f"word{i} common tokens here" for i in range(20)]
+        ds = Dataset.from_features({"txt": texts}, {"txt": Text})
+        model = stage.fit(ds)
+        assert model.is_categorical == [False]
+        col = model.transform(ds)[stage.output_name]
+        assert col.data.shape == (20, 17)  # 16 hash + null indicator
+        assert col.data[:, :16].sum() > 0
+        # deterministic hashing
+        col2 = model.transform(ds)[stage.output_name]
+        np.testing.assert_array_equal(col.data, col2.data)
+
+
+class TestDates:
+    def test_unit_circle(self):
+        a = _feat("d", Date)
+        stage = DateToUnitCircleVectorizer(time_periods=("HourOfDay",))
+        a.transform_with(stage)
+        # 1970-01-01T00:00 and T06:00
+        ds = Dataset.from_features(
+            {"d": [0, 6 * 3600 * 1000, None]}, {"d": Date}
+        )
+        col = stage.transform(ds)[stage.output_name]
+        np.testing.assert_allclose(col.data[0], [1.0, 0.0], atol=1e-6)
+        np.testing.assert_allclose(col.data[1], [0.0, 1.0], atol=1e-6)
+        np.testing.assert_allclose(col.data[2], [0.0, 0.0], atol=1e-6)
+
+
+class TestMaps:
+    def test_numeric_map(self):
+        a = _feat("m", RealMap)
+        stage = NumericMapVectorizer()
+        a.transform_with(stage)
+        ds = Dataset.from_features(
+            {"m": [{"x": 1.0, "y": 2.0}, {"x": 3.0}, {}]}, {"m": RealMap}
+        )
+        model = stage.fit(ds)
+        col = model.transform(ds)[stage.output_name]
+        # keys sorted: x, y ; layout [x, x_null, y, y_null]
+        np.testing.assert_allclose(
+            col.data, [[1, 0, 2, 0], [3, 0, 2, 1], [2, 1, 2, 1]]
+        )
+
+    def test_text_map_pivot(self):
+        a = _feat("tm", TextMap)
+        stage = TextMapPivotVectorizer(top_k=2, min_support=1)
+        a.transform_with(stage)
+        ds = Dataset.from_features(
+            {"tm": [{"k": "u"}, {"k": "v"}, {"k": "u"}, {}]}, {"tm": TextMap}
+        )
+        model = stage.fit(ds)
+        col = model.transform(ds)[stage.output_name]
+        # key k: levels [u, v] + OTHER + NULL
+        assert col.data.shape == (4, 4)
+        assert col.data[0].tolist() == [1, 0, 0, 0]
+        assert col.data[3].tolist() == [0, 0, 0, 1]
+
+
+class TestTransmogrify:
+    def test_mixed_types_end_to_end(self):
+        import pandas as pd
+
+        df = pd.DataFrame({
+            "age": [22.0, 38.0, None, 35.0, 28.0] * 4,
+            "fare": [7.2, 71.3, 8.1, 53.1, 21.0] * 4,
+            "sex": (["male", "female"] * 10),
+            "pclass": [1, 2, 3, 1, 2] * 4,
+            "alone": [True, False, None, True, False] * 4,
+        })
+        feats, ds = FeatureBuilder.from_dataframe(
+            df, ftypes={"sex": PickList, "pclass": Integral}
+        )
+        vec = transmogrify(feats)
+        from transmogrifai_tpu.workflow.dag import compute_dag
+
+        layers = compute_dag([vec])
+        # execute: fit estimators layer by layer
+        for layer in layers:
+            for stage in layer:
+                from transmogrifai_tpu.stages.base import Estimator
+
+                if isinstance(stage, Estimator):
+                    model = stage.fit(ds)
+                    ds = model.transform(ds)
+                else:
+                    ds = stage.transform(ds)
+        col = ds[vec.name]
+        assert col.data.shape[0] == 20
+        assert col.meta is not None
+        assert col.meta.size == col.data.shape[1]
+        parents = {c.parent_feature for c in col.meta.columns}
+        assert parents == {"age", "fare", "sex", "pclass", "alone"}

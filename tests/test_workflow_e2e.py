@@ -1,0 +1,210 @@
+"""End-to-end workflow tests: the canonical Titanic flow (SURVEY §3.1, §7 phase 7).
+
+Mirrors reference helloworld/OpTitanicSimple.scala:84-160: FeatureBuilder -> dsl feature
+math -> transmogrify() -> sanityCheck -> BinaryClassificationModelSelector ->
+Workflow.train() -> score/evaluate -> save/load round-trip.
+
+The real Titanic CSV is read from the reference checkout when present; a deterministic
+synthetic stand-in with the same schema is used otherwise.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    Dataset,
+    Evaluators,
+    FeatureBuilder,
+    Workflow,
+)
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.readers.files import DataReaders
+from transmogrifai_tpu.types import Integral, PickList, Real, RealNN, Text
+
+TITANIC = "/root/reference/helloworld/src/main/resources/TitanicDataset/TitanicPassengersTrainData.csv"
+TITANIC_COLS = ["id", "survived", "pClass", "name", "sex", "age", "sibSp",
+                "parCh", "ticket", "fare", "cabin", "embarked"]
+
+
+def age_group_fn(v):
+    """Module-level (importable) so the fitted model can serialize it."""
+    return None if v is None else ("adult" if v > 18 else "child")
+
+
+def titanic_df():
+    import pandas as pd
+
+    if os.path.exists(TITANIC):
+        return pd.read_csv(TITANIC, header=None, names=TITANIC_COLS)
+    # synthetic fallback with the same schema + plausible signal
+    rng = np.random.default_rng(0)
+    n = 800
+    sex = rng.choice(["male", "female"], n, p=[0.65, 0.35])
+    pclass = rng.choice([1, 2, 3], n, p=[0.25, 0.2, 0.55])
+    age = np.where(rng.random(n) < 0.2, np.nan, rng.normal(30, 12, n).clip(1, 80))
+    fare = rng.lognormal(2.5, 1.0, n)
+    base = 0.6 * (sex == "female") - 0.25 * (pclass == 3) + 0.1 * (fare > 30)
+    y = (rng.random(n) < np.clip(0.25 + base, 0.02, 0.95)).astype(int)
+    return pd.DataFrame({
+        "id": np.arange(n), "survived": y, "pClass": pclass,
+        "name": [f"Name {i}" for i in range(n)], "sex": sex, "age": age,
+        "sibSp": rng.integers(0, 4, n), "parCh": rng.integers(0, 3, n),
+        "ticket": [f"T{i % 100}" for i in range(n)], "fare": fare,
+        "cabin": [None] * n, "embarked": rng.choice(["S", "C", "Q"], n),
+    })
+
+
+def titanic_features():
+    survived = FeatureBuilder.RealNN("survived").extract_field().as_response()
+    p_class = FeatureBuilder.PickList("pClass").extract(
+        lambda r: None if r.get("pClass") is None else str(r["pClass"])).as_predictor()
+    name = FeatureBuilder.Text("name").extract_field().as_predictor()
+    sex = FeatureBuilder.PickList("sex").extract_field().as_predictor()
+    age = FeatureBuilder.Real("age").extract_field().as_predictor()
+    sib_sp = FeatureBuilder.Integral("sibSp").extract_field().as_predictor()
+    par_ch = FeatureBuilder.Integral("parCh").extract_field().as_predictor()
+    ticket = FeatureBuilder.PickList("ticket").extract_field().as_predictor()
+    fare = FeatureBuilder.Real("fare").extract_field().as_predictor()
+    cabin = FeatureBuilder.PickList("cabin").extract(
+        lambda r: r.get("cabin") if isinstance(r.get("cabin"), str) else None
+    ).as_predictor()
+    embarked = FeatureBuilder.PickList("embarked").extract_field().as_predictor()
+    return (survived, p_class, name, sex, age, sib_sp, par_ch, ticket, fare, cabin,
+            embarked)
+
+
+@pytest.fixture(scope="module")
+def titanic_model_and_data():
+    (survived, p_class, name, sex, age, sib_sp, par_ch, ticket, fare, cabin,
+     embarked) = titanic_features()
+
+    # dsl feature engineering (OpTitanicSimple:117-123)
+    family_size = sib_sp + par_ch + 1
+    est_cost = family_size * fare
+    pivoted_sex = sex.pivot(min_support=1)
+    age_group = age.map_to(age_group_fn, PickList, name="ageGroup")
+    normed_age = age.fill_missing_with_mean().z_normalize()
+
+    from transmogrifai_tpu import transmogrify
+
+    passenger_features = transmogrify([
+        p_class, name, age, sib_sp, par_ch, ticket, cabin, embarked,
+        family_size, est_cost, pivoted_sex, age_group, normed_age,
+    ])
+    checked = survived.sanity_check(passenger_features)
+    selector = BinaryClassificationModelSelector.with_train_validation_split(
+        models=[(LogisticRegression(),
+                 [{"reg_param": r, "elastic_net": e}
+                  for r in (0.001, 0.01, 0.1) for e in (0.0,)])],
+    )
+    prediction = survived.transform_with(selector, checked)
+
+    df = titanic_df()
+    reader = DataReaders.Simple.dataframe(df)
+    wf = Workflow().set_result_features(survived, prediction).set_reader(reader)
+    model = wf.train()
+    return model, df, survived, prediction
+
+
+class TestTitanicFlow:
+    def test_train_produces_model(self, titanic_model_and_data):
+        model, df, survived, prediction = titanic_model_and_data
+        s = model.summary()
+        assert s is not None
+        assert s.best_model_name == "LogisticRegression"
+        assert len(s.validation_results) == 3
+
+    def test_aupr_in_reference_range(self, titanic_model_and_data):
+        """Reference anchor: LR AuPR 0.67-0.78 on Titanic 3-fold CV (README.md:63-66)."""
+        model, df, survived, prediction = titanic_model_and_data
+        metrics = model.evaluate(Evaluators.binary_classification(),
+                                 DataReaders.Simple.dataframe(df).generate_dataset(
+                                     model_raw_features(model)))
+        assert metrics["auPR"] > 0.6, metrics
+        assert metrics["auROC"] > 0.7, metrics
+
+    def test_score(self, titanic_model_and_data):
+        model, df, survived, prediction = titanic_model_and_data
+        ds = DataReaders.Simple.dataframe(df).generate_dataset(model_raw_features(model))
+        scored = model.score(ds)
+        assert prediction.name in scored
+        pred_col = scored[prediction.name]
+        assert len(pred_col) == len(df)
+        assert pred_col.prob.shape[1] == 2
+
+    def test_summary_pretty(self, titanic_model_and_data):
+        model, *_ = titanic_model_and_data
+        text = model.summary_pretty()
+        assert "Selected model" in text and "LogisticRegression" in text
+
+    def test_save_load_round_trip(self, titanic_model_and_data, tmp_path):
+        model, df, survived, prediction = titanic_model_and_data
+        ds = DataReaders.Simple.dataframe(df).generate_dataset(model_raw_features(model))
+        expected = model.score(ds)[prediction.name].score
+
+        path = str(tmp_path / "titanic_model")
+        model.save(path)
+        from transmogrifai_tpu import WorkflowModel
+
+        loaded = WorkflowModel.load(path)
+        actual = loaded.score(ds)[prediction.name].score
+        np.testing.assert_allclose(actual, expected, atol=1e-9)
+
+
+def model_raw_features(model):
+    raws = []
+    for f in model.result_features:
+        raws.extend(f.raw_features())
+    # dedup preserving order
+    seen = set()
+    out = []
+    for f in raws:
+        if f.uid not in seen:
+            seen.add(f.uid)
+            out.append(f)
+    return out
+
+
+class TestWorkflowMechanics:
+    def test_holdout_evaluation(self):
+        rng = np.random.default_rng(1)
+        n = 400
+        x1 = rng.normal(size=n)
+        y = (x1 + rng.normal(scale=0.5, size=n) > 0).astype(float)
+        import pandas as pd
+
+        df = pd.DataFrame({"x1": x1, "y": y})
+        ylab = FeatureBuilder.RealNN("y").extract_field().as_response()
+        x1f = FeatureBuilder.Real("x1").extract_field().as_predictor()
+        from transmogrifai_tpu import transmogrify
+
+        vec = transmogrify([x1f])
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            models=[(LogisticRegression(), [{}])])
+        pred = ylab.transform_with(sel, vec)
+        wf = (Workflow().set_result_features(ylab, pred)
+              .set_input_dataset(DataReaders.Simple.dataframe(df)
+                                 .generate_dataset([ylab, x1f])))
+        model = wf.train(test_fraction=0.2)
+        s = model.summary()
+        assert s.holdout_evaluation, "holdout metrics should be recorded"
+        assert s.holdout_evaluation["auROC"] > 0.7
+
+    def test_unfitted_scoring_raises(self):
+        ylab = FeatureBuilder.RealNN("y").extract_field().as_response()
+        x1f = FeatureBuilder.Real("x1").extract_field().as_predictor()
+        from transmogrifai_tpu import transmogrify
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+        vec = transmogrify([x1f])
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            models=[(LogisticRegression(), [{}])])
+        pred = ylab.transform_with(sel, vec)
+        model = WorkflowModel([ylab, pred], fitted={})
+        ds = Dataset.from_features({"y": [1.0], "x1": [0.5]},
+                                   {"y": RealNN, "x1": Real})
+        with pytest.raises(ValueError, match="unfitted"):
+            model.score(ds)

@@ -14,5 +14,22 @@ from .types import *  # noqa: F401,F403 — feature type hierarchy
 from .features.feature import Feature, FeatureHistory
 from .features.builder import FeatureBuilder
 from .data.dataset import Column, Dataset
+from .workflow.workflow import Workflow, WorkflowModel
+from .ops.transmogrifier import transmogrify
+from .checkers.sanity import SanityChecker
+from .models.selector import (
+    BinaryClassificationModelSelector,
+    MultiClassificationModelSelector,
+    RegressionModelSelector,
+    ModelSelector,
+)
+from .evaluators.base import Evaluators
+from .readers.files import DataReaders
+from . import dsl  # noqa: F401 — attaches the rich-feature DSL methods
 
-__all__ = ["Feature", "FeatureHistory", "FeatureBuilder", "Column", "Dataset"]
+__all__ = [
+    "Feature", "FeatureHistory", "FeatureBuilder", "Column", "Dataset",
+    "Workflow", "WorkflowModel", "transmogrify", "SanityChecker",
+    "BinaryClassificationModelSelector", "MultiClassificationModelSelector",
+    "RegressionModelSelector", "ModelSelector", "Evaluators", "DataReaders",
+]

@@ -1,0 +1,288 @@
+"""SanityChecker — automatic feature validation on device.
+
+Reference: core/.../preparators/SanityChecker.scala (fitFn :535-650, categoricalTests
+:420-516, getFeaturesToDrop :360-408), SanityCheckerMetadata.scala.
+
+(label RealNN, features OPVector) -> cleaned OPVector.  All statistics run as one jitted
+XLA program over the row-sharded feature block: moments via masked reductions (psum over
+the data axis when sharded), label correlations as a single matvec, and per-group
+contingency matrices as ``indicators^T @ onehot(label)`` — an MXU matmul (SURVEY §7.5).
+Drop decisions and metadata bookkeeping stay on host.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import Column
+from ..stages.base import BinaryEstimator, Param, Transformer
+from ..types import OPVector, RealNN
+from ..utils import stats as npstats
+from ..utils.vector_metadata import VectorMetadata
+
+MAX_LABEL_CATEGORIES = 100  # reference categorical-label heuristic cap
+
+
+@dataclass
+class ColumnStats:
+    name: str
+    mean: float
+    variance: float
+    min: float
+    max: float
+    corr_label: float
+    cramers_v: Optional[float] = None
+    max_rule_confidence: Optional[float] = None
+    support: Optional[float] = None
+
+
+@dataclass
+class SanityCheckerSummary:
+    """Everything the checker learned — feeds ModelInsights (SanityCheckerMetadata.scala)."""
+
+    stats: List[ColumnStats] = field(default_factory=list)
+    dropped: Dict[str, str] = field(default_factory=dict)  # column name -> reason
+    kept_indices: List[int] = field(default_factory=list)
+    label_distinct: int = 0
+    sample_size: int = 0
+    correlation_type: str = "pearson"
+    correlations_feature: Optional[np.ndarray] = None  # (d,d) when small enough
+
+    def to_dict(self) -> dict:
+        return {
+            "dropped": self.dropped,
+            "keptIndices": self.kept_indices,
+            "labelDistinct": self.label_distinct,
+            "sampleSize": self.sample_size,
+            "correlationType": self.correlation_type,
+            "stats": [vars(s) for s in self.stats],
+        }
+
+
+@partial(jax.jit, static_argnames=("compute_full_corr",))
+def _device_stats(x: jnp.ndarray, y: jnp.ndarray, compute_full_corr: bool = False):
+    """Moments + label correlation in one XLA program (row reductions -> psum over mesh)."""
+    n = x.shape[0]
+    mean = x.mean(axis=0)
+    var = x.var(axis=0)
+    xmin = x.min(axis=0)
+    xmax = x.max(axis=0)
+    xc = x - mean
+    yc = y - y.mean()
+    cov = xc.T @ yc / n
+    sx = jnp.sqrt((xc ** 2).mean(axis=0))
+    sy = jnp.sqrt((yc ** 2).mean())
+    corr = cov / (sx * sy)
+    full = None
+    if compute_full_corr:
+        c = (xc.T @ xc) / n
+        denom = sx[:, None] * sx[None, :]
+        full = c / denom
+    return mean, var, xmin, xmax, corr, full
+
+
+@jax.jit
+def _device_contingency(g: jnp.ndarray, y_onehot: jnp.ndarray) -> jnp.ndarray:
+    """(levels, n)^T-free contingency: g (n, L) indicators x y_onehot (n, C) -> (L, C)."""
+    return g.T @ y_onehot
+
+
+class SanityChecker(BinaryEstimator):
+    """Drop low-signal and leaky slots from the feature vector."""
+
+    input_types = (RealNN, OPVector)
+    output_type = OPVector
+    allow_label_as_input = True
+
+    check_sample = Param(default=1.0, doc="row fraction to sample for stats")
+    sample_seed = Param(default=42)
+    max_correlation = Param(default=0.95, doc="drop |corr with label| above (leakage)")
+    min_correlation = Param(default=0.0, doc="drop |corr with label| below")
+    min_variance = Param(default=1e-5, doc="drop variance below")
+    max_cramers_v = Param(default=0.95, doc="drop categorical groups with V above")
+    max_rule_confidence = Param(default=1.0)
+    min_required_rule_support = Param(default=1.0)
+    correlation_type = Param(default="pearson",
+                             validator=lambda v: v in ("pearson", "spearman"))
+    remove_bad_features = Param(default=True)
+    categorical_label = Param(default=None, doc="None = auto-detect")
+    max_features_for_full_corr = Param(default=512)
+
+    def _is_label_slot(self, feature, features) -> bool:
+        return feature is features[0]
+
+    def fit_columns(self, cols, dataset):
+        label_col, vec_col = cols
+        if vec_col.meta is None:
+            raise ValueError("SanityChecker requires vector metadata on its feature input")
+        y = label_col.data.astype(np.float64)
+        x = vec_col.data.astype(np.float32)
+        n, d = x.shape
+
+        if self.check_sample < 1.0:
+            rng = np.random.default_rng(self.sample_seed)
+            idx = rng.random(n) < self.check_sample
+            x, y = x[idx], y[idx]
+            n = x.shape[0]
+
+        meta = vec_col.meta
+        names = meta.column_names()
+
+        compute_full = d <= self.max_features_for_full_corr
+        if self.correlation_type == "spearman":
+            corr = npstats.spearman_with_label(x, y)
+            mean_, var_, min_, max_, _, full = map(
+                _to_np, _device_stats(jnp.asarray(x), jnp.asarray(y), compute_full)
+            )
+        else:
+            mean_, var_, min_, max_, corr, full = map(
+                _to_np, _device_stats(jnp.asarray(x), jnp.asarray(y), compute_full)
+            )
+
+        # --- categorical label? (reference heuristic SanityChecker.scala:447) ----
+        label_levels = np.unique(y)
+        if self.categorical_label is None:
+            label_is_cat = len(label_levels) <= min(MAX_LABEL_CATEGORIES, np.sqrt(n))
+        else:
+            label_is_cat = bool(self.categorical_label)
+
+        # --- per-group contingency stats (Cramér's V, rule confidence) -----------
+        group_v: Dict[str, float] = {}
+        group_conf: Dict[str, np.ndarray] = {}
+        group_support: Dict[str, np.ndarray] = {}
+        groups = meta.grouping_keys()
+        if label_is_cat and groups:
+            y_onehot = (y[:, None] == label_levels[None, :]).astype(np.float32)
+            y_dev = jnp.asarray(y_onehot)
+            for gkey, indices in groups.items():
+                g = jnp.asarray(x[:, indices])
+                cont = np.asarray(_device_contingency(g, y_dev))
+                group_v[gkey] = npstats.cramers_v(cont)
+                conf, support = npstats.max_rule_confidences(cont)
+                group_conf[gkey] = conf
+                group_support[gkey] = support
+
+        # --- drop decisions (reference getFeaturesToDrop :360-408) ----------------
+        dropped: Dict[str, str] = {}
+        if self.remove_bad_features:
+            for j in range(d):
+                name = names[j]
+                if var_[j] < self.min_variance:
+                    dropped[name] = f"variance {var_[j]:.3g} < min {self.min_variance}"
+                    continue
+                cj = corr[j]
+                if np.isfinite(cj):
+                    if abs(cj) > self.max_correlation:
+                        dropped[name] = (
+                            f"|corr(label)| {abs(cj):.3f} > max {self.max_correlation}"
+                        )
+                        continue
+                    if abs(cj) < self.min_correlation:
+                        dropped[name] = (
+                            f"|corr(label)| {abs(cj):.3f} < min {self.min_correlation}"
+                        )
+                        continue
+            for gkey, indices in groups.items():
+                v = group_v.get(gkey)
+                if v is not None and np.isfinite(v) and v > self.max_cramers_v:
+                    for j in indices:
+                        dropped.setdefault(
+                            names[j], f"Cramér's V {v:.3f} > max {self.max_cramers_v}"
+                        )
+                conf = group_conf.get(gkey)
+                if conf is not None:
+                    support = group_support[gkey]
+                    for pos, j in enumerate(indices):
+                        if (conf[pos] >= self.max_rule_confidence
+                                and support[pos] >= self.min_required_rule_support):
+                            dropped.setdefault(
+                                names[j],
+                                f"rule confidence {conf[pos]:.3f} with support "
+                                f"{support[pos]:.3f}",
+                            )
+
+        kept = [j for j in range(d) if names[j] not in dropped]
+        if not kept:
+            raise ValueError(
+                "SanityChecker dropped every feature slot — check label quality or relax "
+                "thresholds"
+            )
+
+        summary = SanityCheckerSummary(
+            stats=[
+                ColumnStats(
+                    name=names[j], mean=float(mean_[j]), variance=float(var_[j]),
+                    min=float(min_[j]), max=float(max_[j]),
+                    corr_label=float(corr[j]) if np.isfinite(corr[j]) else float("nan"),
+                    cramers_v=_group_value(meta, j, group_v),
+                    max_rule_confidence=_group_pos_value(meta, j, groups, group_conf),
+                    support=_group_pos_value(meta, j, groups, group_support),
+                )
+                for j in range(d)
+            ],
+            dropped=dropped,
+            kept_indices=kept,
+            label_distinct=len(label_levels),
+            sample_size=n,
+            correlation_type=self.correlation_type,
+            correlations_feature=full,
+        )
+        return SanityCheckerModel(kept_indices=kept, summary=summary)
+
+
+def _to_np(v):
+    return None if v is None else np.asarray(v)
+
+
+def _group_value(meta: VectorMetadata, j: int, group_v: Dict[str, float]):
+    c = meta.columns[j]
+    if not c.is_indicator:
+        return None
+    return group_v.get(c.grouping_key())
+
+
+def _group_pos_value(meta, j, groups, values):
+    c = meta.columns[j]
+    if not c.is_indicator:
+        return None
+    gkey = c.grouping_key()
+    if gkey not in values:
+        return None
+    pos = groups[gkey].index(j)
+    return float(values[gkey][pos])
+
+
+class SanityCheckerModel(Transformer):
+    """Slices the kept feature slots (DropIndicesByTransformer equivalent)."""
+
+    input_types = (RealNN, OPVector)
+    output_type = OPVector
+    allow_label_as_input = True
+
+    def __init__(self, kept_indices: List[int], summary: Optional[SanityCheckerSummary] = None,
+                 **kw):
+        super().__init__(**kw)
+        self.kept_indices = list(kept_indices)
+        self.summary = summary
+
+    def _is_label_slot(self, feature, features) -> bool:
+        return feature is features[0]
+
+    def transform(self, dataset):
+        # label is absent at scoring time — only the feature vector is needed
+        vec = dataset[self.inputs[1].name]
+        out = self.transform_columns([None, vec], dataset)
+        return dataset.with_column(self.output_name, out)
+
+    def transform_columns(self, cols, dataset):
+        vec = cols[1]
+        data = vec.data[:, self.kept_indices]
+        meta = (vec.meta.select(self.kept_indices, self.output_name)
+                if vec.meta is not None else None)
+        return Column.vector(data, meta)

@@ -1,0 +1,90 @@
+"""Feature DSL — the rich-feature shortcut API.
+
+Reference: core/.../dsl/Rich*Feature.scala (~3.9K LoC): implicit classes giving features
+``+``, ``-``, ``*``, ``/``, ``.pivot()``, ``.vectorize()``, ``.fillMissingWithMean()``,
+``.zNormalize()``, ``.sanityCheck(...)``, ``Seq(...).transmogrify()``.
+
+Importing this module (done by the package ``__init__``) attaches the methods to Feature.
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import Callable, Optional, Sequence, Type
+
+from .features.feature import Feature
+from .ops.math import BinaryMathTransformer, AliasTransformer, ScalarMathTransformer
+from .ops.onehot import OneHotVectorizer
+from .ops.scalers import FillMissingWithMean, StandardScaler, NumericBucketizer
+from .ops.transmogrifier import transmogrify
+from .checkers.sanity import SanityChecker
+from .stages.base import UnaryLambdaTransformer
+from .types import FeatureType, OPNumeric
+
+
+def _binary_op(op: str):
+    def method(self: Feature, other):
+        if isinstance(other, Feature):
+            return self.transform_with(BinaryMathTransformer(op=op), other)
+        if isinstance(other, numbers.Number):
+            return self.transform_with(ScalarMathTransformer(op=op, scalar=float(other)))
+        return NotImplemented
+
+    return method
+
+
+def _pivot(self: Feature, top_k: int = 20, min_support: int = 10) -> Feature:
+    return self.transform_with(OneHotVectorizer(top_k=top_k, min_support=min_support))
+
+
+def _fill_missing_with_mean(self: Feature, default: float = 0.0) -> Feature:
+    return self.transform_with(FillMissingWithMean(default_value=default))
+
+
+def _z_normalize(self: Feature) -> Feature:
+    return self.transform_with(StandardScaler())
+
+
+def _bucketize(self: Feature, splits: Sequence[float], track_nulls: bool = True) -> Feature:
+    return self.transform_with(
+        NumericBucketizer(splits=tuple(splits), track_nulls=track_nulls))
+
+
+def _map_to(self: Feature, fn: Callable, output_type: Type[FeatureType],
+            name: Optional[str] = None) -> Feature:
+    """Apply a per-value function (reference ``feature.map[T](fn)``)."""
+    t = UnaryLambdaTransformer(
+        fn=fn, input_type=self.ftype, output_type=output_type,
+        operation_name=name or "map",
+    )
+    return self.transform_with(t)
+
+
+def _alias(self: Feature, name: str) -> Feature:
+    return self.transform_with(AliasTransformer(name=name))
+
+
+def _sanity_check(self: Feature, features: Feature, **params) -> Feature:
+    """label.sanity_check(feature_vector) — reference RichNumericFeature.sanityCheck."""
+    if not self.is_response:
+        raise ValueError("sanity_check must be called on the response (label) feature")
+    return self.transform_with(SanityChecker(**params), features)
+
+
+def _vectorize_seq(features: Sequence[Feature], **kw) -> Feature:
+    return transmogrify(features, **kw)
+
+
+Feature.__add__ = _binary_op("plus")
+Feature.__sub__ = _binary_op("minus")
+Feature.__mul__ = _binary_op("multiply")
+Feature.__truediv__ = _binary_op("divide")
+Feature.pivot = _pivot
+Feature.fill_missing_with_mean = _fill_missing_with_mean
+Feature.z_normalize = _z_normalize
+Feature.bucketize = _bucketize
+Feature.map_to = _map_to
+Feature.alias = _alias
+Feature.sanity_check = _sanity_check
+
+__all__ = ["transmogrify"]

@@ -1,0 +1,126 @@
+"""Device metric kernels — jit/vmap-able weighted classification & regression metrics.
+
+Reference: Spark BinaryClassificationMetrics semantics (AuROC/AuPR via trapezoid rule,
+PR curve prepended with (recall=0, precision=1)) as used by
+core/.../evaluators/OpBinaryClassificationEvaluator.scala.
+
+All functions take (scores, labels, weights) device arrays with static shapes so the CV
+sweep can vmap them over (grid x fold) without recompilation; weight=0 rows are inert.
+Tie handling is per-row rather than per-distinct-threshold — identical for continuous
+scores, within noise for discrete ones.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-12
+
+
+def _sorted_cums(scores: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray):
+    order = jnp.argsort(-scores)
+    ys = y[order]
+    ws = w[order]
+    tp = jnp.cumsum(ws * ys)
+    fp = jnp.cumsum(ws * (1.0 - ys))
+    return tp, fp
+
+
+def au_roc(scores: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Weighted area under the ROC curve (trapezoid)."""
+    tp, fp = _sorted_cums(scores, y, w)
+    pos = tp[-1]
+    neg = fp[-1]
+    tpr = tp / jnp.maximum(pos, EPS)
+    fpr = fp / jnp.maximum(neg, EPS)
+    tpr = jnp.concatenate([jnp.zeros(1), tpr])
+    fpr = jnp.concatenate([jnp.zeros(1), fpr])
+    return jnp.sum(0.5 * (tpr[1:] + tpr[:-1]) * (fpr[1:] - fpr[:-1]))
+
+
+def au_pr(scores: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Weighted area under the precision-recall curve (trapezoid, (0,1) start point)."""
+    tp, fp = _sorted_cums(scores, y, w)
+    pos = tp[-1]
+    recall = tp / jnp.maximum(pos, EPS)
+    precision = tp / jnp.maximum(tp + fp, EPS)
+    recall = jnp.concatenate([jnp.zeros(1), recall])
+    precision = jnp.concatenate([jnp.ones(1), precision])
+    return jnp.sum(0.5 * (precision[1:] + precision[:-1]) * (recall[1:] - recall[:-1]))
+
+
+def binary_counts(scores, y, w, threshold: float = 0.5):
+    pred = (scores >= threshold).astype(scores.dtype)
+    tp = jnp.sum(w * pred * y)
+    fp = jnp.sum(w * pred * (1 - y))
+    tn = jnp.sum(w * (1 - pred) * (1 - y))
+    fn = jnp.sum(w * (1 - pred) * y)
+    return tp, fp, tn, fn
+
+
+def precision_recall_f1(scores, y, w, threshold: float = 0.5):
+    tp, fp, tn, fn = binary_counts(scores, y, w, threshold)
+    precision = tp / jnp.maximum(tp + fp, EPS)
+    recall = tp / jnp.maximum(tp + fn, EPS)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, EPS)
+    error = (fp + fn) / jnp.maximum(tp + fp + tn + fn, EPS)
+    return precision, recall, f1, error
+
+
+def log_loss(scores, y, w):
+    p = jnp.clip(scores, EPS, 1 - EPS)
+    ll = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+    return jnp.sum(w * ll) / jnp.maximum(jnp.sum(w), EPS)
+
+
+# --- regression --------------------------------------------------------------
+
+def mse(pred, y, w):
+    return jnp.sum(w * (pred - y) ** 2) / jnp.maximum(jnp.sum(w), EPS)
+
+
+def rmse(pred, y, w):
+    return jnp.sqrt(mse(pred, y, w))
+
+
+def mae(pred, y, w):
+    return jnp.sum(w * jnp.abs(pred - y)) / jnp.maximum(jnp.sum(w), EPS)
+
+
+def r2(pred, y, w):
+    sw = jnp.maximum(jnp.sum(w), EPS)
+    ybar = jnp.sum(w * y) / sw
+    ss_res = jnp.sum(w * (y - pred) ** 2)
+    ss_tot = jnp.maximum(jnp.sum(w * (y - ybar) ** 2), EPS)
+    return 1.0 - ss_res / ss_tot
+
+
+def smape(pred, y, w):
+    denom = jnp.maximum(jnp.abs(pred) + jnp.abs(y), EPS)
+    return 2.0 * jnp.sum(w * jnp.abs(pred - y) / denom) / jnp.maximum(jnp.sum(w), EPS)
+
+
+# --- multiclass --------------------------------------------------------------
+
+def multiclass_error(prob, y, w):
+    """prob (n, C), y (n,) integer labels, w (n,)."""
+    pred = jnp.argmax(prob, axis=1).astype(y.dtype)
+    wrong = (pred != y).astype(prob.dtype)
+    return jnp.sum(w * wrong) / jnp.maximum(jnp.sum(w), EPS)
+
+
+METRICS_BINARY = {
+    "auPR": au_pr,
+    "auROC": au_roc,
+    "logLoss": log_loss,
+}
+METRICS_REGRESSION = {
+    "rmse": rmse,
+    "mse": mse,
+    "mae": mae,
+    "r2": r2,
+    "smape": smape,
+}
+# metrics where larger is better
+LARGER_IS_BETTER = {"auPR", "auROC", "r2", "f1", "precision", "recall"}

@@ -1,0 +1,91 @@
+"""Model stage bases: (label RealNN, features OPVector) -> Prediction.
+
+Reference: core/.../sparkwrappers/specific/OpPredictorWrapper.scala — every model estimator
+takes (label, features) and emits a Prediction map.  Here models are pure JAX: fit produces
+a param pytree; predict is a jitted batched function.  Estimators that implement
+``cv_sweep`` run the whole (fold x grid) sweep as one vmapped XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..data.dataset import Column, Dataset
+from ..stages.base import Estimator, Transformer
+from ..types import OPVector, Prediction, RealNN
+from .prediction import PredictionColumn
+
+
+class PredictionModelBase(Transformer):
+    """Fitted model transformer: scores the feature vector; label input is optional."""
+
+    input_types = (RealNN, OPVector)
+    output_type = Prediction
+    allow_label_as_input = True
+
+    def _is_label_slot(self, feature, features) -> bool:
+        return feature is features[0]
+
+    def predict_column(self, vec: Column) -> PredictionColumn:
+        raise NotImplementedError
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        # label may be absent at scoring time — only the feature vector is required
+        vec = dataset[self.inputs[1].name]
+        return dataset.with_column(self.output_name, self.predict_column(vec))
+
+    def transform_columns(self, cols, dataset):
+        return self.predict_column(cols[-1])
+
+
+class PredictionEstimatorBase(Estimator):
+    input_types = (RealNN, OPVector)
+    output_type = Prediction
+    allow_label_as_input = True
+
+    #: hyperparameter grid axes that can be vmapped on device (dynamic scalars)
+    sweepable_params: tuple = ()
+
+    def _is_label_slot(self, feature, features) -> bool:
+        return feature is features[0]
+
+    def fit_columns(self, cols, dataset):
+        label, vec = cols
+        x = vec.data.astype(np.float32)
+        y = label.data.astype(np.float32)
+        w = dataset["__sample_weight__"].data.astype(np.float32) \
+            if "__sample_weight__" in dataset else np.ones_like(y)
+        return self._fit_arrays(x, y, w)
+
+    def _fit_arrays(self, x: np.ndarray, y: np.ndarray, w: np.ndarray
+                    ) -> PredictionModelBase:
+        raise NotImplementedError
+
+    # --- sweep protocol (overridden by device-sweepable estimators) ----------
+    def cv_sweep(
+        self,
+        x: np.ndarray,
+        y: np.ndarray,
+        train_w: np.ndarray,   # (k, n) fold train weights
+        val_w: np.ndarray,     # (k, n) fold validation weights
+        grids: List[Dict[str, Any]],
+        metric_fn,             # device fn (scores, y, w) -> metric
+    ) -> np.ndarray:
+        """Metric per (grid, fold).  Default: python loops (generic estimators)."""
+        k = train_w.shape[0]
+        out = np.zeros((len(grids), k))
+        for gi, grid in enumerate(grids):
+            est = self.copy().set_params(**grid)
+            for f in range(k):
+                model = est._fit_arrays(x, y, train_w[f])
+                col = model.predict_column(Column.vector(x))
+                # multiclass metrics take the (n, C) probability matrix; binary and
+                # regression metrics take the 1-D score
+                if col.prob is not None and col.prob.shape[1] > 2:
+                    payload = col.prob
+                else:
+                    payload = col.score
+                out[gi, f] = float(metric_fn(payload, y, val_w[f]))
+        return out

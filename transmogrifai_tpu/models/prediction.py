@@ -1,0 +1,83 @@
+"""Prediction column — dense columnar storage for model outputs.
+
+The ``Prediction`` feature type is a map with reserved keys (reference Maps.scala); storing
+a dict per row would kill device throughput, so the columnar path keeps predictions as
+dense arrays: pred (n,), raw (n, k), prob (n, k).  ``to_values`` materializes the reference
+map representation lazily for local scoring / serde parity.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..data.dataset import Column
+from ..types import Prediction
+
+
+class PredictionColumn(Column):
+    __slots__ = ("pred", "raw", "prob")
+
+    def __init__(self, pred: np.ndarray, raw: Optional[np.ndarray] = None,
+                 prob: Optional[np.ndarray] = None):
+        pred = np.asarray(pred, dtype=np.float64).reshape(-1)
+        parts = [pred[:, None]]
+        if raw is not None:
+            raw = np.asarray(raw, dtype=np.float64)
+            parts.append(raw)
+        if prob is not None:
+            prob = np.asarray(prob, dtype=np.float64)
+            parts.append(prob)
+        super().__init__(Prediction, np.hstack(parts), None, None)
+        self.pred = pred
+        self.raw = raw
+        self.prob = prob
+
+    @classmethod
+    def classification(cls, raw: np.ndarray, prob: np.ndarray) -> "PredictionColumn":
+        pred = np.argmax(prob, axis=1).astype(np.float64)
+        return cls(pred, raw, prob)
+
+    @classmethod
+    def regression(cls, pred: np.ndarray) -> "PredictionColumn":
+        return cls(pred)
+
+    @property
+    def score(self) -> np.ndarray:
+        """Positive-class probability for binary problems, else the prediction."""
+        if self.prob is not None and self.prob.shape[1] == 2:
+            return self.prob[:, 1]
+        return self.pred
+
+    def present(self) -> np.ndarray:
+        return np.ones(len(self), dtype=np.bool_)
+
+    def to_values(self, ftype=None) -> List[dict]:
+        out = []
+        for i in range(len(self)):
+            m = {Prediction.PredictionName: float(self.pred[i])}
+            if self.raw is not None:
+                for j in range(self.raw.shape[1]):
+                    m[f"{Prediction.RawPredictionName}_{j}"] = float(self.raw[i, j])
+            if self.prob is not None:
+                for j in range(self.prob.shape[1]):
+                    m[f"{Prediction.ProbabilityName}_{j}"] = float(self.prob[i, j])
+            out.append(m)
+        return out
+
+    def take(self, indices: np.ndarray) -> "PredictionColumn":
+        return PredictionColumn(
+            self.pred[indices],
+            self.raw[indices] if self.raw is not None else None,
+            self.prob[indices] if self.prob is not None else None,
+        )
+
+    def concat(self, other: "Column") -> "PredictionColumn":
+        if not isinstance(other, PredictionColumn):
+            raise TypeError("can only concat PredictionColumns")
+        return PredictionColumn(
+            np.concatenate([self.pred, other.pred]),
+            np.concatenate([self.raw, other.raw]) if self.raw is not None else None,
+            np.concatenate([self.prob, other.prob]) if self.prob is not None else None,
+        )

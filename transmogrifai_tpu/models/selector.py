@@ -1,0 +1,314 @@
+"""ModelSelector — automatic model + hyperparameter selection.
+
+Reference: core/.../selector/ModelSelector.scala:71-195 (findBestEstimator :115-127,
+fit :144-193), ModelSelectorSummary.scala, factories in
+BinaryClassificationModelSelector.scala / MultiClassificationModelSelector.scala /
+RegressionModelSelector.scala, DefaultSelectorParams.scala.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.dataset import Column
+from ..evaluators.base import (
+    BinaryClassificationEvaluator,
+    Evaluator,
+    Evaluators,
+    MultiClassificationEvaluator,
+    RegressionEvaluator,
+)
+from .base import PredictionEstimatorBase, PredictionModelBase
+from .linear import LinearRegression
+from .logistic import LogisticRegression
+from .prediction import PredictionColumn
+from .softmax import MultinomialLogisticRegression
+from .tuning import (
+    CrossValidator,
+    DataBalancer,
+    DataCutter,
+    DataSplitter,
+    ModelEvaluation,
+    PrepSummary,
+    TrainValidationSplit,
+    ValidationResult,
+)
+
+
+@dataclass
+class ModelSelectorSummary:
+    """Validation results + best model + data prep + train/holdout metrics.
+
+    Reference: ModelSelectorSummary.scala:1-309.
+    """
+
+    validation_type: str = "cv"
+    validation_results: List[ModelEvaluation] = field(default_factory=list)
+    best_model_name: str = ""
+    best_model_uid: str = ""
+    best_grid: Dict[str, Any] = field(default_factory=dict)
+    metric_name: str = ""
+    larger_is_better: bool = True
+    data_prep: Optional[PrepSummary] = None
+    train_evaluation: Dict[str, float] = field(default_factory=dict)
+    holdout_evaluation: Dict[str, float] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "validationType": self.validation_type,
+            "bestModelName": self.best_model_name,
+            "bestModelUID": self.best_model_uid,
+            "bestGrid": self.best_grid,
+            "metricName": self.metric_name,
+            "dataPrep": vars(self.data_prep) if self.data_prep else None,
+            "trainEvaluation": self.train_evaluation,
+            "holdoutEvaluation": self.holdout_evaluation,
+            "validationResults": [
+                {
+                    "modelName": ev.model_name,
+                    "grid": ev.grid,
+                    "metric": ev.metric_name,
+                    "values": ev.metric_values,
+                    "mean": ev.mean_metric,
+                }
+                for ev in self.validation_results
+            ],
+        }
+
+    def pretty(self) -> str:
+        from ..utils.pretty import Table
+
+        sign = -1.0 if self.larger_is_better else 1.0
+        rows = [
+            (ev.model_name, _grid_str(ev.grid), f"{ev.mean_metric:.4f}")
+            for ev in sorted(self.validation_results,
+                             key=lambda e: sign * e.mean_metric
+                             if np.isfinite(e.mean_metric) else np.inf)
+        ]
+        t = Table(("Model", "Grid", f"mean {self.metric_name}"), rows)
+        lines = [
+            f"Selected model: {self.best_model_name} {_grid_str(self.best_grid)}",
+            t.render(),
+            f"Train metrics: {self.train_evaluation}",
+        ]
+        if self.holdout_evaluation:
+            lines.append(f"Holdout metrics: {self.holdout_evaluation}")
+        return "\n".join(lines)
+
+
+def _grid_str(grid: Dict[str, Any]) -> str:
+    return "{" + ", ".join(f"{k}={v}" for k, v in sorted(grid.items())) + "}"
+
+
+class ModelSelector(PredictionEstimatorBase):
+    """Estimator over (label, features): validates all (model, grid) candidates, refits best."""
+
+    def __init__(
+        self,
+        models: Sequence[Tuple[PredictionEstimatorBase, List[Dict[str, Any]]]],
+        validator: CrossValidator,
+        splitter: Optional[DataSplitter] = None,
+        train_evaluators: Sequence[Evaluator] = (),
+        **kw,
+    ):
+        super().__init__(operation_name=kw.pop("operation_name", "modelSelector"), **kw)
+        self.models = list(models)
+        self.validator = validator
+        self.splitter = splitter
+        self.train_evaluators = list(train_evaluators)
+
+    def fit_columns(self, cols, dataset):
+        label, vec = cols
+        x = vec.data.astype(np.float32)
+        y = label.data.astype(np.float32)
+
+        base_w, prep_summary = (
+            self.splitter.prepare(y) if self.splitter is not None
+            else (np.ones_like(y, dtype=np.float32), None)
+        )
+        if "__sample_weight__" in dataset:
+            base_w = base_w * dataset["__sample_weight__"].data.astype(np.float32)
+
+        result: ValidationResult = self.validator.validate(self.models, x, y, base_w)
+        best_eval = result.best
+        best_est = next(e for e, _ in self.models if e.uid == best_eval.model_uid)
+        final_est = best_est.copy().set_params(**best_eval.grid)
+        best_model = final_est._fit_arrays(x, y, base_w)
+
+        pred_col = best_model.predict_column(Column.vector(x))
+        train_eval: Dict[str, float] = {}
+        for ev in ([self.validator.evaluator] + self.train_evaluators):
+            try:
+                train_eval.update(ev.evaluate_arrays(y.astype(np.float64), pred_col))
+            except Exception:
+                pass
+
+        summary = ModelSelectorSummary(
+            validation_type=type(self.validator).__name__,
+            validation_results=result.evaluations,
+            best_model_name=best_eval.model_name,
+            best_model_uid=best_eval.model_uid,
+            best_grid=best_eval.grid,
+            metric_name=best_eval.metric_name,
+            larger_is_better=self.validator.evaluator.larger_is_better,
+            data_prep=prep_summary,
+            train_evaluation=train_eval,
+        )
+        return SelectedModel(model=best_model, summary=summary)
+
+
+class SelectedModel(PredictionModelBase):
+    """The winning fitted model + selection summary."""
+
+    def __init__(self, model: PredictionModelBase, summary: ModelSelectorSummary, **kw):
+        super().__init__(**kw)
+        self.model = model
+        self.summary = summary
+
+    def predict_column(self, vec: Column) -> PredictionColumn:
+        return self.model.predict_column(vec)
+
+
+# ---------------------------------------------------------------------------
+# Factories with reference-default grids
+# ---------------------------------------------------------------------------
+
+class BinaryClassificationModelSelector:
+    """Reference: BinaryClassificationModelSelector.scala:49-150 defaults.
+
+    Model families currently available natively: LogisticRegression (IRLS).
+    RF/GBT/LinearSVC/NaiveBayes land with the tree/SVM milestones and register here.
+    """
+
+    @staticmethod
+    def default_models() -> List[Tuple[PredictionEstimatorBase, List[Dict[str, Any]]]]:
+        lr_grid = [
+            {"reg_param": r, "elastic_net": e}
+            for r in (0.001, 0.01, 0.1)
+            for e in (0.0, 0.5)
+        ]
+        models: List[Tuple[PredictionEstimatorBase, List[Dict[str, Any]]]] = [
+            (LogisticRegression(), lr_grid),
+        ]
+        try:
+            from .trees import GradientBoostedTreesClassifier, RandomForestClassifier
+
+            rf_grid = [
+                {"num_trees": t, "max_depth": d}
+                for t in (50,) for d in (3, 6)
+            ]
+            gbt_grid = [
+                {"num_rounds": r, "max_depth": d}
+                for r in (50,) for d in (3,)
+            ]
+            models.append((RandomForestClassifier(), rf_grid))
+            models.append((GradientBoostedTreesClassifier(), gbt_grid))
+        except ImportError:
+            pass
+        return models
+
+    @staticmethod
+    def with_cross_validation(
+        num_folds: int = 3,
+        validation_metric: str = "auPR",
+        seed: int = 42,
+        splitter: Optional[DataSplitter] = None,
+        models: Optional[Sequence] = None,
+        stratify: bool = False,
+    ) -> ModelSelector:
+        ev = BinaryClassificationEvaluator(validation_metric)
+        return ModelSelector(
+            models=models or BinaryClassificationModelSelector.default_models(),
+            validator=CrossValidator(ev, num_folds=num_folds, seed=seed, stratify=stratify),
+            splitter=splitter if splitter is not None else DataBalancer(),
+            train_evaluators=[Evaluators.binary_classification()],
+        )
+
+    @staticmethod
+    def with_train_validation_split(
+        train_ratio: float = 0.75,
+        validation_metric: str = "auPR",
+        seed: int = 42,
+        splitter: Optional[DataSplitter] = None,
+        models: Optional[Sequence] = None,
+    ) -> ModelSelector:
+        ev = BinaryClassificationEvaluator(validation_metric)
+        return ModelSelector(
+            models=models or BinaryClassificationModelSelector.default_models(),
+            validator=TrainValidationSplit(ev, train_ratio=train_ratio, seed=seed),
+            splitter=splitter if splitter is not None else DataBalancer(),
+            train_evaluators=[Evaluators.binary_classification()],
+        )
+
+
+class MultiClassificationModelSelector:
+    """Reference: MultiClassificationModelSelector.scala:49."""
+
+    @staticmethod
+    def default_models():
+        grid = [{"reg_param": r} for r in (0.001, 0.01, 0.1)]
+        models = [(MultinomialLogisticRegression(), grid)]
+        try:
+            from .trees import RandomForestClassifier
+
+            models.append((RandomForestClassifier(), [{"num_trees": 50, "max_depth": d}
+                                                      for d in (3, 6)]))
+        except ImportError:
+            pass
+        return models
+
+    @staticmethod
+    def with_cross_validation(
+        num_folds: int = 3,
+        validation_metric: str = "error",
+        seed: int = 42,
+        splitter: Optional[DataSplitter] = None,
+        models: Optional[Sequence] = None,
+        stratify: bool = False,
+    ) -> ModelSelector:
+        ev = MultiClassificationEvaluator(validation_metric)
+        return ModelSelector(
+            models=models or MultiClassificationModelSelector.default_models(),
+            validator=CrossValidator(ev, num_folds=num_folds, seed=seed, stratify=stratify),
+            splitter=splitter if splitter is not None else DataCutter(),
+            train_evaluators=[Evaluators.multi_classification()],
+        )
+
+
+class RegressionModelSelector:
+    """Reference: RegressionModelSelector.scala:49."""
+
+    @staticmethod
+    def default_models():
+        grid = [{"reg_param": r, "elastic_net": e}
+                for r in (0.001, 0.01, 0.1) for e in (0.0, 0.5)]
+        models = [(LinearRegression(), grid)]
+        try:
+            from .trees import GradientBoostedTreesRegressor, RandomForestRegressor
+
+            models.append((RandomForestRegressor(), [{"num_trees": 50, "max_depth": d}
+                                                     for d in (3, 6)]))
+            models.append((GradientBoostedTreesRegressor(), [{"num_rounds": 50,
+                                                              "max_depth": 3}]))
+        except ImportError:
+            pass
+        return models
+
+    @staticmethod
+    def with_cross_validation(
+        num_folds: int = 3,
+        validation_metric: str = "rmse",
+        seed: int = 42,
+        splitter: Optional[DataSplitter] = None,
+        models: Optional[Sequence] = None,
+    ) -> ModelSelector:
+        ev = RegressionEvaluator(validation_metric)
+        return ModelSelector(
+            models=models or RegressionModelSelector.default_models(),
+            validator=CrossValidator(ev, num_folds=num_folds, seed=seed),
+            splitter=splitter if splitter is not None else DataSplitter(),
+            train_evaluators=[Evaluators.regression()],
+        )

@@ -1,0 +1,127 @@
+"""Multinomial logistic regression (softmax) — full-batch Newton-free optimizer on device.
+
+Reference capability: multiclass OpLogisticRegression (Spark multinomial family).  Uses
+fixed-iteration full-batch Adam under ``lax.fori_loop`` (one XLA program; vmap-able over
+fold weights and reg grid for CV sweeps).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import Column
+from ..stages.base import Param
+from .base import PredictionEstimatorBase, PredictionModelBase
+from .prediction import PredictionColumn
+
+MAX_ITER_DEFAULT = 200
+LR_DEFAULT = 0.3
+
+
+@partial(jax.jit, static_argnames=("n_classes", "max_iter"))
+def _softmax_core(x, y_onehot, w, reg, n_classes: int, max_iter: int):
+    """x (n, d+1) with ones column; returns B (d+1, C)."""
+    n, d1 = x.shape
+    sw = jnp.maximum(w.sum(), 1e-12)
+    reg_mask = jnp.ones((d1, 1)).at[-1, 0].set(0.0)
+
+    def loss_grad(b):
+        logits = x @ b
+        logp = jax.nn.log_softmax(logits, axis=1)
+        p = jnp.exp(logp)
+        g = x.T @ (w[:, None] * (p - y_onehot)) / sw + reg * reg_mask * b
+        return g
+
+    b0 = jnp.zeros((d1, n_classes), dtype=x.dtype)
+    m0 = jnp.zeros_like(b0)
+    v0 = jnp.zeros_like(b0)
+    beta1, beta2, eps, lr = 0.9, 0.999, 1e-8, LR_DEFAULT
+
+    def step(i, state):
+        b, m, v = state
+        g = loss_grad(b)
+        m = beta1 * m + (1 - beta1) * g
+        v = beta2 * v + (1 - beta2) * g * g
+        mh = m / (1 - beta1 ** (i + 1.0))
+        vh = v / (1 - beta2 ** (i + 1.0))
+        b = b - lr * mh / (jnp.sqrt(vh) + eps)
+        return (b, m, v)
+
+    b, _, _ = jax.lax.fori_loop(0, max_iter, step, (b0, m0, v0))
+    return b
+
+
+class MultinomialLogisticRegression(PredictionEstimatorBase):
+    reg_param = Param(default=0.0)
+    elastic_net = Param(default=0.0)
+    max_iter = Param(default=MAX_ITER_DEFAULT)
+    fit_intercept = Param(default=True)
+    n_classes = Param(default=None, doc="None = infer from labels")
+
+    sweepable_params = ("reg_param",)
+
+    def _with_ones(self, x):
+        if self.fit_intercept:
+            return np.hstack([x, np.ones((x.shape[0], 1), dtype=x.dtype)]).astype(np.float32)
+        return x.astype(np.float32)
+
+    def _n_classes(self, y: np.ndarray) -> int:
+        return int(self.n_classes) if self.n_classes else int(y.max()) + 1
+
+    def _fit_arrays(self, x, y, w):
+        c = self._n_classes(y)
+        y_onehot = np.eye(c, dtype=np.float32)[y.astype(np.int32)]
+        xs = self._with_ones(x)
+        reg = jnp.float32(float(self.reg_param) * (1.0 - float(self.elastic_net)))
+        b = np.asarray(_softmax_core(jnp.asarray(xs), jnp.asarray(y_onehot), jnp.asarray(w),
+                                     reg, c, int(self.max_iter)))
+        if self.fit_intercept:
+            coef, intercept = b[:-1], b[-1]
+        else:
+            coef, intercept = b, np.zeros(c)
+        return MultinomialLogisticRegressionModel(coef=coef, intercept=intercept)
+
+    def cv_sweep(self, x, y, train_w, val_w, grids: List[Dict[str, Any]], metric_fn):
+        c = self._n_classes(y)
+        y_onehot = np.eye(c, dtype=np.float32)[y.astype(np.int32)]
+        xs = self._with_ones(x)
+        regs = jnp.asarray(
+            [float(g.get("reg_param", self.reg_param))
+             * (1.0 - float(g.get("elastic_net", self.elastic_net))) for g in grids],
+            dtype=jnp.float32)
+        xd = jnp.asarray(xs)
+        yoh = jnp.asarray(y_onehot)
+        yd = jnp.asarray(y.astype(np.int32))
+
+        fit_fold = jax.vmap(
+            lambda w_, reg: _softmax_core(xd, yoh, w_, reg, c, int(self.max_iter)),
+            in_axes=(0, None))
+        bs = jax.vmap(lambda reg: fit_fold(jnp.asarray(train_w), reg), in_axes=0)(regs)
+
+        @jax.jit
+        def eval_gk(bs, vw):
+            logits = jnp.einsum("nd,gkdc->gknc", xd, bs)
+            probs = jax.nn.softmax(logits, axis=-1)
+            per_fold = jax.vmap(lambda p, w_: metric_fn(p, yd, w_), in_axes=(0, 0))
+            return jax.vmap(lambda ps: per_fold(ps, vw), in_axes=0)(probs)
+
+        return np.asarray(eval_gk(bs, jnp.asarray(val_w)))
+
+
+class MultinomialLogisticRegressionModel(PredictionModelBase):
+    def __init__(self, coef: np.ndarray, intercept: np.ndarray, **kw):
+        super().__init__(**kw)
+        self.coef = np.asarray(coef, dtype=np.float64)
+        self.intercept = np.asarray(intercept, dtype=np.float64)
+
+    def predict_column(self, vec: Column) -> PredictionColumn:
+        logits = vec.data.astype(np.float64) @ self.coef + self.intercept
+        z = logits - logits.max(axis=1, keepdims=True)
+        e = np.exp(z)
+        prob = e / e.sum(axis=1, keepdims=True)
+        return PredictionColumn.classification(logits, prob)

@@ -1,0 +1,244 @@
+"""Data splitting, rebalancing, and validation (CV / train-validation split).
+
+Reference: core/.../tuning/ — Splitter.scala, DataSplitter.scala, DataBalancer.scala:73-436,
+DataCutter.scala:76-296, OpValidator.scala, OpCrossValidation.scala:42-199,
+OpTrainValidationSplit.scala.
+
+TPU-first: fold membership and class rebalancing are expressed as *sample weights* over a
+fixed row block — shapes stay static, so the whole (grid x fold) sweep fits in one vmapped
+XLA program (the reference instead copies DataFrames per fold and runs a Futures thread
+pool, OpCrossValidation.scala:114-134).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..evaluators.base import Evaluator
+from .base import PredictionEstimatorBase
+
+
+# ---------------------------------------------------------------------------
+# Splitters / balancers / cutters
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PrepSummary:
+    kind: str = "none"
+    details: Dict[str, Any] = field(default_factory=dict)
+
+
+class DataSplitter:
+    """Reserve a test fraction; no label-based prep (regression default)."""
+
+    def __init__(self, reserve_test_fraction: float = 0.0, seed: int = 42):
+        self.reserve_test_fraction = reserve_test_fraction
+        self.seed = seed
+
+    def prepare(self, y: np.ndarray) -> Tuple[np.ndarray, PrepSummary]:
+        """Per-row training weights (1 = keep at weight 1)."""
+        return np.ones_like(y, dtype=np.float32), PrepSummary("DataSplitter")
+
+
+class DataBalancer(DataSplitter):
+    """Binary-label rebalancing via sample weights.
+
+    Reference DataBalancer down-samples the majority / up-weights the minority until the
+    positive fraction reaches ``sample_fraction``.  Weighting (not row dropping) keeps
+    array shapes static for the device sweep; the fitted weights multiply into every
+    model's loss exactly like Spark's weightCol.
+    """
+
+    def __init__(self, sample_fraction: float = 0.1, seed: int = 42,
+                 reserve_test_fraction: float = 0.0):
+        super().__init__(reserve_test_fraction, seed)
+        self.sample_fraction = sample_fraction
+
+    def prepare(self, y: np.ndarray) -> Tuple[np.ndarray, PrepSummary]:
+        n = len(y)
+        pos = float((y == 1.0).sum())
+        neg = n - pos
+        summary = PrepSummary("DataBalancer", {
+            "positiveCount": pos, "negativeCount": neg, "sampleFraction": self.sample_fraction,
+        })
+        if pos == 0 or neg == 0:
+            return np.ones(n, dtype=np.float32), summary
+        small, big = (pos, neg) if pos <= neg else (neg, pos)
+        small_is_pos = pos <= neg
+        frac = small / n
+        if frac >= self.sample_fraction:
+            return np.ones(n, dtype=np.float32), summary
+        # weight the majority down so the weighted minority fraction = sample_fraction
+        target_big = small * (1.0 - self.sample_fraction) / self.sample_fraction
+        big_w = target_big / big
+        w = np.ones(n, dtype=np.float32)
+        if small_is_pos:
+            w[y != 1.0] = big_w
+        else:
+            w[y == 1.0] = big_w
+        summary.details["downSampleFraction"] = big_w
+        return w, summary
+
+
+class DataCutter(DataSplitter):
+    """Multiclass label pruning: drop rare labels (weight 0) and cap label count.
+
+    Reference: DataCutter.scala:76-296.
+    """
+
+    def __init__(self, min_label_fraction: float = 0.0, max_label_categories: int = 100,
+                 seed: int = 42, reserve_test_fraction: float = 0.0):
+        super().__init__(reserve_test_fraction, seed)
+        self.min_label_fraction = min_label_fraction
+        self.max_label_categories = max_label_categories
+
+    def prepare(self, y: np.ndarray) -> Tuple[np.ndarray, PrepSummary]:
+        n = len(y)
+        labels, counts = np.unique(y, return_counts=True)
+        fracs = counts / n
+        keep = fracs >= self.min_label_fraction
+        if keep.sum() > self.max_label_categories:
+            order = np.argsort(-counts)
+            keep = np.zeros_like(keep)
+            keep[order[: self.max_label_categories]] = True
+        kept_labels = set(labels[keep].tolist())
+        w = np.array([1.0 if v in kept_labels else 0.0 for v in y], dtype=np.float32)
+        summary = PrepSummary("DataCutter", {
+            "labelsKept": sorted(kept_labels),
+            "labelsDropped": sorted(set(labels.tolist()) - kept_labels),
+        })
+        return w, summary
+
+
+# ---------------------------------------------------------------------------
+# Validators
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ModelEvaluation:
+    model_name: str
+    model_uid: str
+    grid: Dict[str, Any]
+    metric_name: str
+    metric_values: List[float]          # per fold
+    mean_metric: float = 0.0
+
+    def __post_init__(self):
+        finite = [v for v in self.metric_values if np.isfinite(v)]
+        self.mean_metric = float(np.mean(finite)) if finite else float("nan")
+
+
+@dataclass
+class ValidationResult:
+    evaluations: List[ModelEvaluation]
+    best_index: int
+
+    @property
+    def best(self) -> ModelEvaluation:
+        return self.evaluations[self.best_index]
+
+
+class CrossValidator:
+    """k-fold CV over (estimator, grid) pairs.
+
+    Sweepable estimators (LR/linear/softmax) run all folds x grids in one vmapped XLA
+    program via ``cv_sweep``; generic estimators fall back to per-fold fits.  Fold-robust
+    selection: grids with non-finite metrics on any fold lose to grids evaluated on the
+    full fold count (OpCrossValidation.findBestModel :63-85 semantics).
+    """
+
+    def __init__(self, evaluator: Evaluator, num_folds: int = 3, seed: int = 42,
+                 stratify: bool = False, parallelism: int = 8):
+        self.evaluator = evaluator
+        self.num_folds = num_folds
+        self.seed = seed
+        self.stratify = stratify
+        self.parallelism = parallelism
+
+    def fold_weights(self, y: np.ndarray, base_w: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """(train_w, val_w) of shape (k, n) from fold assignment."""
+        n = len(y)
+        rng = np.random.default_rng(self.seed)
+        if self.stratify:
+            fold_id = np.empty(n, dtype=np.int64)
+            for lbl in np.unique(y):
+                idx = np.flatnonzero(y == lbl)
+                idx = rng.permutation(idx)
+                fold_id[idx] = np.arange(len(idx)) % self.num_folds
+        else:
+            fold_id = rng.permutation(n) % self.num_folds
+        k = self.num_folds
+        train_w = np.zeros((k, n), dtype=np.float32)
+        val_w = np.zeros((k, n), dtype=np.float32)
+        for f in range(k):
+            in_val = fold_id == f
+            train_w[f] = np.where(in_val, 0.0, base_w)
+            val_w[f] = np.where(in_val, base_w, 0.0)
+        return train_w, val_w
+
+    def validate(
+        self,
+        models: Sequence[Tuple[PredictionEstimatorBase, List[Dict[str, Any]]]],
+        x: np.ndarray,
+        y: np.ndarray,
+        base_w: Optional[np.ndarray] = None,
+    ) -> ValidationResult:
+        base_w = np.ones_like(y, dtype=np.float32) if base_w is None else base_w
+        train_w, val_w = self.fold_weights(y, base_w)
+        metric_fn = self.evaluator.metric_fn()
+        evaluations: List[ModelEvaluation] = []
+        for est, grids in models:
+            grids = grids or [{}]
+            try:
+                scores = est.cv_sweep(x, y, train_w, val_w, grids, metric_fn)
+            except Exception as e:  # robust to failing models (SURVEY §5.3)
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "model %s failed in CV (%s); excluded from selection",
+                    type(est).__name__, e)
+                scores = np.full((len(grids), self.num_folds), np.nan)
+            for gi, grid in enumerate(grids):
+                evaluations.append(ModelEvaluation(
+                    model_name=type(est).__name__,
+                    model_uid=est.uid,
+                    grid=grid,
+                    metric_name=self.evaluator.default_metric,
+                    metric_values=[float(v) for v in scores[gi]],
+                ))
+        best = self._best_index(evaluations)
+        return ValidationResult(evaluations, best)
+
+    def _best_index(self, evaluations: List[ModelEvaluation]) -> int:
+        sign = 1.0 if self.evaluator.larger_is_better else -1.0
+
+        def key(i: int):
+            ev = evaluations[i]
+            n_ok = sum(1 for v in ev.metric_values if np.isfinite(v))
+            mean = ev.mean_metric if np.isfinite(ev.mean_metric) else -np.inf * sign
+            return (n_ok, sign * mean)
+
+        if not evaluations:
+            raise ValueError("no models to validate")
+        return max(range(len(evaluations)), key=key)
+
+
+class TrainValidationSplit(CrossValidator):
+    """Single split validator.  Reference: OpTrainValidationSplit.scala:35-130."""
+
+    def __init__(self, evaluator: Evaluator, train_ratio: float = 0.75, seed: int = 42,
+                 stratify: bool = False):
+        super().__init__(evaluator, num_folds=1, seed=seed, stratify=stratify)
+        self.train_ratio = train_ratio
+
+    def fold_weights(self, y, base_w):
+        n = len(y)
+        rng = np.random.default_rng(self.seed)
+        in_val = rng.random(n) >= self.train_ratio
+        train_w = np.where(in_val, 0.0, base_w)[None, :].astype(np.float32)
+        val_w = np.where(in_val, base_w, 0.0)[None, :].astype(np.float32)
+        return train_w, val_w

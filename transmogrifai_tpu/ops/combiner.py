@@ -1,0 +1,35 @@
+"""VectorsCombiner: concatenate OPVector columns + union of metadata.
+
+Reference: core/.../feature/VectorsCombiner.scala.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..data.dataset import Column
+from ..stages.base import SequenceTransformer
+from ..types import OPVector
+from ..utils.vector_metadata import VectorMetadata
+
+
+class VectorsCombiner(SequenceTransformer):
+    sequence_input_type = OPVector
+    output_type = OPVector
+
+    def transform_columns(self, cols, dataset):
+        metas = []
+        for f, c in zip(self.inputs, cols):
+            if c.meta is not None:
+                metas.append(c.meta)
+            else:
+                # synthesize anonymous metadata so downstream always has slot provenance
+                from ..utils.vector_metadata import VectorColumnMetadata
+
+                metas.append(VectorMetadata(f.name, [
+                    VectorColumnMetadata(f.name, f.ftype.__name__, index=i)
+                    for i in range(c.width)
+                ]))
+        meta = VectorMetadata.concat(self.output_name, metas)
+        data = np.hstack([c.data for c in cols]).astype(np.float32)
+        return Column.vector(data, meta)

@@ -1,0 +1,73 @@
+"""Date vectorization: unit-circle projection of time periods.
+
+Reference: core/.../feature/DateToUnitCircleTransformer.scala — sin/cos of
+HourOfDay/DayOfWeek/DayOfMonth/DayOfYear so cyclic time is metrically smooth for models.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import List
+
+import numpy as np
+
+from ..data.dataset import Column
+from ..stages.base import Param, SequenceTransformer
+from ..types import Date, OPVector
+from ..utils.vector_metadata import VectorColumnMetadata, VectorMetadata
+
+TIME_PERIODS = ("HourOfDay", "DayOfWeek", "DayOfMonth", "DayOfYear")
+_PERIOD_SIZE = {"HourOfDay": 24.0, "DayOfWeek": 7.0, "DayOfMonth": 31.0, "DayOfYear": 366.0}
+
+
+def _period_values(ms: np.ndarray, period: str) -> np.ndarray:
+    """Vectorized extraction of the period ordinal from epoch-millis (UTC)."""
+    secs = ms.astype("datetime64[ms]").astype("datetime64[s]")
+    days = secs.astype("datetime64[D]")
+    if period == "HourOfDay":
+        return ((secs - days).astype("timedelta64[h]").astype(np.float64)) % 24
+    if period == "DayOfWeek":
+        # 1970-01-01 is a Thursday; Monday=0
+        return ((days.astype(np.int64) + 3) % 7).astype(np.float64)
+    if period == "DayOfMonth":
+        months = days.astype("datetime64[M]")
+        return (days - months).astype(np.int64).astype(np.float64)  # 0-based
+    if period == "DayOfYear":
+        years = days.astype("datetime64[Y]")
+        return (days - years).astype(np.int64).astype(np.float64)  # 0-based
+    raise ValueError(f"Unknown time period {period!r}")
+
+
+class DateToUnitCircleVectorizer(SequenceTransformer):
+    """Epoch-millis dates -> [cos, sin] per configured time period (missing -> origin)."""
+
+    sequence_input_type = Date
+    output_type = OPVector
+
+    time_periods = Param(default=tuple(TIME_PERIODS))
+
+    def transform_columns(self, cols: List[Column], dataset):
+        n = len(cols[0])
+        blocks = []
+        meta_cols = []
+        for f, col in zip(self.inputs, cols):
+            ms = col.data.astype(np.int64)
+            present = col.present()
+            for period in self.time_periods:
+                size = _PERIOD_SIZE[period]
+                vals = _period_values(ms, period)
+                angle = 2.0 * np.pi * vals / size
+                cos = np.where(present, np.cos(angle), 0.0)
+                sin = np.where(present, np.sin(angle), 0.0)
+                blocks.append(np.column_stack([cos, sin]).astype(np.float32))
+                meta_cols.append(VectorColumnMetadata(
+                    f.name, f.ftype.__name__, grouping=f.name,
+                    descriptor_value=f"x_{period}"))
+                meta_cols.append(VectorColumnMetadata(
+                    f.name, f.ftype.__name__, grouping=f.name,
+                    descriptor_value=f"y_{period}"))
+        meta = VectorMetadata(
+            self.output_name, meta_cols,
+            {f.name: f.history().to_dict() for f in self.inputs},
+        ).reindexed()
+        return Column.vector(np.hstack(blocks), meta)

@@ -1,0 +1,127 @@
+"""Numeric vectorizers: imputation + null tracking.
+
+Reference: core/.../feature/RealVectorizer.scala, IntegralVectorizer (fill mean/mode/constant
++ null indicator), BinaryVectorizer, RealNNVectorizer (SURVEY §2.7 "Numeric").
+
+TPU-first: a whole group of same-typed features becomes one (n, N) block; imputation and
+null-indicator math is vectorized; output is a device-ready (n, 2N) float32 block with
+per-slot metadata.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..data.dataset import Column
+from ..stages.base import Param, SequenceEstimator, SequenceTransformer, Transformer
+from ..types import Binary, Integral, OPNumeric, OPVector, Real, RealNN
+from ..utils.vector_metadata import NULL_INDICATOR, VectorColumnMetadata, VectorMetadata
+
+
+def _stack_f64(cols: List[Column]) -> np.ndarray:
+    """(n, N) float64 with NaN for missing."""
+    return np.column_stack([c.values_f64() for c in cols])
+
+
+def _numeric_meta(stage, track_nulls: bool, descriptor: Optional[str] = None) -> VectorMetadata:
+    cols = []
+    for f in stage.inputs:
+        cols.append(VectorColumnMetadata(f.name, f.ftype.__name__,
+                                         descriptor_value=descriptor))
+        if track_nulls:
+            cols.append(VectorColumnMetadata(f.name, f.ftype.__name__,
+                                             grouping=f.name,
+                                             indicator_value=NULL_INDICATOR))
+    meta = VectorMetadata(stage.output_name, cols,
+                          {f.name: f.history().to_dict() for f in stage.inputs})
+    return meta.reindexed()
+
+
+def _emit(values: np.ndarray, isnan: Optional[np.ndarray], meta: VectorMetadata) -> Column:
+    """Interleave per-feature [value, null_indicator] columns into one block."""
+    n, N = values.shape
+    if isnan is None:
+        return Column.vector(values.astype(np.float32), meta)
+    out = np.empty((n, 2 * N), dtype=np.float32)
+    out[:, 0::2] = values
+    out[:, 1::2] = isnan
+    return Column.vector(out, meta)
+
+
+class NumericVectorizer(SequenceEstimator):
+    """Impute (mean/mode/constant) + optional null indicators for nullable numerics."""
+
+    sequence_input_type = OPNumeric
+    output_type = OPVector
+
+    fill_strategy = Param(default="mean", doc="mean | mode | constant",
+                          validator=lambda v: v in ("mean", "mode", "constant"))
+    fill_constant = Param(default=0.0)
+    track_nulls = Param(default=True)
+
+    def fit_columns(self, cols, dataset):
+        x = _stack_f64(cols)
+        if self.fill_strategy == "constant":
+            fills = np.full(x.shape[1], float(self.fill_constant))
+        elif self.fill_strategy == "mode":
+            fills = np.array([_col_mode(x[:, j]) for j in range(x.shape[1])])
+        else:
+            with np.errstate(invalid="ignore"):
+                fills = np.nan_to_num(np.nanmean(x, axis=0), nan=0.0)
+        return NumericVectorizerModel(fills=fills, track_nulls=self.track_nulls)
+
+
+def _col_mode(v: np.ndarray) -> float:
+    v = v[~np.isnan(v)]
+    if v.size == 0:
+        return 0.0
+    vals, counts = np.unique(v, return_counts=True)
+    return float(vals[np.argmax(counts)])
+
+
+class NumericVectorizerModel(Transformer):
+    sequence_input_type = OPNumeric
+    output_type = OPVector
+
+    def __init__(self, fills: np.ndarray, track_nulls: bool = True, **kw):
+        super().__init__(**kw)
+        self.fills = np.asarray(fills, dtype=np.float64)
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, cols, dataset):
+        x = _stack_f64(cols)
+        nan = np.isnan(x)
+        filled = np.where(nan, self.fills[None, :], x)
+        meta = _numeric_meta(self, self.track_nulls)
+        return _emit(filled, nan.astype(np.float32) if self.track_nulls else None, meta)
+
+
+class RealNNVectorizer(SequenceTransformer):
+    """Non-nullable reals: direct passthrough into the vector."""
+
+    sequence_input_type = RealNN
+    output_type = OPVector
+
+    def transform_columns(self, cols, dataset):
+        x = np.column_stack([c.data.astype(np.float64) for c in cols])
+        return _emit(x, None, _numeric_meta(self, track_nulls=False))
+
+
+class BinaryVectorizer(SequenceTransformer):
+    """Booleans -> {0,1} + null indicator (missing treated as 0)."""
+
+    sequence_input_type = Binary
+    output_type = OPVector
+
+    track_nulls = Param(default=True)
+
+    def transform_columns(self, cols, dataset):
+        n = len(cols[0])
+        vals = np.column_stack([c.data.astype(np.float64) for c in cols])
+        present = np.column_stack([c.present() for c in cols])
+        vals = np.where(present, vals, 0.0)
+        meta = _numeric_meta(self, self.track_nulls)
+        isnan = (~present).astype(np.float32) if self.track_nulls else None
+        return _emit(vals, isnan, meta)

@@ -1,0 +1,180 @@
+"""One-hot pivot vectorizers for categorical text and multi-picklists.
+
+Reference: core/.../feature/OpOneHotVectorizer.scala:1-438 — pivot top-K levels by count with
+min support, plus OTHER and null-indicator columns per feature.
+
+Host side finds the level vocabulary (string work stays on CPU); the emitted one-hot block
+is a dense (n, Σ(k_i+2)) float32 device-ready matrix.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..data.dataset import Column
+from ..stages.base import Param, SequenceEstimator, Transformer
+from ..types import MultiPickList, OPSet, OPVector, Text
+from ..utils.vector_metadata import (
+    NULL_INDICATOR,
+    OTHER_INDICATOR,
+    VectorColumnMetadata,
+    VectorMetadata,
+)
+
+TOP_K_DEFAULT = 20          # Transmogrifier.scala:52-90 TopK
+MIN_SUPPORT_DEFAULT = 10    # MinSupport
+MAX_CARDINALITY = 500
+
+
+def clean_text_value(v: str) -> str:
+    """Normalize a categorical level (reference TextParams.cleanText semantics)."""
+    return "".join(ch for ch in v.strip() if ch.isalnum() or ch == " ")
+
+
+class _OneHotFitMixin:
+    def _fit_vocab(self, value_lists: Sequence[Sequence[str]]) -> List[List[str]]:
+        """Per input feature: ordered kept levels (top-K by count, min support)."""
+        vocabs = []
+        for values in value_lists:
+            counts = Counter(values)
+            kept = [
+                v for v, c in counts.most_common()
+                if c >= self.min_support
+            ]
+            # stable order: count desc, then value asc (deterministic across runs)
+            kept = sorted(kept, key=lambda v: (-counts[v], v))[: self.top_k]
+            vocabs.append(kept)
+        return vocabs
+
+
+class OneHotVectorizer(_OneHotFitMixin, SequenceEstimator):
+    """Single-select categorical (PickList/ComboBox/geo-text) pivot."""
+
+    sequence_input_type = Text
+    output_type = OPVector
+    allow_label_as_input = False
+
+    top_k = Param(default=TOP_K_DEFAULT)
+    min_support = Param(default=MIN_SUPPORT_DEFAULT)
+    clean_text = Param(default=True)
+    track_nulls = Param(default=True)
+
+    def _levels_of(self, col: Column) -> List[str]:
+        out = []
+        for v in col.data:
+            if v is None or v == "":
+                continue
+            out.append(clean_text_value(v) if self.clean_text else v)
+        return out
+
+    def fit_columns(self, cols, dataset):
+        vocabs = self._fit_vocab([self._levels_of(c) for c in cols])
+        return OneHotVectorizerModel(
+            vocabs=vocabs, clean_text=self.clean_text, track_nulls=self.track_nulls
+        )
+
+
+class OneHotVectorizerModel(Transformer):
+    sequence_input_type = Text
+    output_type = OPVector
+
+    def __init__(self, vocabs: List[List[str]], clean_text: bool = True,
+                 track_nulls: bool = True, **kw):
+        super().__init__(**kw)
+        self.vocabs = vocabs
+        self.clean_text = clean_text
+        self.track_nulls = track_nulls
+
+    def _meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for f, vocab in zip(self.inputs, self.vocabs):
+            for level in vocab:
+                cols.append(VectorColumnMetadata(f.name, f.ftype.__name__,
+                                                 grouping=f.name, indicator_value=level))
+            cols.append(VectorColumnMetadata(f.name, f.ftype.__name__,
+                                             grouping=f.name, indicator_value=OTHER_INDICATOR))
+            if self.track_nulls:
+                cols.append(VectorColumnMetadata(f.name, f.ftype.__name__,
+                                                 grouping=f.name, indicator_value=NULL_INDICATOR))
+        return VectorMetadata(
+            self.output_name, cols,
+            {f.name: f.history().to_dict() for f in self.inputs},
+        ).reindexed()
+
+    def transform_columns(self, cols, dataset):
+        n = len(cols[0])
+        blocks = []
+        for col, vocab in zip(cols, self.vocabs):
+            k = len(vocab)
+            width = k + 1 + (1 if self.track_nulls else 0)
+            block = np.zeros((n, width), dtype=np.float32)
+            index: Dict[str, int] = {v: i for i, v in enumerate(vocab)}
+            for i, v in enumerate(col.data):
+                if v is None or v == "":
+                    if self.track_nulls:
+                        block[i, k + 1] = 1.0
+                    continue
+                key = clean_text_value(v) if self.clean_text else v
+                j = index.get(key)
+                if j is None:
+                    block[i, k] = 1.0  # OTHER
+                else:
+                    block[i, j] = 1.0
+            blocks.append(block)
+        return Column.vector(np.hstack(blocks), self._meta())
+
+
+class MultiPickListVectorizer(_OneHotFitMixin, SequenceEstimator):
+    """Multi-select categorical: each set member lights its level column."""
+
+    sequence_input_type = OPSet
+    output_type = OPVector
+
+    top_k = Param(default=TOP_K_DEFAULT)
+    min_support = Param(default=MIN_SUPPORT_DEFAULT)
+    clean_text = Param(default=True)
+    track_nulls = Param(default=True)
+
+    def fit_columns(self, cols, dataset):
+        value_lists = []
+        for c in cols:
+            vals = []
+            for s in c.data:
+                for v in s or ():
+                    vals.append(clean_text_value(v) if self.clean_text else v)
+            value_lists.append(vals)
+        vocabs = self._fit_vocab(value_lists)
+        return MultiPickListVectorizerModel(
+            vocabs=vocabs, clean_text=self.clean_text, track_nulls=self.track_nulls
+        )
+
+
+class MultiPickListVectorizerModel(OneHotVectorizerModel):
+    sequence_input_type = OPSet
+    output_type = OPVector
+
+    def transform_columns(self, cols, dataset):
+        n = len(cols[0])
+        blocks = []
+        for col, vocab in zip(cols, self.vocabs):
+            k = len(vocab)
+            width = k + 1 + (1 if self.track_nulls else 0)
+            block = np.zeros((n, width), dtype=np.float32)
+            index = {v: i for i, v in enumerate(vocab)}
+            for i, members in enumerate(col.data):
+                if not members:
+                    if self.track_nulls:
+                        block[i, k + 1] = 1.0
+                    continue
+                for v in members:
+                    key = clean_text_value(v) if self.clean_text else v
+                    j = index.get(key)
+                    if j is None:
+                        block[i, k] = 1.0
+                    else:
+                        block[i, j] = 1.0
+            blocks.append(block)
+        return Column.vector(np.hstack(blocks), self._meta())

@@ -1,0 +1,159 @@
+"""Scaling stages: FillMissingWithMean, standard scaler (z-normalize), min-max, bucketizer.
+
+Reference: core/.../feature/FillMissingWithMean (RichNumericFeature), OpScalarStandardScaler.scala,
+NumericBucketizer.scala:1-303, PercentileCalibrator.scala, ScalerTransformer.scala.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..data.dataset import Column
+from ..stages.base import Param, Transformer, UnaryEstimator, UnaryTransformer
+from ..types import OPNumeric, OPVector, Real, RealNN
+from ..utils.vector_metadata import VectorColumnMetadata, VectorMetadata
+
+
+class FillMissingWithMean(UnaryEstimator):
+    """Real -> RealNN with train-mean imputation."""
+
+    input_types = (OPNumeric,)
+    output_type = RealNN
+
+    default_value = Param(default=0.0, doc="fill when the training column is all-empty")
+
+    def fit_columns(self, cols, dataset):
+        v = cols[0].values_f64()
+        ok = ~np.isnan(v)
+        mean = float(v[ok].mean()) if ok.any() else float(self.default_value)
+        return FillMissingWithMeanModel(mean=mean)
+
+
+class FillMissingWithMeanModel(UnaryTransformer):
+    input_types = (OPNumeric,)
+    output_type = RealNN
+
+    def __init__(self, mean: float, **kw):
+        super().__init__(**kw)
+        self.mean = mean
+
+    def transform_columns(self, cols, dataset):
+        v = cols[0].values_f64()
+        filled = np.where(np.isnan(v), self.mean, v)
+        return Column(RealNN, filled, np.ones(len(filled), dtype=np.bool_))
+
+
+class StandardScaler(UnaryEstimator):
+    """z-normalization (reference OpScalarStandardScaler)."""
+
+    input_types = (RealNN,)
+    output_type = RealNN
+
+    with_mean = Param(default=True)
+    with_std = Param(default=True)
+
+    def fit_columns(self, cols, dataset):
+        v = cols[0].data.astype(np.float64)
+        mean = float(v.mean()) if self.with_mean else 0.0
+        std = float(v.std())
+        if not self.with_std or std < 1e-12:
+            std = 1.0
+        return StandardScalerModel(mean=mean, std=std)
+
+
+class StandardScalerModel(UnaryTransformer):
+    input_types = (RealNN,)
+    output_type = RealNN
+
+    def __init__(self, mean: float, std: float, **kw):
+        super().__init__(**kw)
+        self.mean = mean
+        self.std = std
+
+    def transform_columns(self, cols, dataset):
+        v = (cols[0].data.astype(np.float64) - self.mean) / self.std
+        return Column(RealNN, v, np.ones(len(v), dtype=np.bool_))
+
+
+class NumericBucketizer(UnaryTransformer):
+    """Fixed-split bucketizer -> one-hot OPVector (reference NumericBucketizer.scala)."""
+
+    input_types = (OPNumeric,)
+    output_type = OPVector
+
+    splits = Param(default=(-np.inf, 0.0, np.inf))
+    track_nulls = Param(default=True)
+    track_invalid = Param(default=False)
+
+    def transform_columns(self, cols, dataset):
+        f = self.inputs[0]
+        splits = np.asarray(self.splits, dtype=np.float64)
+        v = cols[0].values_f64()
+        ok = ~np.isnan(v)
+        n = len(v)
+        n_buckets = len(splits) - 1
+        in_range = ok & (v >= splits[0]) & (v <= splits[-1])
+        invalid = ok & ~in_range
+        idx = np.clip(np.searchsorted(splits, np.nan_to_num(v), side="right") - 1,
+                      0, n_buckets - 1)
+        width = n_buckets + (1 if self.track_invalid else 0) \
+            + (1 if self.track_nulls else 0)
+        block = np.zeros((n, width), dtype=np.float32)
+        block[np.arange(n)[in_range], idx[in_range]] = 1.0
+        meta_cols = [
+            VectorColumnMetadata(f.name, f.ftype.__name__, grouping=f.name,
+                                 indicator_value=f"{splits[i]}-{splits[i + 1]}")
+            for i in range(n_buckets)
+        ]
+        col_at = n_buckets
+        if self.track_invalid:
+            block[invalid, col_at] = 1.0
+            meta_cols.append(VectorColumnMetadata(
+                f.name, f.ftype.__name__, grouping=f.name,
+                indicator_value="OutOfBounds"))
+            col_at += 1
+        else:
+            # out-of-range values land in the nearest edge bucket
+            block[np.arange(n)[invalid], idx[invalid]] = 1.0
+        if self.track_nulls:
+            block[~ok, col_at] = 1.0
+            from ..utils.vector_metadata import NULL_INDICATOR
+
+            meta_cols.append(VectorColumnMetadata(
+                f.name, f.ftype.__name__, grouping=f.name,
+                indicator_value=NULL_INDICATOR))
+        meta = VectorMetadata(self.output_name, meta_cols,
+                              {f.name: f.history().to_dict()}).reindexed()
+        return Column.vector(block, meta)
+
+
+class PercentileCalibrator(UnaryEstimator):
+    """Map a score into [0, 99] percentile buckets (reference PercentileCalibrator)."""
+
+    input_types = (RealNN,)
+    output_type = RealNN
+
+    buckets = Param(default=100)
+
+    def fit_columns(self, cols, dataset):
+        v = cols[0].data.astype(np.float64)
+        qs = np.quantile(v, np.linspace(0, 1, self.buckets + 1))
+        return PercentileCalibratorModel(splits=qs)
+
+
+class PercentileCalibratorModel(UnaryTransformer):
+    input_types = (RealNN,)
+    output_type = RealNN
+
+    def __init__(self, splits: np.ndarray, **kw):
+        super().__init__(**kw)
+        self.splits = np.asarray(splits, dtype=np.float64)
+
+    def transform_columns(self, cols, dataset):
+        v = cols[0].data.astype(np.float64)
+        idx = np.clip(np.searchsorted(self.splits[1:-1], v, side="right"),
+                      0, len(self.splits) - 2)
+        return Column(RealNN, idx.astype(np.float64),
+                      np.ones(len(v), dtype=np.bool_))

@@ -1,0 +1,69 @@
+"""Hashing vectorizer for text lists (token lists).
+
+Reference: core/.../feature/OPCollectionHashingVectorizer.scala:1-398 — hashing trick over
+list elements, shared-vs-separate hash space strategy, null tracking.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..data.dataset import Column
+from ..stages.base import Param, SequenceTransformer
+from ..types import OPVector, TextList
+from ..utils.hashing import hash_to_bucket
+from ..utils.vector_metadata import NULL_INDICATOR, VectorColumnMetadata, VectorMetadata
+
+NUM_HASHES_DEFAULT = 512
+
+
+class TextListHashingVectorizer(SequenceTransformer):
+    sequence_input_type = TextList
+    output_type = OPVector
+
+    num_hashes = Param(default=NUM_HASHES_DEFAULT)
+    shared_hash_space = Param(default=False)
+    track_nulls = Param(default=True)
+
+    def transform_columns(self, cols: List[Column], dataset):
+        n = len(cols[0])
+        width = self.num_hashes
+        blocks: List[np.ndarray] = []
+        meta_cols: List[VectorColumnMetadata] = []
+        if self.shared_hash_space:
+            block = np.zeros((n, width), dtype=np.float32)
+            for col in cols:
+                for i, toks in enumerate(col.data):
+                    for tok in toks or ():
+                        block[i, hash_to_bucket(tok, width)] += 1.0
+            blocks.append(block)
+            f0 = self.inputs[0]
+            for b in range(width):
+                meta_cols.append(VectorColumnMetadata(
+                    f0.name, f0.ftype.__name__, grouping="shared",
+                    descriptor_value=f"hash_{b}"))
+        else:
+            for f, col in zip(self.inputs, cols):
+                block = np.zeros((n, width), dtype=np.float32)
+                for i, toks in enumerate(col.data):
+                    for tok in toks or ():
+                        block[i, hash_to_bucket(tok, width)] += 1.0
+                blocks.append(block)
+                for b in range(width):
+                    meta_cols.append(VectorColumnMetadata(
+                        f.name, f.ftype.__name__, grouping=f.name,
+                        descriptor_value=f"hash_{b}"))
+        if self.track_nulls:
+            for f, col in zip(self.inputs, cols):
+                nulls = np.array([0.0 if t else 1.0 for t in col.data], dtype=np.float32)
+                blocks.append(nulls[:, None])
+                meta_cols.append(VectorColumnMetadata(
+                    f.name, f.ftype.__name__, grouping=f.name,
+                    indicator_value=NULL_INDICATOR))
+        meta = VectorMetadata(
+            self.output_name, meta_cols,
+            {f.name: f.history().to_dict() for f in self.inputs},
+        ).reindexed()
+        return Column.vector(np.hstack(blocks), meta)

@@ -1,0 +1,163 @@
+"""SmartTextVectorizer — automatic categorical-vs-free-text decision per feature.
+
+Reference: core/.../feature/SmartTextVectorizer.scala:61-196 (decision :92-106): one pass
+computes per-feature TextStats (capped value counts); features with at most
+``max_cardinality`` distinct values pivot as categoricals (top-K one-hot), the rest
+tokenize + hash (hashing trick, murmur3) into ``num_hashes`` buckets, with text-length and
+null-indicator tracking.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..data.dataset import Column
+from ..stages.base import Param, SequenceEstimator, Transformer
+from ..types import OPVector, Text
+from ..utils.hashing import hash_to_bucket
+from ..utils.text import tokenize
+from ..utils.vector_metadata import (
+    NULL_INDICATOR,
+    OTHER_INDICATOR,
+    VectorColumnMetadata,
+    VectorMetadata,
+)
+from .onehot import clean_text_value
+
+MAX_CARDINALITY_DEFAULT = 30   # SmartTextVectorizer maxCardinality
+NUM_HASHES_DEFAULT = 512       # Transmogrifier DefaultNumOfFeatures
+TOP_K_DEFAULT = 20
+MIN_SUPPORT_DEFAULT = 10
+
+
+class TextStats:
+    """Capped value-count statistics for one text feature (one fit pass)."""
+
+    __slots__ = ("value_counts", "cardinality_capped")
+
+    def __init__(self, cap: int = 1000):
+        self.value_counts: Counter = Counter()
+        self.cardinality_capped = cap
+
+    def update(self, value: Optional[str]) -> None:
+        if value:
+            if len(self.value_counts) < self.cardinality_capped or value in self.value_counts:
+                self.value_counts[value] += 1
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.value_counts)
+
+
+class SmartTextVectorizer(SequenceEstimator):
+    sequence_input_type = Text
+    output_type = OPVector
+
+    max_cardinality = Param(default=MAX_CARDINALITY_DEFAULT)
+    num_hashes = Param(default=NUM_HASHES_DEFAULT)
+    top_k = Param(default=TOP_K_DEFAULT)
+    min_support = Param(default=MIN_SUPPORT_DEFAULT)
+    clean_text = Param(default=True)
+    track_nulls = Param(default=True)
+    track_text_len = Param(default=False)
+
+    def fit_columns(self, cols, dataset):
+        is_categorical: List[bool] = []
+        vocabs: List[List[str]] = []
+        for col in cols:
+            stats = TextStats()
+            for v in col.data:
+                if v:
+                    stats.update(clean_text_value(v) if self.clean_text else v)
+            if 0 < stats.cardinality <= self.max_cardinality:
+                is_categorical.append(True)
+                kept = [v for v, c in stats.value_counts.items() if c >= self.min_support]
+                kept = sorted(kept, key=lambda v: (-stats.value_counts[v], v))[: self.top_k]
+                vocabs.append(kept)
+            else:
+                is_categorical.append(False)
+                vocabs.append([])
+        return SmartTextVectorizerModel(
+            is_categorical=is_categorical,
+            vocabs=vocabs,
+            num_hashes=self.num_hashes,
+            clean_text=self.clean_text,
+            track_nulls=self.track_nulls,
+            track_text_len=self.track_text_len,
+        )
+
+
+class SmartTextVectorizerModel(Transformer):
+    sequence_input_type = Text
+    output_type = OPVector
+
+    def __init__(self, is_categorical: List[bool], vocabs: List[List[str]],
+                 num_hashes: int = NUM_HASHES_DEFAULT, clean_text: bool = True,
+                 track_nulls: bool = True, track_text_len: bool = False, **kw):
+        super().__init__(**kw)
+        self.is_categorical = is_categorical
+        self.vocabs = vocabs
+        self.num_hashes = num_hashes
+        self.clean_text = clean_text
+        self.track_nulls = track_nulls
+        self.track_text_len = track_text_len
+
+    def transform_columns(self, cols, dataset):
+        n = len(cols[0])
+        blocks: List[np.ndarray] = []
+        meta_cols: List[VectorColumnMetadata] = []
+        for f, col, cat, vocab in zip(self.inputs, cols, self.is_categorical, self.vocabs):
+            tname = f.ftype.__name__
+            if cat:
+                k = len(vocab)
+                width = k + 1 + (1 if self.track_nulls else 0)
+                block = np.zeros((n, width), dtype=np.float32)
+                index: Dict[str, int] = {v: i for i, v in enumerate(vocab)}
+                for i, v in enumerate(col.data):
+                    if not v:
+                        if self.track_nulls:
+                            block[i, k + 1] = 1.0
+                        continue
+                    key = clean_text_value(v) if self.clean_text else v
+                    j = index.get(key)
+                    block[i, j if j is not None else k] = 1.0
+                for level in vocab:
+                    meta_cols.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
+                                                          indicator_value=level))
+                meta_cols.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
+                                                      indicator_value=OTHER_INDICATOR))
+                if self.track_nulls:
+                    meta_cols.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
+                                                          indicator_value=NULL_INDICATOR))
+            else:
+                width = self.num_hashes
+                block = np.zeros((n, width), dtype=np.float32)
+                for i, v in enumerate(col.data):
+                    for tok in tokenize(v):
+                        block[i, hash_to_bucket(tok, width)] += 1.0
+                for b in range(width):
+                    meta_cols.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
+                                                          descriptor_value=f"hash_{b}"))
+                extras = []
+                if self.track_text_len:
+                    lens = np.array([float(len(v)) if v else 0.0 for v in col.data],
+                                    dtype=np.float32)
+                    extras.append(lens[:, None])
+                    meta_cols.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
+                                                          descriptor_value="textLen"))
+                if self.track_nulls:
+                    nulls = np.array([0.0 if v else 1.0 for v in col.data], dtype=np.float32)
+                    extras.append(nulls[:, None])
+                    meta_cols.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
+                                                          indicator_value=NULL_INDICATOR))
+                if extras:
+                    block = np.hstack([block] + extras)
+            blocks.append(block)
+        meta = VectorMetadata(
+            self.output_name, meta_cols,
+            {f.name: f.history().to_dict() for f in self.inputs},
+        ).reindexed()
+        return Column.vector(np.hstack(blocks), meta)

@@ -1,0 +1,131 @@
+"""Transmogrifier — automatic per-type default vectorization.
+
+Reference: core/.../feature/Transmogrifier.scala:92-330 (defaults :52-90: TopK=20,
+MinSupport=10, 512 hash features, null tracking on, MurMur3).  ``transmogrify(features)``
+groups features by type family, applies each family's default vectorizer, and combines
+everything into a single OPVector feature via VectorsCombiner.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Type
+
+from ..features.feature import Feature
+from ..types import (
+    Base64,
+    Binary,
+    City,
+    ComboBox,
+    Country,
+    Date,
+    Email,
+    FeatureType,
+    Geolocation,
+    ID,
+    Integral,
+    MultiPickList,
+    OPVector,
+    Phone,
+    PickList,
+    PostalCode,
+    Real,
+    RealNN,
+    State,
+    Street,
+    Text,
+    TextArea,
+    TextList,
+    URL,
+)
+from .combiner import VectorsCombiner
+from .dates import DateToUnitCircleVectorizer
+from .geo import GeolocationVectorizer
+from .numeric import BinaryVectorizer, NumericVectorizer, RealNNVectorizer
+from .onehot import MultiPickListVectorizer, OneHotVectorizer
+from .text_lists import TextListHashingVectorizer
+from .text_smart import SmartTextVectorizer
+
+# categorical text subtypes pivot directly (reference: pivot-by-default types)
+_CATEGORICAL_TEXT = (PickList, ComboBox, Country, State, City, PostalCode, Street)
+# free-form text subtypes go through the smart categorical-vs-text decision
+_SMART_TEXT = (TextArea, Email, URL, Phone, ID, Base64)
+
+
+def _family(ftype: Type[FeatureType]) -> str:
+    if issubclass(ftype, RealNN):
+        return "realnn"
+    if issubclass(ftype, Binary):
+        return "binary"
+    if issubclass(ftype, Date):
+        return "date"
+    if issubclass(ftype, Integral):
+        return "integral"
+    if issubclass(ftype, Real):
+        return "real"
+    if issubclass(ftype, _CATEGORICAL_TEXT):
+        return "categorical_text"
+    if issubclass(ftype, _SMART_TEXT) or ftype is Text:
+        return "smart_text"
+    if issubclass(ftype, MultiPickList):
+        return "multipicklist"
+    if issubclass(ftype, Geolocation):
+        return "geolocation"
+    if issubclass(ftype, TextList):
+        return "text_list"
+    if issubclass(ftype, OPVector):
+        return "vector"
+    from ..types import OPMap
+
+    if issubclass(ftype, OPMap):
+        return "map"
+    raise NotImplementedError(
+        f"Transmogrifier has no default vectorizer for {ftype.__name__} yet"
+    )
+
+
+def transmogrify(features: Sequence[Feature], label: Feature | None = None,
+                 combiner_name: str = "features") -> Feature:
+    """Apply per-type default vectorization and combine into one OPVector feature."""
+    groups: Dict[str, List[Feature]] = {}
+    for f in features:
+        groups.setdefault(_family(f.ftype), []).append(f)
+
+    vectors: List[Feature] = []
+    for family in sorted(groups):
+        feats = groups[family]
+        if family == "realnn":
+            stage = RealNNVectorizer()
+        elif family == "real":
+            stage = NumericVectorizer(fill_strategy="mean")
+        elif family == "integral":
+            stage = NumericVectorizer(fill_strategy="mode")
+        elif family == "binary":
+            stage = BinaryVectorizer()
+        elif family == "date":
+            stage = DateToUnitCircleVectorizer()
+        elif family == "categorical_text":
+            stage = OneHotVectorizer()
+        elif family == "smart_text":
+            stage = SmartTextVectorizer()
+        elif family == "multipicklist":
+            stage = MultiPickListVectorizer()
+        elif family == "geolocation":
+            stage = GeolocationVectorizer()
+        elif family == "text_list":
+            stage = TextListHashingVectorizer()
+        elif family == "vector":
+            vectors.extend(feats)
+            continue
+        elif family == "map":
+            from .maps import transmogrify_maps
+
+            vectors.extend(transmogrify_maps(feats))
+            continue
+        else:  # pragma: no cover
+            raise NotImplementedError(family)
+        vectors.append(feats[0].transform_with(stage, *feats[1:]))
+
+    if len(vectors) == 1:
+        return vectors[0]
+    combiner = VectorsCombiner(operation_name=combiner_name)
+    return vectors[0].transform_with(combiner, *vectors[1:])

@@ -1,0 +1,83 @@
+"""Device mesh + sharding helpers — the distributed substrate.
+
+Replaces the reference's Spark driver/executor row-partitioning (SURVEY §5.8): rows are
+sharded over the ``data`` mesh axis; model-selection sweeps shard the (fold × grid) batch
+over the ``model`` axis.  XLA inserts the ICI/DCN collectives (psum for statistics,
+histograms, gradients) under ``jit`` from sharding annotations — we never hand-write
+NCCL-style calls.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def make_mesh(
+    n_data: Optional[int] = None,
+    n_model: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A (data, model) mesh.  Default: all devices on the data axis."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    total = devs.size
+    if n_data is None:
+        n_data = total // n_model
+    if n_data * n_model != total:
+        raise ValueError(f"mesh {n_data}x{n_model} != {total} devices")
+    return Mesh(devs.reshape(n_data, n_model), (DATA_AXIS, MODEL_AXIS))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (row) axis over the data axis, replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def model_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (model/grid) axis over the model axis."""
+    return NamedSharding(mesh, P(MODEL_AXIS))
+
+
+def pad_rows(arr: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:
+    """Pad rows to a multiple (sharding requires even splits); returns (padded, n_valid)."""
+    n = arr.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr, n
+    pad_width = [(0, rem)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad_width), n
+
+
+def shard_rows(arr: np.ndarray, mesh: Optional[Mesh] = None):
+    """Place an array on device with its rows sharded over the data axis.
+
+    Pads rows to the data-axis size; returns (device_array, n_valid_rows).  Callers mask
+    with ``row_mask(n_padded, n_valid)`` so padded rows never contaminate statistics.
+    """
+    if mesh is None:
+        return jax.numpy.asarray(arr), arr.shape[0]
+    n_data = mesh.shape[DATA_AXIS]
+    padded, n_valid = pad_rows(np.asarray(arr), n_data)
+    out = jax.device_put(padded, row_sharding(mesh))
+    return out, n_valid
+
+
+def row_mask(n_padded: int, n_valid: int):
+    import jax.numpy as jnp
+
+    return (jnp.arange(n_padded) < n_valid).astype(jnp.float32)

@@ -1,0 +1,195 @@
+"""Readers — data ingestion producing columnar Datasets from raw features.
+
+Reference: readers/.../Reader.scala, DataReader.scala:57-198 (generateDataFrame :173-188),
+CSVReaders.scala, ParquetProductReader.scala, DataReaders.scala factory.
+
+TPU-first: ingestion is columnar (pandas/pyarrow -> numpy blocks) with a fast path when a
+feature's extract function is a named-field read; the per-record extract path exists for
+arbitrary extract functions (reference parity), and aggregate/conditional readers apply
+monoid event aggregation with cutoff semantics (SURVEY §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..aggregators.monoid import CutOffTime, Event, aggregate_events
+from ..data.dataset import Column, Dataset
+from ..features.feature import Feature, _NamedExtract
+from ..features.generator import FeatureGeneratorStage
+
+
+def _generators(raw_features: Sequence[Feature]) -> List[FeatureGeneratorStage]:
+    gens = []
+    for f in raw_features:
+        st = f.origin_stage
+        if not isinstance(st, FeatureGeneratorStage):
+            raise ValueError(f"Feature {f.name!r} is not a raw feature")
+        gens.append(st)
+    return gens
+
+
+class Reader:
+    """Base reader: produce a Dataset containing one column per raw feature."""
+
+    def __init__(self, key_fn: Optional[Callable[[Any], str]] = None):
+        self.key_fn = key_fn
+
+    def read_records(self) -> Iterable[Any]:
+        """Yield raw records (dict-like).  Implemented by subclasses."""
+        raise NotImplementedError
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        records = list(self.read_records())
+        return rows_to_dataset(records, raw_features)
+
+
+def rows_to_dataset(records: Sequence[Any], raw_features: Sequence[Feature]) -> Dataset:
+    """Run every raw feature's extract over the records (DataReader.generateRow path)."""
+    gens = _generators(raw_features)
+    cols: Dict[str, Column] = {}
+    for f, g in zip(raw_features, gens):
+        values = [g.extract(r).value for r in records]
+        cols[f.name] = Column.from_values(g.ftype, values)
+    return Dataset(cols)
+
+
+class DataFrameReader(Reader):
+    """Columnar fast path over a pandas DataFrame (named-field extracts read directly)."""
+
+    def __init__(self, df, key_fn=None):
+        super().__init__(key_fn)
+        self.df = df
+
+    def read_records(self):
+        return self.df.to_dict("records")
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        from ..features.builder import _clean_series
+
+        gens = _generators(raw_features)
+        missing = [g.extract_fn.key for g in gens
+                   if isinstance(g.extract_fn, _NamedExtract)
+                   and g.extract_fn.key not in self.df.columns]
+        if missing:
+            raise KeyError(
+                f"DataFrame is missing columns for raw features: {sorted(missing)}; "
+                f"available: {sorted(self.df.columns)}")
+        cols: Dict[str, Column] = {}
+        slow: List[int] = []
+        for i, (f, g) in enumerate(zip(raw_features, gens)):
+            fn = g.extract_fn
+            if isinstance(fn, _NamedExtract):
+                cols[f.name] = Column.from_values(
+                    g.ftype, _clean_series(self.df[fn.key], g.ftype))
+            else:
+                slow.append(i)
+        if slow:
+            records = self.read_records()
+            for i in slow:
+                f, g = raw_features[i], gens[i]
+                cols[f.name] = Column.from_values(
+                    g.ftype, [g.extract(r).value for r in records])
+        return Dataset(cols)
+
+
+class CustomReader(Reader):
+    """Wrap any record-producing function (reference CustomReader)."""
+
+    def __init__(self, fn: Callable[[], Iterable[Any]], key_fn=None):
+        super().__init__(key_fn)
+        self.fn = fn
+
+    def read_records(self):
+        return self.fn()
+
+
+class AggregateReader(Reader):
+    """Group records by key and monoid-aggregate each feature's events.
+
+    Reference: AggregatedReader/AggregateDataReader (DataReader.scala:206-280) —
+    the label-leakage-safe temporal aggregation: predictors fold events strictly before
+    the cutoff, responses at/after it.
+    """
+
+    def __init__(self, inner: Reader, key_fn: Callable[[Any], str],
+                 time_fn: Callable[[Any], int],
+                 cutoff: CutOffTime = CutOffTime.no_cutoff()):
+        super().__init__(key_fn)
+        self.inner = inner
+        self.time_fn = time_fn
+        self.cutoff = cutoff
+
+    def read_records(self):
+        return self.inner.read_records()
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        gens = _generators(raw_features)
+        by_key: Dict[str, List[Any]] = {}
+        for r in self.read_records():
+            by_key.setdefault(self.key_fn(r), []).append(r)
+        keys = sorted(by_key)
+        cols: Dict[str, Column] = {}
+        for f, g in zip(raw_features, gens):
+            values = []
+            for k in keys:
+                recs = by_key[k]
+                cutoff_ms = self.cutoff.cutoff_for(recs[0])
+                events = [Event(self.time_fn(r), g.extract(r).value,
+                                g.is_response) for r in recs]
+                values.append(aggregate_events(
+                    g.ftype, events,
+                    aggregator=g.aggregator,
+                    is_response=g.is_response,
+                    cutoff_ms=cutoff_ms,
+                    window_ms=g.aggregate_window_ms,
+                ))
+            cols[f.name] = Column.from_values(g.ftype, values)
+        out = Dataset(cols)
+        return out
+
+
+class ConditionalReader(AggregateReader):
+    """Per-key cutoff defined by a predicate event (e.g. 'first purchase').
+
+    Reference: ConditionalDataReader — records matching ``condition_fn`` define the key's
+    cutoff time; keys with no matching event are dropped.
+    """
+
+    def __init__(self, inner: Reader, key_fn, time_fn,
+                 condition_fn: Callable[[Any], bool], drop_if_no_condition: bool = True):
+        super().__init__(inner, key_fn, time_fn)
+        self.condition_fn = condition_fn
+        self.drop_if_no_condition = drop_if_no_condition
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        gens = _generators(raw_features)
+        by_key: Dict[str, List[Any]] = {}
+        for r in self.read_records():
+            by_key.setdefault(self.key_fn(r), []).append(r)
+        cols_values: Dict[str, List[Any]] = {f.name: [] for f in raw_features}
+        for k in sorted(by_key):
+            recs = by_key[k]
+            times = [self.time_fn(r) for r in recs if self.condition_fn(r)]
+            if not times:
+                if self.drop_if_no_condition:
+                    continue
+                cutoff_ms = None
+            else:
+                cutoff_ms = min(times)
+            for f, g in zip(raw_features, gens):
+                events = [Event(self.time_fn(r), g.extract(r).value, g.is_response)
+                          for r in recs]
+                cols_values[f.name].append(aggregate_events(
+                    g.ftype, events,
+                    aggregator=g.aggregator,
+                    is_response=g.is_response,
+                    cutoff_ms=cutoff_ms,
+                    window_ms=g.aggregate_window_ms,
+                ))
+        return Dataset({
+            f.name: Column.from_values(g.ftype, cols_values[f.name])
+            for f, g in zip(raw_features, gens)
+        })

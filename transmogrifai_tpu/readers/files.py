@@ -1,0 +1,135 @@
+"""File-format readers: CSV (with/without schema inference), Parquet, Avro, JSON-lines.
+
+Reference: readers/.../CSVReaders.scala, CSVAutoReaders.scala:1-142,
+ParquetProductReader.scala:1-90, AvroReaders.scala:1-134, DataReaders.scala:44-278.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..data.dataset import Dataset
+from ..features.feature import Feature
+from .base import DataFrameReader, Reader
+
+
+class CSVReader(DataFrameReader):
+    """CSV -> columnar dataset.  ``headers=None`` auto-infers (CSVAutoReader)."""
+
+    def __init__(self, path: str, headers: Optional[Sequence[str]] = None,
+                 key_fn=None, **pandas_kwargs):
+        import pandas as pd
+
+        if headers is not None:
+            df = pd.read_csv(path, header=None, names=list(headers), **pandas_kwargs)
+        else:
+            df = pd.read_csv(path, **pandas_kwargs)
+        super().__init__(df, key_fn)
+        self.path = path
+
+
+class ParquetReader(DataFrameReader):
+    def __init__(self, path: str, key_fn=None):
+        import pandas as pd
+
+        super().__init__(pd.read_parquet(path), key_fn)
+        self.path = path
+
+
+class JsonLinesReader(DataFrameReader):
+    def __init__(self, path: str, key_fn=None):
+        import pandas as pd
+
+        super().__init__(pd.read_json(path, lines=True), key_fn)
+        self.path = path
+
+
+class AvroReader(Reader):
+    """Avro container files.  Gated: needs ``fastavro`` (not in the base image)."""
+
+    def __init__(self, path: str, key_fn=None):
+        super().__init__(key_fn)
+        self.path = path
+
+    def read_records(self):
+        try:
+            import fastavro
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                "Avro reading requires the optional 'fastavro' package"
+            ) from e
+        with open(self.path, "rb") as fh:  # pragma: no cover
+            yield from fastavro.reader(fh)
+
+
+class StreamingReader:
+    """Micro-batch scoring source (reference StreamingReaders, DStream equivalent).
+
+    Wraps an iterator of record batches; each batch becomes a Dataset for scoring.
+    """
+
+    def __init__(self, batches):
+        self.batches = batches
+
+    def stream_datasets(self, raw_features: Sequence[Feature]):
+        from .base import rows_to_dataset
+
+        for batch in self.batches:
+            if isinstance(batch, Dataset):
+                yield batch
+            else:
+                yield rows_to_dataset(list(batch), raw_features)
+
+
+class DataReaders:
+    """Factory mirroring reference ``DataReaders.Simple/Aggregate/Conditional``."""
+
+    class Simple:
+        @staticmethod
+        def csv(path: str, headers: Optional[Sequence[str]] = None, key_fn=None,
+                **kw) -> CSVReader:
+            return CSVReader(path, headers=headers, key_fn=key_fn, **kw)
+
+        @staticmethod
+        def csv_auto(path: str, key_fn=None, **kw) -> CSVReader:
+            return CSVReader(path, headers=None, key_fn=key_fn, **kw)
+
+        @staticmethod
+        def parquet(path: str, key_fn=None) -> ParquetReader:
+            return ParquetReader(path, key_fn=key_fn)
+
+        @staticmethod
+        def avro(path: str, key_fn=None) -> AvroReader:
+            return AvroReader(path, key_fn=key_fn)
+
+        @staticmethod
+        def json_lines(path: str, key_fn=None) -> JsonLinesReader:
+            return JsonLinesReader(path, key_fn=key_fn)
+
+        @staticmethod
+        def dataframe(df, key_fn=None) -> DataFrameReader:
+            return DataFrameReader(df, key_fn=key_fn)
+
+    class Aggregate:
+        @staticmethod
+        def csv(path: str, key_fn, time_fn, cutoff, headers=None, **kw):
+            from .base import AggregateReader
+
+            return AggregateReader(CSVReader(path, headers=headers, **kw),
+                                   key_fn=key_fn, time_fn=time_fn, cutoff=cutoff)
+
+        @staticmethod
+        def dataframe(df, key_fn, time_fn, cutoff):
+            from .base import AggregateReader, DataFrameReader
+
+            return AggregateReader(DataFrameReader(df), key_fn=key_fn,
+                                   time_fn=time_fn, cutoff=cutoff)
+
+    class Conditional:
+        @staticmethod
+        def dataframe(df, key_fn, time_fn, condition_fn, drop_if_no_condition=True):
+            from .base import ConditionalReader, DataFrameReader
+
+            return ConditionalReader(DataFrameReader(df), key_fn=key_fn, time_fn=time_fn,
+                                     condition_fn=condition_fn,
+                                     drop_if_no_condition=drop_if_no_condition)

@@ -57,8 +57,17 @@ class Param:
         obj._param_values[self.name] = value
 
 
+#: class-name -> class registry for serde (reference OpPipelineStageReader's reflective
+#: loading, re-designed as an explicit registry populated by __init_subclass__)
+STAGE_REGISTRY: Dict[str, type] = {}
+
+
 class PipelineStage:
     """Base of all stages (OpPipelineStageBase equivalent)."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        STAGE_REGISTRY[cls.__name__] = cls
 
     # --- class-level schema -------------------------------------------------
     #: expected input feature types, one per input (fixed-arity stages)

@@ -1,0 +1,62 @@
+"""Serializable-function registry.
+
+Reference: FeatureGeneratorStage serde stores the extract lambda's *class name* and
+re-instantiates it on load (FeatureGeneratorStage.scala:129-210) — possible because Scala
+lambdas are classes.  Python equivalent: functions serialize either by an explicit
+registered name (``@register_function("age_group")``) or by importable module path;
+closures/lambdas are rejected at save time with an actionable error.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Dict, Optional
+
+FN_REGISTRY: Dict[str, Callable] = {}
+_FN_NAMES: Dict[int, str] = {}
+
+
+def register_function(name: str):
+    """Decorator: make a function serializable under a stable name."""
+
+    def deco(fn: Callable) -> Callable:
+        FN_REGISTRY[name] = fn
+        _FN_NAMES[id(fn)] = name
+        return fn
+
+    return deco
+
+
+def encode_function(fn: Callable) -> Optional[dict]:
+    """Serializable descriptor for ``fn``, or None if it cannot round-trip."""
+    name = _FN_NAMES.get(id(fn))
+    if name is not None:
+        return {"__registered_fn__": name}
+    mod = getattr(fn, "__module__", None)
+    qual = getattr(fn, "__qualname__", "")
+    if mod and qual and "<" not in qual:
+        try:
+            m = importlib.import_module(mod)
+            obj = m
+            for part in qual.split("."):
+                obj = getattr(obj, part)
+            if obj is fn:
+                return {"__imported_fn__": f"{mod}:{qual}"}
+        except Exception:
+            pass
+    return None
+
+
+def decode_function(desc: dict) -> Callable:
+    if "__registered_fn__" in desc:
+        name = desc["__registered_fn__"]
+        if name not in FN_REGISTRY:
+            raise ValueError(
+                f"Function {name!r} is not registered; import the module that calls "
+                f"register_function({name!r}) before loading this model")
+        return FN_REGISTRY[name]
+    mod, _, qual = desc["__imported_fn__"].partition(":")
+    obj = importlib.import_module(mod)
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    return obj

@@ -18,6 +18,17 @@ import enum
 from typing import Any, ClassVar, Dict, Optional, Type
 
 
+def _freeze(v: Any):
+    """Recursively convert a value to a hashable form (lists/sets/dicts frozen)."""
+    if isinstance(v, (set, frozenset)):
+        return frozenset(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    return v
+
+
 class ColumnKind(enum.Enum):
     """Physical storage class of a column of a given FeatureType.
 
@@ -98,14 +109,7 @@ class FeatureType:
         return type(self) is type(other) and self._value == other._value
 
     def __hash__(self) -> int:
-        v = self._value
-        if isinstance(v, (set, frozenset)):
-            v = frozenset(v)
-        elif isinstance(v, dict):
-            v = tuple(sorted(v.items(), key=lambda kv: kv[0]))
-        elif isinstance(v, list):
-            v = tuple(v)
-        return hash((type(self).__name__, v))
+        return hash((type(self).__name__, _freeze(self._value)))
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self._value!r})"

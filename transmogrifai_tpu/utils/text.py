@@ -1,0 +1,48 @@
+"""Host-side text utilities: tokenization, language heuristics.
+
+Reference: utils/text stack — LuceneTextAnalyzer (core/.../utils/text/LuceneTextAnalyzer.scala),
+TextTokenizer (core/.../feature/TextTokenizer.scala:1-260).  Re-designed as simple
+vectorizable host functions: strings never reach the device; tokenizers emit integer
+bucket ids / count blocks that do.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence
+
+_TOKEN_RE = re.compile(r"[^\W\d_]+|\d+", re.UNICODE)
+
+# minimal English stop set (reference uses Lucene per-language analyzers)
+STOP_WORDS = frozenset(
+    """a an and are as at be but by for if in into is it no not of on or such that the
+    their then there these they this to was will with""".split()
+)
+
+MIN_TOKEN_LENGTH = 1
+
+
+def tokenize(
+    text: Optional[str],
+    to_lowercase: bool = True,
+    min_token_length: int = MIN_TOKEN_LENGTH,
+    remove_stop_words: bool = False,
+) -> List[str]:
+    """Analyze a string into tokens (Lucene-standard-analyzer-like behavior)."""
+    if not text:
+        return []
+    if to_lowercase:
+        text = text.lower()
+    tokens = _TOKEN_RE.findall(text)
+    if min_token_length > 1:
+        tokens = [t for t in tokens if len(t) >= min_token_length]
+    if remove_stop_words:
+        tokens = [t for t in tokens if t not in STOP_WORDS]
+    return tokens
+
+
+def ngrams(tokens: Sequence[str], n: int = 2) -> List[str]:
+    """Word n-grams (reference OpNGram)."""
+    if n <= 1:
+        return list(tokens)
+    return [" ".join(tokens[i:i + n]) for i in range(len(tokens) - n + 1)]

@@ -1,0 +1,68 @@
+"""Layer-wise DAG fitting/transform — the scheduler core.
+
+Reference: core/.../utils/stages/FitStagesUtil.scala:51-372 (fitAndTransformDAG :213-240,
+fitAndTransformLayer :254, applyOpTransformations :96-119).
+
+Estimators fit per layer, then the layer's transforms apply.  Columnar transforms are
+already whole-column vectorized; device stages produce jnp-ready blocks (fusing a layer's
+numeric transforms into one jitted program is a planned optimization on this seam).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+from ..data.dataset import Dataset
+from ..features.feature import Feature
+from ..stages.base import Estimator, PipelineStage, Transformer
+from .dag import compute_dag
+
+
+def fit_dag(
+    dataset: Dataset,
+    result_features: Sequence[Feature],
+    fitted: Dict[str, Transformer] | None = None,
+) -> Tuple[Dataset, Dict[str, Transformer]]:
+    """Fit every estimator and apply every transformer, layer by layer.
+
+    Returns (transformed dataset, {stage uid -> fitted transformer}).  Already-fitted
+    stages (uid present in ``fitted``) are reused, enabling warm-start stacking
+    (OpWorkflow.withModelStages :457-461).
+    """
+    fitted = dict(fitted or {})
+    for layer in compute_dag(result_features):
+        for stage in layer:
+            runner = _resolve(stage, fitted)
+            if runner is None:
+                model = stage.fit(dataset)
+                fitted[stage.uid] = model
+                runner = model
+            dataset = runner.transform(dataset)
+    return dataset, fitted
+
+
+def transform_dag(
+    dataset: Dataset,
+    result_features: Sequence[Feature],
+    fitted: Dict[str, Transformer],
+) -> Dataset:
+    """Scoring path: apply fitted transformers only (no fitting allowed)."""
+    for layer in compute_dag(result_features):
+        for stage in layer:
+            runner = _resolve(stage, fitted)
+            if runner is None:
+                raise ValueError(
+                    f"Stage {stage.uid} is an unfitted estimator; cannot score. "
+                    "Train the workflow first."
+                )
+            dataset = runner.transform(dataset)
+    return dataset
+
+
+def _resolve(stage: PipelineStage, fitted: Dict[str, Transformer]) -> Transformer | None:
+    if stage.uid in fitted:
+        return fitted[stage.uid]
+    if isinstance(stage, Estimator):
+        return None
+    assert isinstance(stage, Transformer)
+    return stage

@@ -1,0 +1,345 @@
+"""Model persistence: JSON manifest + numpy array store.
+
+Reference: core/.../OpWorkflowModelWriter.scala:53-205 (gzip JSON manifest: uid, features,
+stages+params, blacklist), OpWorkflowModelReader.scala (reflective stage reconstruction).
+
+Re-design: a ``model.json.gz`` manifest (features, stage states, fitted-model states) plus
+``arrays.npz`` for tensors.  Stages reconstruct through an explicit class registry
+(stages.base.STAGE_REGISTRY) — no reflection over constructors.  Estimator DAG nodes are
+saved as identity stubs only (uid + wiring): scoring resolves their fitted models by uid,
+so selector internals (grids, validators) never need to round-trip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import json
+import os
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ..features.feature import Feature, _NamedExtract
+from ..features.generator import FeatureGeneratorStage
+from ..stages.base import Estimator, PipelineStage, STAGE_REGISTRY, Transformer
+from ..types import feature_type_by_name
+from ..utils.vector_metadata import VectorMetadata
+from .dag import all_stages
+
+FORMAT_VERSION = 1
+
+_SKIP_ATTRS = {"_param_values", "_input_features", "_output_feature",
+               "operation_name", "uid"}
+
+
+class _Encoder:
+    def __init__(self):
+        self.arrays: Dict[str, np.ndarray] = {}
+        self._n = 0
+
+    def _store(self, arr: np.ndarray) -> dict:
+        key = f"a{self._n}"
+        self._n += 1
+        self.arrays[key] = arr
+        return {"__ndarray__": key}
+
+    def encode(self, v: Any) -> Any:
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return v
+        if isinstance(v, np.generic):
+            return v.item()
+        if isinstance(v, np.ndarray):
+            return self._store(v)
+        if isinstance(v, (list, tuple)):
+            return {"__list__": [self.encode(x) for x in v],
+                    "__tuple__": isinstance(v, tuple)}
+        if isinstance(v, set):
+            return {"__set__": [self.encode(x) for x in sorted(v)]}
+        if isinstance(v, dict):
+            return {"__dict__": [[self.encode(k), self.encode(x)] for k, x in v.items()]}
+        if isinstance(v, _NamedExtract):
+            return {"__named_extract__": v.key}
+        if isinstance(v, PipelineStage):
+            return {"__stage__": encode_stage(v, self, full=True)}
+        if isinstance(v, VectorMetadata):
+            return {"__vector_metadata__": v.to_dict()}
+        if dataclasses.is_dataclass(v) and not isinstance(v, type):
+            return {"__dataclass__": type(v).__name__,
+                    "data": self.encode(_dataclass_dict(v))}
+        if callable(v):
+            from ..stages.functions import encode_function
+
+            desc = encode_function(v)
+            if desc is not None:
+                return desc
+            return {"__unserializable__": repr(v)}
+        if hasattr(v, "to_dict"):
+            return {"__dataclass__": type(v).__name__, "data": self.encode(v.to_dict())}
+        return {"__unserializable__": repr(v)}
+
+
+def _dataclass_dict(v) -> dict:
+    return {f.name: getattr(v, f.name) for f in dataclasses.fields(v)}
+
+
+class _Decoder:
+    def __init__(self, arrays):
+        self.arrays = arrays
+
+    def decode(self, v: Any) -> Any:
+        if not isinstance(v, dict):
+            return v
+        if "__ndarray__" in v:
+            return self.arrays[v["__ndarray__"]]
+        if "__list__" in v:
+            items = [self.decode(x) for x in v["__list__"]]
+            return tuple(items) if v.get("__tuple__") else items
+        if "__set__" in v:
+            return {self.decode(x) for x in v["__set__"]}
+        if "__dict__" in v:
+            return {self.decode(k): self.decode(x) for k, x in v["__dict__"]}
+        if "__named_extract__" in v:
+            return _NamedExtract(v["__named_extract__"])
+        if "__stage__" in v:
+            return decode_stage(v["__stage__"], self)
+        if "__vector_metadata__" in v:
+            return VectorMetadata.from_dict(v["__vector_metadata__"])
+        if "__dataclass__" in v:
+            data = self.decode(v["data"])
+            return _restore_dataclass(v["__dataclass__"], data)
+        if "__registered_fn__" in v or "__imported_fn__" in v:
+            from ..stages.functions import decode_function
+
+            return decode_function(v)
+        if "__unserializable__" in v:
+            return None
+        return {k: self.decode(x) for k, x in v.items()}
+
+
+def _restore_dataclass(name: str, data: dict):
+    from ..models.selector import ModelSelectorSummary
+    from ..models.tuning import ModelEvaluation, PrepSummary
+
+    if name == "ModelSelectorSummary":
+        return ModelSelectorSummary(
+            validation_type=data.get("validation_type", "cv"),
+            validation_results=[
+                ModelEvaluation(**e) if isinstance(e, dict) and "model_name" in e else e
+                for e in data.get("validation_results", [])
+            ],
+            best_model_name=data.get("best_model_name", ""),
+            best_model_uid=data.get("best_model_uid", ""),
+            best_grid=data.get("best_grid", {}),
+            metric_name=data.get("metric_name", ""),
+            data_prep=data.get("data_prep"),
+            train_evaluation=data.get("train_evaluation", {}),
+            holdout_evaluation=data.get("holdout_evaluation", {}),
+        )
+    if name == "ModelEvaluation":
+        return ModelEvaluation(**data)
+    if name == "PrepSummary":
+        return PrepSummary(**data)
+    return data  # unknown summaries restore as plain dicts
+
+
+def encode_stage(stage: PipelineStage, enc: _Encoder, full: bool) -> dict:
+    out = {
+        "class": type(stage).__name__,
+        "uid": stage.uid,
+        "operationName": stage.operation_name,
+        "params": enc.encode(stage.get_params()),
+        "inputUids": [f.uid for f in stage.inputs],
+        "full": full,
+    }
+    if isinstance(stage, FeatureGeneratorStage):
+        out["generator"] = {
+            "rawName": stage.raw_name,
+            "ftype": stage.ftype.__name__,
+            "isResponse": stage.is_response,
+            "extract": enc.encode(stage.extract_fn),
+            "windowMs": stage.aggregate_window_ms,
+        }
+        return out
+    if full:
+        attrs = {}
+        for k, v in vars(stage).items():
+            if k in _SKIP_ATTRS or k.startswith("__"):
+                continue
+            encoded = enc.encode(v)
+            if _has_unserializable(encoded):
+                raise ValueError(
+                    f"Cannot save stage {type(stage).__name__} ({stage.uid}): attribute "
+                    f"{k!r} holds a non-serializable callable. Use a module-level "
+                    "function or @register_function so it can round-trip."
+                )
+            attrs[k] = encoded
+        out["attrs"] = attrs
+    return out
+
+
+def _has_unserializable(v) -> bool:
+    if isinstance(v, dict):
+        if "__unserializable__" in v:
+            return True
+        return any(_has_unserializable(x) for x in v.values()) or any(
+            _has_unserializable(x) for x in v.get("__list__", []))
+    if isinstance(v, list):
+        return any(_has_unserializable(x) for x in v)
+    return False
+
+
+def decode_stage(state: dict, dec: _Decoder) -> PipelineStage:
+    cls_name = state["class"]
+    cls = STAGE_REGISTRY.get(cls_name)
+    if cls is None:
+        raise ValueError(
+            f"Unknown stage class {cls_name!r}: import the module defining it before "
+            "loading this model")
+    if "generator" in state:
+        g = state["generator"]
+        extract = dec.decode(g["extract"]) or _NamedExtract(g["rawName"])
+        stage = FeatureGeneratorStage(
+            extract_fn=extract,
+            ftype=feature_type_by_name(g["ftype"]),
+            output_name=g["rawName"],
+            is_response=g["isResponse"],
+            aggregate_window_ms=g.get("windowMs"),
+            uid=state["uid"],
+        )
+        return stage
+    stage = object.__new__(cls)
+    stage._param_values = {}
+    stage.uid = state["uid"]
+    stage.operation_name = state["operationName"]
+    stage._input_features = ()
+    stage._output_feature = None
+    params = dec.decode(state["params"]) or {}
+    for k, v in params.items():
+        if k in stage._class_params():
+            stage._param_values[k] = v
+    for k, v in (state.get("attrs") or {}).items():
+        setattr(stage, k, dec.decode(v))
+    return stage
+
+
+# ---------------------------------------------------------------------------
+# Save / load
+# ---------------------------------------------------------------------------
+
+def save_model(model, path: str) -> None:
+    from .workflow import WorkflowModel
+
+    assert isinstance(model, WorkflowModel)
+    os.makedirs(path, exist_ok=True)
+    enc = _Encoder()
+
+    features: List[Feature] = []
+    seen = set()
+    for f in model.result_features:
+        for feat in f.all_features():
+            if feat.uid not in seen:
+                seen.add(feat.uid)
+                features.append(feat)
+
+    stages = []
+    for f in features:
+        st = f.origin_stage
+        if st is None:
+            continue
+        full = not isinstance(st, Estimator)
+        stages.append(encode_stage(st, enc, full=full))
+
+    manifest = {
+        "formatVersion": FORMAT_VERSION,
+        "resultFeatureUids": [f.uid for f in model.result_features],
+        "blacklist": list(model.blacklist),
+        "features": [
+            {
+                "uid": f.uid,
+                "name": f.name,
+                "ftype": f.ftype.__name__,
+                "isResponse": f.is_response,
+                "originStageUid": f.origin_stage.uid if f.origin_stage else None,
+                "parentUids": [p.uid for p in f.parents],
+            }
+            for f in features
+        ],
+        "stages": stages,
+        "fitted": {
+            uid: encode_stage(t, enc, full=True) for uid, t in model.fitted.items()
+        },
+    }
+    with gzip.open(os.path.join(path, "model.json.gz"), "wt") as fh:
+        json.dump(manifest, fh)
+    np.savez_compressed(os.path.join(path, "arrays.npz"), **enc.arrays)
+
+
+def load_model(path: str):
+    from .workflow import WorkflowModel
+
+    with gzip.open(os.path.join(path, "model.json.gz"), "rt") as fh:
+        manifest = json.load(fh)
+    if manifest["formatVersion"] > FORMAT_VERSION:
+        raise ValueError("model saved by a newer format version")
+    npz = np.load(os.path.join(path, "arrays.npz"), allow_pickle=False)
+    dec = _Decoder({k: npz[k] for k in npz.files})
+
+    stage_states = {s["uid"]: s for s in manifest["stages"]}
+    stages: Dict[str, PipelineStage] = {}
+    features: Dict[str, Feature] = {}
+
+    feat_states = {f["uid"]: f for f in manifest["features"]}
+
+    def build_feature(uid: str) -> Feature:
+        if uid in features:
+            return features[uid]
+        fs = feat_states[uid]
+        parents = tuple(build_feature(p) for p in fs["parentUids"])
+        origin = None
+        if fs["originStageUid"] is not None:
+            origin = build_stage(fs["originStageUid"], parents)
+        feat = Feature(
+            name=fs["name"],
+            ftype=feature_type_by_name(fs["ftype"]),
+            is_response=fs["isResponse"],
+            origin_stage=origin,
+            parents=parents,
+            uid=uid,
+        )
+        features[uid] = feat
+        if origin is not None:
+            origin._output_feature = feat
+        return feat
+
+    def build_stage(uid: str, parents: Tuple[Feature, ...]) -> PipelineStage:
+        if uid in stages:
+            return stages[uid]
+        st = decode_stage(stage_states[uid], dec)
+        st._input_features = parents
+        stages[uid] = st
+        return st
+
+    result_features = [build_feature(u) for u in manifest["resultFeatureUids"]]
+
+    fitted: Dict[str, Transformer] = {}
+    for uid, s in manifest["fitted"].items():
+        t = decode_stage(s, dec)
+        if uid in stages:
+            t._input_features = stages[uid]._input_features
+            t._output_feature = stages[uid]._output_feature
+        else:
+            t._input_features = tuple(
+                features[u] for u in s["inputUids"] if u in features)
+        t.is_model = True
+        fitted[uid] = t
+    # wire fitted model inputs/outputs from their estimator stage wiring
+    for uid, t in fitted.items():
+        if t._output_feature is None and uid in stages:
+            t._output_feature = stages[uid].get_output()
+
+    return WorkflowModel(
+        result_features=result_features,
+        fitted=fitted,
+        blacklist=manifest.get("blacklist", []),
+    )

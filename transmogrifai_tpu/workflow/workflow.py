@@ -1,0 +1,234 @@
+"""Workflow — DAG construction, training, and the fitted WorkflowModel.
+
+Reference: core/.../OpWorkflow.scala:59-566 (train :332-357, fitStages :368-444),
+OpWorkflowCore.scala, OpWorkflowModel.scala:59-465 (score :255-269, evaluate :320-325,
+summary :184-212).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..evaluators.base import Evaluator
+from ..features.feature import Feature
+from ..features.generator import FeatureGeneratorStage
+from ..models.selector import ModelSelectorSummary, SelectedModel
+from ..stages.base import Estimator, Transformer
+from .dag import all_stages, compute_dag, raw_feature_generators
+from .fit import fit_dag, transform_dag
+
+
+class Workflow:
+    """Lazy DAG of stages reached from the result features; ``train()`` fits it."""
+
+    def __init__(self):
+        self.result_features: List[Feature] = []
+        self._input_dataset: Optional[Dataset] = None
+        self._reader = None
+        self._raw_feature_filter = None
+        self._blacklist: List[str] = []
+        self._warm_models: Dict[str, Transformer] = {}
+
+    # -- configuration -------------------------------------------------------
+    def set_result_features(self, *features: Feature) -> "Workflow":
+        self.result_features = list(features)
+        self._validate_dag()
+        return self
+
+    def set_input_dataset(self, ds: Dataset) -> "Workflow":
+        self._input_dataset = ds
+        return self
+
+    def set_reader(self, reader) -> "Workflow":
+        self._reader = reader
+        return self
+
+    def with_raw_feature_filter(self, rff) -> "Workflow":
+        """Attach a RawFeatureFilter applied before fitting (SURVEY §2.8)."""
+        self._raw_feature_filter = rff
+        return self
+
+    def with_model_stages(self, model: "WorkflowModel") -> "Workflow":
+        """Warm-start: reuse fitted stages by uid (OpWorkflow.withModelStages :457-461)."""
+        self._warm_models.update(model.fitted)
+        return self
+
+    # -- validation (reference OpWorkflow.scala:265-323) -----------------------
+    def _validate_dag(self) -> None:
+        seen_uids: Dict[str, object] = {}
+        for stage in all_stages(self.result_features):
+            if stage.uid in seen_uids and seen_uids[stage.uid] is not stage:
+                raise ValueError(f"Duplicate stage uid in DAG: {stage.uid}")
+            seen_uids[stage.uid] = stage
+
+    # -- data ----------------------------------------------------------------
+    def raw_features(self) -> List[Feature]:
+        out: Dict[str, Feature] = {}
+        for f in self.result_features:
+            for r in f.raw_features():
+                out.setdefault(r.uid, r)
+        return list(out.values())
+
+    def generate_raw_data(self) -> Dataset:
+        if self._reader is not None:
+            return self._reader.generate_dataset(self.raw_features())
+        if self._input_dataset is not None:
+            ds = self._input_dataset
+            missing = [f.name for f in self.raw_features() if f.name not in ds]
+            if missing:
+                raise KeyError(f"Input dataset is missing raw feature columns: {missing}")
+            return ds
+        raise ValueError("No input data: call set_input_dataset or set_reader first")
+
+    # -- training ------------------------------------------------------------
+    def train(self, test_fraction: float = 0.0, seed: int = 42) -> "WorkflowModel":
+        if not self.result_features:
+            raise ValueError("set_result_features before train()")
+        raw = self.generate_raw_data()
+
+        blacklist: List[str] = []
+        rff_summary = None
+        if self._raw_feature_filter is not None:
+            raw, blacklist, rff_summary = self._raw_feature_filter.filter_raw(
+                raw, self.raw_features())
+
+        train_ds, test_ds = (raw, None)
+        if test_fraction > 0.0:
+            train_ds, test_ds = raw.split(test_fraction, seed=seed)
+
+        _, fitted = fit_dag(train_ds, self.result_features, fitted=self._warm_models)
+
+        model = WorkflowModel(
+            result_features=self.result_features,
+            fitted=fitted,
+            blacklist=blacklist,
+            rff_summary=rff_summary,
+        )
+
+        # holdout evaluation on the test reserve (reference HasTestEval semantics)
+        if test_ds is not None and test_ds.n_rows > 0:
+            model._evaluate_holdout(test_ds)
+        return model
+
+
+class WorkflowModel:
+    """A fitted workflow: score/evaluate/save, summaries and insights."""
+
+    def __init__(self, result_features: Sequence[Feature], fitted: Dict[str, Transformer],
+                 blacklist: Sequence[str] = (), rff_summary=None):
+        self.result_features = list(result_features)
+        self.fitted = dict(fitted)
+        self.blacklist = list(blacklist)
+        self.rff_summary = rff_summary
+        self._reader = None
+
+    def set_reader(self, reader) -> "WorkflowModel":
+        self._reader = reader
+        return self
+
+    # -- scoring -------------------------------------------------------------
+    def score(self, dataset: Optional[Dataset] = None,
+              keep_intermediate: bool = False) -> Dataset:
+        if dataset is None:
+            if self._reader is None:
+                raise ValueError("score() needs a dataset or a reader")
+            raws = []
+            for f in self.result_features:
+                raws.extend(f.raw_features())
+            dataset = self._reader.generate_dataset(raws)
+        out = transform_dag(dataset, self.result_features, self.fitted)
+        if keep_intermediate:
+            return out
+        keep = [f.name for f in self.result_features if f.name in out]
+        raw_names = [c for c in dataset.names if c in out.names]
+        return out.select(list(dict.fromkeys(raw_names + keep)))
+
+    def evaluate(self, evaluator: Evaluator, dataset: Optional[Dataset] = None
+                 ) -> Dict[str, float]:
+        label, pred = self._label_and_pred()
+        scored = self.score(dataset, keep_intermediate=True)
+        return evaluator.evaluate(scored, label.name, pred.name)
+
+    def score_and_evaluate(self, evaluator: Evaluator,
+                           dataset: Optional[Dataset] = None):
+        label, pred = self._label_and_pred()
+        scored = self.score(dataset, keep_intermediate=True)
+        metrics = evaluator.evaluate(scored, label.name, pred.name)
+        keep = [f.name for f in self.result_features if f.name in scored]
+        return scored.select(keep), metrics
+
+    def _label_and_pred(self):
+        label = next((f for f in self.result_features if f.is_response), None)
+        pred = next(
+            (f for f in self.result_features if f.ftype.__name__ == "Prediction"), None)
+        if label is None or pred is None:
+            raise ValueError(
+                "evaluate() needs a response feature and a Prediction result feature")
+        return label, pred
+
+    def _evaluate_holdout(self, test_ds: Dataset) -> None:
+        try:
+            label, pred = self._label_and_pred()
+        except ValueError:
+            return
+        selector_model = self.selector_model()
+        if selector_model is None:
+            return
+        scored = transform_dag(test_ds, self.result_features, self.fitted)
+        from ..evaluators.base import (
+            BinaryClassificationEvaluator,
+            MultiClassificationEvaluator,
+            RegressionEvaluator,
+        )
+
+        n_classes = None
+        col = scored[pred.name]
+        if getattr(col, "prob", None) is not None:
+            n_classes = col.prob.shape[1]
+        if n_classes == 2:
+            ev = BinaryClassificationEvaluator()
+        elif n_classes is not None and n_classes > 2:
+            ev = MultiClassificationEvaluator()
+        else:
+            ev = RegressionEvaluator()
+        selector_model.summary.holdout_evaluation = ev.evaluate(
+            scored, label.name, pred.name)
+
+    # -- introspection -------------------------------------------------------
+    def selector_model(self) -> Optional[SelectedModel]:
+        for t in self.fitted.values():
+            if isinstance(t, SelectedModel):
+                return t
+        return None
+
+    def summary(self) -> Optional[ModelSelectorSummary]:
+        m = self.selector_model()
+        return m.summary if m else None
+
+    def summary_pretty(self) -> str:
+        s = self.summary()
+        return s.pretty() if s else "(no model selector in workflow)"
+
+    def compute_data_up_to(self, feature: Feature, dataset: Dataset) -> Dataset:
+        """Materialize the DAG only up to ``feature`` (OpWorkflowModel.computeDataUpTo)."""
+        return transform_dag(dataset, [feature], self.fitted)
+
+    def model_insights(self):
+        from ..insights.model_insights import extract_model_insights
+
+        return extract_model_insights(self)
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path: str) -> None:
+        from .serde import save_model
+
+        save_model(self, path)
+
+    @staticmethod
+    def load(path: str) -> "WorkflowModel":
+        from .serde import load_model
+
+        return load_model(path)
