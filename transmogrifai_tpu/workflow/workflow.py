@@ -146,14 +146,27 @@ class WorkflowModel:
         raw_names = [c for c in dataset.names if c in out.names]
         return out.select(list(dict.fromkeys(raw_names + keep)))
 
+    @staticmethod
+    def _check_eval_args(evaluator, dataset):
+        """Forgive swapped (dataset, evaluator) order; fail fast on bad types."""
+        if isinstance(evaluator, Dataset) and isinstance(dataset, Evaluator):
+            evaluator, dataset = dataset, evaluator
+        if not isinstance(evaluator, Evaluator):
+            raise TypeError(
+                f"expected an Evaluator (e.g. Evaluators.binary_classification()), "
+                f"got {type(evaluator).__name__}: call evaluate(evaluator, dataset)")
+        return evaluator, dataset
+
     def evaluate(self, evaluator: Evaluator, dataset: Optional[Dataset] = None
                  ) -> Dict[str, float]:
+        evaluator, dataset = self._check_eval_args(evaluator, dataset)
         label, pred = self._label_and_pred()
         scored = self.score(dataset, keep_intermediate=True)
         return evaluator.evaluate(scored, label.name, pred.name)
 
     def score_and_evaluate(self, evaluator: Evaluator,
                            dataset: Optional[Dataset] = None):
+        evaluator, dataset = self._check_eval_args(evaluator, dataset)
         label, pred = self._label_and_pred()
         scored = self.score(dataset, keep_intermediate=True)
         metrics = evaluator.evaluate(scored, label.name, pred.name)
