@@ -1,0 +1,235 @@
+"""Tree-ensemble tests: exact split math, missing-value routing, regularization,
+sharded-parity, and workflow/serde integration (reference test strategy SURVEY §4 —
+OpEstimatorSpec behavior: fit → model → transform parity → serde round-trip)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from transmogrifai_tpu.data.dataset import Column
+from transmogrifai_tpu.models.trees import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    GradientBoostedTreesClassifier,
+    GradientBoostedTreesRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+    XGBoostClassifier,
+    quantile_bin,
+)
+
+
+def _logloss(p, y):
+    p = np.clip(p, 1e-9, 1 - 1e-9)
+    return -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+
+
+class TestQuantileBin:
+    def test_bins_cover_range_and_missing(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(500, 3)).astype(np.float32)
+        x[::7, 1] = np.nan
+        binned, edges = quantile_bin(x, n_bins=16)
+        assert binned.shape == (500, 3)
+        assert edges.shape == (3, 15)
+        assert (binned[::7, 1] == 16).all()          # missing -> reserved bin
+        ok = ~np.isnan(x)
+        assert binned[ok].max() < 16 and binned[ok].min() >= 0
+        # monotone: larger value -> same or larger bin
+        order = np.argsort(x[:, 0])
+        assert (np.diff(binned[order, 0]) >= 0).all()
+
+    def test_constant_column(self):
+        x = np.ones((50, 1), dtype=np.float32)
+        binned, _ = quantile_bin(x, n_bins=8)
+        assert len(np.unique(binned)) == 1
+
+
+class TestExactTreeMath:
+    def test_single_split_leaf_values(self):
+        """Hand-computed XGBoost math: depth-1 regression tree, lambda=0, eta=1."""
+        x = np.array([[1.0], [2.0], [10.0], [11.0]], dtype=np.float32)
+        y = np.array([0.0, 0.0, 1.0, 1.0], dtype=np.float32)
+        est = GradientBoostedTreesRegressor(
+            num_rounds=1, max_depth=1, eta=1.0, reg_lambda=0.0,
+            min_child_weight=0.0, n_bins=4)
+        m = est._fit_arrays(x, y, np.ones(4, dtype=np.float32))
+        # base = 0.5; grads = 0.5-y; leaf values -G/H = ±0.5 -> exact predictions
+        pred = m.predict_column(Column.vector(x)).pred
+        np.testing.assert_allclose(pred, y, atol=1e-6)
+
+    def test_lambda_shrinks_leaves(self):
+        x = np.array([[1.0], [2.0], [10.0], [11.0]], dtype=np.float32)
+        y = np.array([0.0, 0.0, 1.0, 1.0], dtype=np.float32)
+        m = GradientBoostedTreesRegressor(
+            num_rounds=1, max_depth=1, eta=1.0, reg_lambda=2.0,
+            min_child_weight=0.0, n_bins=4,
+        )._fit_arrays(x, y, np.ones(4, dtype=np.float32))
+        pred = m.predict_column(Column.vector(x)).pred
+        # leaf value = -G/(H+2) = ±0.25 -> predictions pulled toward base 0.5
+        np.testing.assert_allclose(pred, [0.25, 0.25, 0.75, 0.75], atol=1e-6)
+
+    def test_gamma_prunes_to_stump(self):
+        x = np.array([[1.0], [2.0], [10.0], [11.0]], dtype=np.float32)
+        y = np.array([0.0, 0.0, 1.0, 1.0], dtype=np.float32)
+        m = GradientBoostedTreesRegressor(
+            num_rounds=1, max_depth=3, eta=1.0, reg_lambda=0.0, gamma=1e6,
+            n_bins=4)._fit_arrays(x, y, np.ones(4, dtype=np.float32))
+        pred = m.predict_column(Column.vector(x)).pred
+        np.testing.assert_allclose(pred, 0.5, atol=1e-6)  # no split: base score
+
+    def test_sample_weights_shift_split(self):
+        """Zero-weight rows must not influence fitting at all."""
+        x = np.array([[1.0], [2.0], [10.0], [11.0], [100.0]], dtype=np.float32)
+        y = np.array([0.0, 0.0, 1.0, 1.0, 5.0], dtype=np.float32)
+        w = np.array([1, 1, 1, 1, 0], dtype=np.float32)
+        m = GradientBoostedTreesRegressor(
+            num_rounds=1, max_depth=1, eta=1.0, reg_lambda=0.0,
+            min_child_weight=0.0, n_bins=8)._fit_arrays(x, y, w)
+        pred = m.predict_column(Column.vector(x[:4])).pred
+        np.testing.assert_allclose(pred, y[:4], atol=1e-6)
+
+
+class TestMissingValues:
+    def test_learned_default_direction(self):
+        """Missing values correlated with the positive class must route there."""
+        rng = np.random.default_rng(1)
+        n = 1000
+        x = rng.normal(size=(n, 2)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float32)
+        miss = rng.random(n) < 0.3
+        # make x0 missing mostly on the POSITIVE side
+        miss &= y == 1
+        x[miss, 0] = np.nan
+        m = GradientBoostedTreesClassifier(
+            num_rounds=10, max_depth=3, eta=0.5)._fit_arrays(
+            x, y, np.ones(n, dtype=np.float32))
+        score = m.predict_column(Column.vector(x)).score
+        assert score[miss].mean() > 0.7  # missing rows recognized as positive
+
+
+class TestEnsembles:
+    @pytest.fixture(scope="class")
+    def binary_data(self):
+        rng = np.random.default_rng(2)
+        n, d = 1500, 6
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        logit = 1.5 * x[:, 0] - x[:, 1] * x[:, 2]
+        y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+        return x, y, np.ones(n, dtype=np.float32)
+
+    def test_gbt_beats_stump_and_improves_with_rounds(self, binary_data):
+        x, y, w = binary_data
+        l5 = _logloss(GradientBoostedTreesClassifier(num_rounds=5, max_depth=3)
+                      ._fit_arrays(x, y, w).predict_column(Column.vector(x)).score, y)
+        l50 = _logloss(GradientBoostedTreesClassifier(num_rounds=50, max_depth=3)
+                       ._fit_arrays(x, y, w).predict_column(Column.vector(x)).score, y)
+        assert l50 < l5 < _logloss(np.full_like(y, y.mean()), y)
+
+    def test_rf_probabilities_calibrated(self, binary_data):
+        x, y, w = binary_data
+        m = RandomForestClassifier(num_trees=30, max_depth=6)._fit_arrays(x, y, w)
+        p = m.predict_column(Column.vector(x))
+        assert 0.0 <= p.prob.min() and p.prob.max() <= 1.0
+        np.testing.assert_allclose(p.prob.sum(axis=1), 1.0, atol=1e-6)
+        assert ((p.score > 0.5) == y).mean() > 0.75
+
+    def test_decision_tree_deterministic(self, binary_data):
+        x, y, w = binary_data
+        p1 = DecisionTreeClassifier(max_depth=4)._fit_arrays(x, y, w) \
+            .predict_column(Column.vector(x)).score
+        p2 = DecisionTreeClassifier(max_depth=4)._fit_arrays(x, y, w) \
+            .predict_column(Column.vector(x)).score
+        np.testing.assert_array_equal(p1, p2)
+
+    def test_regressors_fit_signal(self):
+        rng = np.random.default_rng(3)
+        n = 1200
+        x = rng.normal(size=(n, 5)).astype(np.float32)
+        y = (2 * x[:, 0] + x[:, 1] ** 2 + 0.1 * rng.normal(size=n)).astype(np.float32)
+        w = np.ones(n, dtype=np.float32)
+        for est in (GradientBoostedTreesRegressor(num_rounds=40, max_depth=4, eta=0.2),
+                    RandomForestRegressor(num_trees=25, max_depth=8, feature_subset="all"),
+                    DecisionTreeRegressor(max_depth=8)):
+            pred = est._fit_arrays(x, y, w).predict_column(Column.vector(x)).pred
+            r2 = 1 - ((pred - y) ** 2).mean() / y.var()
+            assert r2 > 0.8, f"{type(est).__name__} r2={r2}"
+
+    def test_feature_importances(self, binary_data):
+        x, y, w = binary_data
+        m = GradientBoostedTreesClassifier(num_rounds=20, max_depth=3) \
+            ._fit_arrays(x, y, w)
+        imp = m.feature_importances(x.shape[1])
+        assert imp.shape == (x.shape[1],)
+        assert abs(imp.sum() - 1.0) < 1e-9
+        # signal features (x0, x1, x2) dominate pure-noise features
+        assert imp[:3].sum() > imp[3:].sum()
+
+
+class TestShardedParity:
+    def test_row_sharded_fit_matches_single_device(self):
+        """Histogram psum over the data axis must not change the fitted trees."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from transmogrifai_tpu.models.trees import _fit_gbt
+        from transmogrifai_tpu.parallel.mesh import make_mesh
+        rng = np.random.default_rng(4)
+        n, d = 512, 4
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float32)
+        binned, _ = quantile_bin(x, 16)
+        w = np.ones(n, dtype=np.float32)
+
+        args = dict(n_rounds=5, max_depth=3, n_bins=16, objective="binary:logistic",
+                    eta=0.3, reg_lambda=1.0, gamma=0.0, min_child_weight=1.0,
+                    base_score=0.0)
+        _, t_single = _fit_gbt(jnp.asarray(binned), jnp.asarray(y), jnp.asarray(w),
+                               **args)
+
+        mesh = make_mesh()
+        shard = NamedSharding(mesh, P("data"))
+        _, t_shard = _fit_gbt(
+            jax.device_put(binned, NamedSharding(mesh, P("data", None))),
+            jax.device_put(y, shard), jax.device_put(w, shard), **args)
+        np.testing.assert_allclose(np.asarray(t_single.value),
+                                   np.asarray(t_shard.value), atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(t_single.feat),
+                                      np.asarray(t_shard.feat))
+
+
+class TestWorkflowIntegration:
+    def test_selector_with_trees_and_serde(self, tmp_path):
+        from transmogrifai_tpu import (
+            BinaryClassificationModelSelector, FeatureBuilder, Workflow,
+            WorkflowModel, transmogrify,
+        )
+        import pandas as pd
+
+        rng = np.random.default_rng(5)
+        n = 400
+        a = rng.normal(size=n)
+        b = rng.choice(["x", "y", "z"], n)
+        y = ((a > 0) & (b != "z")).astype(int)
+        df = pd.DataFrame({"a": a, "b": b, "label": y})
+        feats, ds = FeatureBuilder.from_dataframe(df, response="label")
+        fmap = {f.name: f for f in feats}
+        vec = transmogrify([fmap["a"], fmap["b"]])
+        models = [(GradientBoostedTreesClassifier(n_bins=16),
+                   [{"num_rounds": 10, "max_depth": 3}]),
+                  (XGBoostClassifier(n_bins=16),
+                   [{"num_rounds": 5, "max_depth": 2, "eta": 0.5}])]
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, models=models, seed=0)
+        pred = sel.set_input(fmap["label"], vec).get_output()
+        model = Workflow().set_result_features(fmap["label"], pred) \
+            .set_input_dataset(ds).train()
+        scored = model.score(ds)
+        s = scored[pred.name].score
+        assert ((s > 0.5) == y).mean() > 0.8
+
+        model.save(str(tmp_path / "m"))
+        m2 = WorkflowModel.load(str(tmp_path / "m"))
+        s2 = m2.score(ds)[pred.name].score
+        np.testing.assert_allclose(s, s2, atol=1e-6)
