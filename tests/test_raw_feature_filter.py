@@ -1,0 +1,197 @@
+"""RawFeatureFilter tests (SURVEY §2.8).
+
+Mirrors reference core/src/test/.../filters/RawFeatureFilterTest.scala coverage:
+distributions, fill-rate exclusion, train-vs-score divergence, null-label leakage,
+blacklist DAG rewiring, protected features.
+"""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder, Workflow, transmogrify
+from transmogrifai_tpu.filters import (
+    FeatureDistribution,
+    RawFeatureFilter,
+    Summary,
+    compute_distributions,
+    js_divergence,
+)
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.models.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.types import PickList, Real, RealNN, Text
+
+
+def _features():
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    x = FeatureBuilder.Real("x").extract_field().as_predictor()
+    sparse = FeatureBuilder.Real("sparse").extract_field().as_predictor()
+    color = FeatureBuilder.PickList("color").extract_field().as_predictor()
+    leaky = FeatureBuilder.Real("leaky").extract_field().as_predictor()
+    return label, x, sparse, color, leaky
+
+
+def _dataset(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    y = (rng.random(n) < 0.5).astype(float)
+    x = rng.normal(0, 1, n)
+    sparse = [None] * n  # never filled
+    color = rng.choice(["red", "green", "blue"], n)
+    # leaky: missing exactly when label is 0 -> null indicator correlates with label
+    leaky = [float(v) if yy > 0.5 else None for v, yy in zip(rng.normal(5, 1, n), y)]
+    return Dataset.from_features(
+        {"label": y.tolist(), "x": x.tolist(), "sparse": sparse,
+         "color": color.tolist(), "leaky": leaky},
+        {"label": RealNN, "x": Real, "sparse": Real, "color": PickList, "leaky": Real},
+    )
+
+
+class TestDistributions:
+    def test_numeric_histogram(self):
+        label, x, *_ = _features()
+        ds = _dataset()
+        dists = compute_distributions(ds, [label, x], bins=20)
+        assert len(dists) == 1  # response skipped
+        d = dists[0]
+        assert d.name == "x"
+        assert d.count == 400 and d.nulls == 0
+        assert d.distribution.sum() == pytest.approx(400)
+        assert d.summary_info.min < -1 and d.summary_info.max > 1
+
+    def test_text_hashed_distribution(self):
+        feats = _features()
+        ds = _dataset()
+        dists = compute_distributions(ds, list(feats), bins=16)
+        by_name = {d.name: d for d in dists}
+        color = by_name["color"]
+        assert color.distribution.sum() == pytest.approx(400)
+        # 3 distinct values -> at most 3 non-empty buckets
+        assert (color.distribution > 0).sum() <= 3
+
+    def test_fill_rates(self):
+        feats = _features()
+        ds = _dataset()
+        by_name = {d.name: d for d in compute_distributions(ds, list(feats))}
+        assert by_name["sparse"].fill_rate == 0.0
+        assert by_name["x"].fill_rate == 1.0
+        assert 0.3 < by_name["leaky"].fill_rate < 0.7
+
+    def test_js_divergence_identical_is_zero(self):
+        h = np.array([5.0, 3.0, 2.0, 0.0])
+        assert js_divergence(h, h) == pytest.approx(0.0, abs=1e-12)
+
+    def test_js_divergence_disjoint_is_one(self):
+        a = np.array([10.0, 0.0, 0.0, 0.0])
+        b = np.array([0.0, 0.0, 0.0, 10.0])
+        assert js_divergence(a, b) == pytest.approx(1.0, abs=1e-9)
+
+
+class TestExclusions:
+    def test_min_fill_excludes_empty_feature(self):
+        feats = _features()
+        ds = _dataset()
+        rff = RawFeatureFilter(min_fill=0.01)
+        filtered, blacklist, results = rff.filter_raw(ds, list(feats))
+        assert "sparse" in blacklist
+        assert "sparse" not in filtered.names
+        assert "x" not in blacklist and "color" not in blacklist
+
+    def test_null_label_leakage_excluded(self):
+        feats = _features()
+        ds = _dataset()
+        rff = RawFeatureFilter(min_fill=0.0, max_correlation=0.8)
+        _, blacklist, results = rff.filter_raw(ds, list(feats))
+        assert "leaky" in blacklist
+        m = next(m for m in results.metrics if m.name == "leaky")
+        assert abs(m.null_label_correlation) > 0.8
+
+    def test_protected_feature_survives(self):
+        feats = _features()
+        ds = _dataset()
+        rff = RawFeatureFilter(min_fill=0.01, protected_features=("sparse",))
+        _, blacklist, _ = rff.filter_raw(ds, list(feats))
+        assert "sparse" not in blacklist
+
+    def test_scoring_divergence_excludes_shifted_feature(self):
+        feats = _features()
+        train = _dataset(n=600, seed=1)
+        rng = np.random.default_rng(2)
+        n = 600
+        score = Dataset.from_features(
+            {"label": [1.0] * n, "x": (rng.normal(100, 0.1, n)).tolist(),
+             "sparse": [None] * n, "color": rng.choice(["red", "blue"], n).tolist(),
+             "leaky": rng.normal(5, 1, n).tolist()},
+            {"label": RealNN, "x": Real, "sparse": Real, "color": PickList,
+             "leaky": Real},
+        )
+        rff = RawFeatureFilter(min_fill=0.0, max_correlation=1.1,
+                               max_js_divergence=0.5, scoring_dataset=score)
+        _, blacklist, results = rff.filter_raw(train, list(feats))
+        assert "x" in blacklist  # completely shifted distribution
+        m = next(m for m in results.metrics if m.name == "x")
+        assert m.js_divergence > 0.5
+
+    def test_fill_rate_difference_check(self):
+        feats = _features()
+        train = _dataset(n=600, seed=1)
+        n = 600
+        # leaky is ~50% filled in train, 100% filled in score -> ratio 2x
+        rng = np.random.default_rng(3)
+        score = Dataset.from_features(
+            {"label": [1.0] * n, "x": rng.normal(0, 1, n).tolist(),
+             "sparse": [None] * n, "color": ["red"] * n,
+             "leaky": rng.normal(5, 1, n).tolist()},
+            {"label": RealNN, "x": Real, "sparse": Real, "color": PickList,
+             "leaky": Real},
+        )
+        rff = RawFeatureFilter(min_fill=0.0, max_correlation=1.1,
+                               max_js_divergence=1.1, max_fill_ratio_diff=1.5,
+                               scoring_dataset=score)
+        _, blacklist, results = rff.filter_raw(train, list(feats))
+        assert "leaky" in blacklist
+
+    def test_small_scoring_set_skips_scoring_checks(self):
+        feats = _features()
+        train = _dataset(n=300)
+        score = train.take(np.arange(10))
+        rff = RawFeatureFilter(min_fill=0.0, max_correlation=1.1,
+                               max_js_divergence=0.01, scoring_dataset=score,
+                               min_scoring_rows=500)
+        _, blacklist, results = rff.filter_raw(train, list(feats))
+        assert blacklist == []  # too few scoring rows: checks skipped
+
+
+class TestWorkflowIntegration:
+    def test_train_with_rff_drops_and_rewires(self):
+        label, x, sparse, color, leaky = _features()
+        ds = _dataset(n=500)
+        vec = transmogrify([x, sparse, color, leaky])
+        selector = BinaryClassificationModelSelector.with_train_validation_split(
+            models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+        pred = label.transform_with(selector, vec)
+        wf = (Workflow()
+              .set_result_features(label, pred)
+              .set_input_dataset(ds)
+              .with_raw_feature_filter(
+                  RawFeatureFilter(min_fill=0.01, max_correlation=0.8)))
+        model = wf.train()
+        assert "sparse" in model.blacklist and "leaky" in model.blacklist
+        scored = model.score(ds)
+        assert pred.name in scored
+        assert model.rff_summary is not None
+        d = model.rff_summary.to_dict()
+        assert d["excludedFeatures"] == sorted(model.blacklist)
+
+    def test_result_feature_blacklisted_raises(self):
+        label, x, sparse, color, leaky = _features()
+        ds = _dataset(n=300)
+        # pipeline depends ONLY on sparse -> filtering it must raise
+        vec = transmogrify([sparse])
+        selector = BinaryClassificationModelSelector.with_train_validation_split(
+            models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+        pred = label.transform_with(selector, vec)
+        wf = (Workflow()
+              .set_result_features(label, pred)
+              .set_input_dataset(ds)
+              .with_raw_feature_filter(RawFeatureFilter(min_fill=0.01)))
+        with pytest.raises(ValueError, match="blacklisted"):
+            wf.train()

@@ -1,0 +1,17 @@
+"""Raw feature filtering — pre-DAG data hygiene (SURVEY §2.8).
+
+Reference: core/.../filters/RawFeatureFilter.scala:90-637, FeatureDistribution.scala:1-334,
+PreparedFeatures.scala, Summary.scala.
+"""
+
+from .distribution import FeatureDistribution, Summary, compute_distributions, js_divergence
+from .raw_feature_filter import RawFeatureFilter, RawFeatureFilterResults
+
+__all__ = [
+    "FeatureDistribution",
+    "Summary",
+    "compute_distributions",
+    "js_divergence",
+    "RawFeatureFilter",
+    "RawFeatureFilterResults",
+]

@@ -1,0 +1,265 @@
+"""Per-feature summaries and binned distributions for RawFeatureFilter.
+
+Reference: core/.../filters/FeatureDistribution.scala:1-334 (fillRate, JS divergence,
+EmpiricalDistribution), Summary.scala, PreparedFeatures.scala:1-208.
+
+TPU-first: all numeric columns are stacked into one (n, d) block and their histograms
+are produced by a single jitted XLA program (bucketize -> one-hot -> column sums — the
+inner reduction is an MXU matmul when d is wide); text/map distributions hash on host
+(murmur3) since values live in CPU DRAM anyway.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import Column, Dataset
+from ..features.feature import Feature
+from ..types import ColumnKind, FeatureType
+from ..utils.hashing import hash_to_bucket
+
+
+@dataclass(frozen=True)
+class Summary:
+    """Min/max/sum/count of a feature's non-null values (Summary.scala)."""
+
+    min: float
+    max: float
+    sum: float
+    count: float
+
+    @staticmethod
+    def empty() -> "Summary":
+        return Summary(np.inf, -np.inf, 0.0, 0.0)
+
+    def to_dict(self) -> dict:
+        return {"min": self.min, "max": self.max, "sum": self.sum, "count": self.count}
+
+
+@dataclass
+class FeatureDistribution:
+    """Binned distribution of one raw feature (or one map key).
+
+    ``distribution`` is histogram counts: equi-width bins over [summary.min, summary.max]
+    for numerics, hashed-token buckets for text-like features (FeatureDistribution.scala).
+    """
+
+    name: str
+    key: Optional[str]          # map key, None for scalar features
+    count: int                  # total rows
+    nulls: int                  # rows where the feature is empty
+    distribution: np.ndarray    # (bins,) float64 counts
+    summary_info: Summary
+
+    @property
+    def fill_rate(self) -> float:
+        return (self.count - self.nulls) / self.count if self.count else 0.0
+
+    def relative_fill_delta(self, other: "FeatureDistribution") -> float:
+        return abs(self.fill_rate - other.fill_rate)
+
+    def relative_fill_ratio(self, other: "FeatureDistribution") -> float:
+        a, b = self.fill_rate, other.fill_rate
+        lo, hi = min(a, b), max(a, b)
+        return np.inf if lo == 0.0 else hi / lo
+
+    def js_divergence(self, other: "FeatureDistribution") -> float:
+        return js_divergence(self.distribution, other.distribution)
+
+    @property
+    def full_name(self) -> str:
+        return self.name if self.key is None else f"{self.name}[{self.key}]"
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "key": self.key,
+            "count": self.count,
+            "nulls": self.nulls,
+            "distribution": self.distribution.tolist(),
+            "summaryInfo": self.summary_info.to_dict(),
+        }
+
+
+def js_divergence(p: np.ndarray, q: np.ndarray) -> float:
+    """Jensen-Shannon divergence between two (unnormalized) histograms, in [0, 1].
+
+    Matches the reference's use (FeatureDistribution.scala jsDivergence): base-2 logs,
+    zero-count bins contribute nothing.
+    """
+    p = np.asarray(p, dtype=np.float64)
+    q = np.asarray(q, dtype=np.float64)
+    ps, qs = p.sum(), q.sum()
+    if ps == 0.0 or qs == 0.0:
+        return 0.0
+    p = p / ps
+    q = q / qs
+    m = 0.5 * (p + q)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        kl_pm = np.where(p > 0, p * np.log2(p / m), 0.0).sum()
+        kl_qm = np.where(q > 0, q * np.log2(q / m), 0.0).sum()
+    return float(0.5 * kl_pm + 0.5 * kl_qm)
+
+
+# ---------------------------------------------------------------------------
+# Device-side numeric histograms
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("bins",))
+def _numeric_histograms(values: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
+                        bins: int) -> jnp.ndarray:
+    """Histogram every column of a (n, d) block at once: (d, bins) counts.
+
+    NaN marks missing.  Bucketize is elementwise; counts come from one scatter-add
+    into a flat (d*bins,) accumulator — O(n*d) memory, no (n, d, bins) one-hot.
+    """
+    n, d = values.shape
+    width = jnp.where(hi > lo, hi - lo, 1.0)
+    scaled = (values - lo[None, :]) / width[None, :] * bins
+    idx = jnp.clip(scaled.astype(jnp.int32), 0, bins - 1)
+    valid = ~jnp.isnan(values)
+    flat = idx + jnp.arange(d, dtype=jnp.int32)[None, :] * bins
+    counts = jnp.zeros(d * bins, dtype=jnp.float32).at[flat.ravel()].add(
+        valid.ravel().astype(jnp.float32))
+    return counts.reshape(d, bins)
+
+
+def _numeric_block_distributions(
+    named_cols: List[Tuple[str, Optional[str], np.ndarray]], bins: int,
+    ref_summaries: Optional[Dict[Tuple[str, Optional[str]], Summary]] = None,
+) -> List[FeatureDistribution]:
+    """named_cols: (feature name, map key, float64 values w/ NaN missing).
+
+    When ``ref_summaries`` is given (the scoring pass), bin edges come from the
+    reference (training) min/max so train/score histograms are comparable —
+    RawFeatureFilter.scala reuses training Summaries for the scoring distributions.
+    """
+    if not named_cols:
+        return []
+    block = np.stack([v for _, _, v in named_cols], axis=1)  # (n, d)
+    n = block.shape[0]
+    import warnings
+
+    with np.errstate(all="ignore"), warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)  # all-NaN columns are legal
+        lo = np.nanmin(block, axis=0)
+        hi = np.nanmax(block, axis=0)
+        sums = np.nansum(block, axis=0)
+        counts = (~np.isnan(block)).sum(axis=0)
+    lo = np.where(np.isfinite(lo), lo, 0.0)
+    hi = np.where(np.isfinite(hi), hi, 0.0)
+    # bin edges may come from the reference (training) pass; summaries below always
+    # describe THIS dataset
+    edge_lo, edge_hi = lo.copy(), hi.copy()
+    if ref_summaries is not None:
+        for j, (name, key, _) in enumerate(named_cols):
+            ref = ref_summaries.get((name, key))
+            if ref is not None and ref.count > 0:
+                edge_lo[j], edge_hi[j] = ref.min, ref.max
+    hists = np.asarray(
+        _numeric_histograms(jnp.asarray(block), jnp.asarray(edge_lo),
+                            jnp.asarray(edge_hi), bins)
+    )
+    out = []
+    for j, (name, key, _) in enumerate(named_cols):
+        summ = (
+            Summary(float(lo[j]), float(hi[j]), float(sums[j]), float(counts[j]))
+            if counts[j] else Summary.empty()
+        )
+        out.append(
+            FeatureDistribution(
+                name=name, key=key, count=n, nulls=int(n - counts[j]),
+                distribution=hists[j].astype(np.float64), summary_info=summ,
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host-side text / set / list hashing distributions
+# ---------------------------------------------------------------------------
+
+def _hashed_distribution(
+    name: str, key: Optional[str], values: Sequence[Any], text_bins: int
+) -> FeatureDistribution:
+    """Hash string-ish values into ``text_bins`` buckets (FeatureDistribution text path)."""
+    from ..data.dataset import _is_empty_obj
+
+    counts = np.zeros(text_bins, dtype=np.float64)
+    nulls = 0
+    total_tokens = 0.0
+    for v in values:
+        if _is_empty_obj(v):
+            nulls += 1
+            continue
+        tokens = v if isinstance(v, (list, set, tuple)) else [v]
+        for t in tokens:
+            counts[hash_to_bucket(str(t), text_bins)] += 1.0
+            total_tokens += 1.0
+    summ = Summary(0.0, float(text_bins), total_tokens, float(len(values) - nulls))
+    return FeatureDistribution(
+        name=name, key=key, count=len(values), nulls=nulls,
+        distribution=counts, summary_info=summ,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+_NUMERIC_KINDS = (ColumnKind.FLOAT, ColumnKind.INT, ColumnKind.BOOL)
+
+
+def compute_distributions(
+    dataset: Dataset,
+    raw_features: Sequence[Feature],
+    bins: int = 100,
+    text_bins: int = 100,
+    ref_summaries: Optional[Dict[Tuple[str, Optional[str]], Summary]] = None,
+) -> List[FeatureDistribution]:
+    """One FeatureDistribution per raw predictor feature (one per key for map features).
+
+    Mirrors RawFeatureFilter.computeFeatureStats (RawFeatureFilter.scala:137-199): response
+    features are skipped — they are never filtered.
+    """
+    numeric_cols: List[Tuple[str, Optional[str], np.ndarray]] = []
+    out: List[FeatureDistribution] = []
+    for f in raw_features:
+        if f.is_response or f.name not in dataset:
+            continue
+        col = dataset[f.name]
+        kind = col.kind
+        if kind in _NUMERIC_KINDS:
+            numeric_cols.append((f.name, None, col.values_f64()))
+        elif kind is ColumnKind.GEO:
+            present = col.present()
+            # distribution over distance-from-origin buckets keeps geo comparable
+            vals = np.where(present, np.linalg.norm(col.data[:, :2], axis=1), np.nan)
+            numeric_cols.append((f.name, None, vals))
+        elif kind is ColumnKind.MAP:
+            keys = sorted({k for m in col.data if m for k in m})
+            for k in keys:
+                sub = [m.get(k) if m else None for m in col.data]
+                # map values are homogeneous per type (types/maps.py): the first
+                # non-null value decides numeric vs hashed treatment
+                first = next((v for v in sub if v is not None), None)
+                if isinstance(first, (bool, int, float)):
+                    arr = np.array(
+                        [float(v) if v is not None else np.nan for v in sub],
+                        dtype=np.float64,
+                    )
+                    numeric_cols.append((f.name, k, arr))
+                else:
+                    out.append(_hashed_distribution(f.name, k, sub, text_bins))
+        elif kind is ColumnKind.VECTOR:
+            continue  # vectors are derived, never raw-filtered
+        else:  # TEXT, TEXT_LIST, TEXT_SET, INT_LIST
+            out.append(_hashed_distribution(f.name, None, list(col.data), text_bins))
+    out.extend(_numeric_block_distributions(numeric_cols, bins, ref_summaries))
+    return out

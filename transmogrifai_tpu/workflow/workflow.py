@@ -93,7 +93,7 @@ class Workflow:
         rff_summary = None
         if self._raw_feature_filter is not None:
             raw, blacklist, rff_summary = self._raw_feature_filter.filter_raw(
-                raw, self.raw_features())
+                raw, self.raw_features(), self.result_features)
 
         train_ds, test_ds = (raw, None)
         if test_fraction > 0.0:
