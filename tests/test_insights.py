@@ -1,0 +1,222 @@
+"""ModelInsights + RecordInsightsLOCO/Corr tests (SURVEY §2.12).
+
+Mirrors reference ModelInsightsTest / RecordInsightsLOCOTest coverage: insights carry
+slot provenance + sanity stats + model contributions; LOCO diffs identify the
+influential features and respect top-K/strategy; JSON serde works.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    Dataset,
+    FeatureBuilder,
+    Workflow,
+    transmogrify,
+)
+from transmogrifai_tpu.data.dataset import Column
+from transmogrifai_tpu.insights import (
+    ModelInsights,
+    RecordInsightsCorr,
+    RecordInsightsLOCO,
+    extract_model_insights,
+)
+from transmogrifai_tpu.models.logistic import LogisticRegression, LogisticRegressionModel
+from transmogrifai_tpu.types import PickList, Real, RealNN
+from transmogrifai_tpu.utils.vector_metadata import VectorColumnMetadata, VectorMetadata
+
+
+@pytest.fixture(scope="module")
+def fitted_model():
+    rng = np.random.default_rng(11)
+    n = 600
+    strong = rng.normal(0, 1, n)
+    weak = rng.normal(0, 1, n)
+    noise = rng.normal(0, 1, n)
+    color = rng.choice(["red", "blue"], n)
+    logit = 2.5 * strong + 0.3 * weak + 0.8 * (color == "red")
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(float)
+    ds = Dataset.from_features(
+        {"label": y.tolist(), "strong": strong.tolist(), "weak": weak.tolist(),
+         "noise": noise.tolist(), "color": color.tolist()},
+        {"label": RealNN, "strong": Real, "weak": Real, "noise": Real,
+         "color": PickList})
+
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    f_strong = FeatureBuilder.Real("strong").extract_field().as_predictor()
+    f_weak = FeatureBuilder.Real("weak").extract_field().as_predictor()
+    f_noise = FeatureBuilder.Real("noise").extract_field().as_predictor()
+    f_color = FeatureBuilder.PickList("color").extract_field().as_predictor()
+
+    vec = transmogrify([f_strong, f_weak, f_noise, f_color])
+    checked = label.sanity_check(vec)
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+    pred = label.transform_with(sel, checked)
+    wf = Workflow().set_result_features(label, pred).set_input_dataset(ds)
+    return wf.train(), ds, pred
+
+
+class TestModelInsights:
+    def test_extract_structure(self, fitted_model):
+        model, ds, pred = fitted_model
+        ins = model.model_insights()
+        assert isinstance(ins, ModelInsights)
+        assert ins.label.name == "label"
+        assert ins.label.distinct_count == 2
+        parents = {f.feature_name for f in ins.features}
+        assert {"strong", "weak", "noise", "color"} <= parents
+        assert ins.selected_model_info["bestModelName"] == "LogisticRegression"
+
+    def test_contributions_align_with_signal(self, fitted_model):
+        model, ds, pred = fitted_model
+        ins = model.model_insights()
+        by_name = {f.feature_name: f for f in ins.features}
+        assert by_name["strong"].max_contribution > by_name["noise"].max_contribution
+
+    def test_slots_have_sanity_stats(self, fitted_model):
+        model, *_ = fitted_model
+        ins = model.model_insights()
+        slots = [d for f in ins.features for d in f.derived]
+        with_corr = [d for d in slots if d.corr_label is not None]
+        assert len(with_corr) > 0
+        assert any(d.variance is not None for d in slots)
+
+    def test_json_roundtrip(self, fitted_model):
+        model, *_ = fitted_model
+        ins = model.model_insights()
+        d = json.loads(ins.to_json())
+        assert d["label"]["name"] == "label"
+        assert len(d["features"]) >= 4
+        assert d["stageInfo"]
+
+    def test_pretty(self, fitted_model):
+        model, *_ = fitted_model
+        text = model.model_insights().pretty()
+        assert "Top contributing slots" in text
+        assert "strong" in text
+
+    def test_insights_after_save_load(self, fitted_model, tmp_path):
+        model, *_ = fitted_model
+        p = str(tmp_path / "m")
+        model.save(p)
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+        loaded = WorkflowModel.load(p)
+        ins = loaded.model_insights()
+        assert ins.label.distinct_count == 2
+        by_name = {f.feature_name: f for f in ins.features}
+        assert by_name["strong"].max_contribution > by_name["noise"].max_contribution
+
+
+class TestContributions:
+    def test_multiclass_coef_axis(self):
+        """coef (d_slots, k_classes) -> one per-class vector per slot."""
+        from transmogrifai_tpu.insights.model_insights import _model_contributions
+
+        class Fake:
+            coef = np.arange(12.0).reshape(4, 3)  # 4 slots, 3 classes
+
+        out = _model_contributions(Fake(), 4)
+        assert len(out) == 4
+        assert out[1] == [3.0, 4.0, 5.0]
+
+    def test_binary_coef_shorter_than_d(self):
+        from transmogrifai_tpu.insights.model_insights import _model_contributions
+
+        class Fake:
+            coef = np.array([1.0, 2.0])
+
+        out = _model_contributions(Fake(), 4)
+        assert out == [[1.0], [2.0], [], []]
+
+
+def _toy_linear_model():
+    """LogisticRegressionModel with known coefs over a 3-slot vector."""
+    m = LogisticRegressionModel(coef=np.array([3.0, 0.0, -1.0]), intercept=0.0)
+    meta = VectorMetadata("vec", [
+        VectorColumnMetadata("a", "Real", index=0),
+        VectorColumnMetadata("b", "Real", index=1),
+        VectorColumnMetadata("c", "Real", index=2),
+    ])
+    return m, meta
+
+
+class TestLOCO:
+    def test_loco_finds_influential_slot(self):
+        m, meta = _toy_linear_model()
+        x = np.array([[1.0, 1.0, 1.0], [0.5, 2.0, 0.0]])
+        loco = RecordInsightsLOCO(m, meta=meta, top_k=3)
+        col = loco.transform_columns([Column.vector(x, meta)], None)
+        first = RecordInsightsLOCO.parse(col.data[0])
+        # slot a (coef 3) must dominate row 0
+        assert list(first)[0].startswith("a")
+        # diffs: base prob - zeroed prob; zeroing a positive-coef active slot lowers p
+        assert first[list(first)[0]][-1] > 0
+
+    def test_inactive_slots_skipped(self):
+        m, meta = _toy_linear_model()
+        x = np.array([[0.0, 1.0, 0.0]])
+        loco = RecordInsightsLOCO(m, meta=meta)
+        col = loco.transform_columns([Column.vector(x, meta)], None)
+        names = set(RecordInsightsLOCO.parse(col.data[0]))
+        assert all(n.startswith("b") for n in names)
+
+    def test_top_k(self):
+        m, meta = _toy_linear_model()
+        x = np.ones((1, 3))
+        loco = RecordInsightsLOCO(m, meta=meta, top_k=1)
+        col = loco.transform_columns([Column.vector(x, meta)], None)
+        assert len(col.data[0]) == 1
+
+    def test_strategy_negative(self):
+        m, meta = _toy_linear_model()
+        x = np.ones((1, 3))
+        loco = RecordInsightsLOCO(m, meta=meta, strategy="negative", top_k=1)
+        col = loco.transform_columns([Column.vector(x, meta)], None)
+        name = list(RecordInsightsLOCO.parse(col.data[0]))[0]
+        assert name.startswith("c")  # negative coef -> most negative diff
+
+    def test_group_aggregation(self):
+        m = LogisticRegressionModel(coef=np.array([1.0, 1.0, 2.0]), intercept=0.0)
+        meta = VectorMetadata("vec", [
+            VectorColumnMetadata("txt", "Text", grouping="hash", index=0),
+            VectorColumnMetadata("txt", "Text", grouping="hash", index=1),
+            VectorColumnMetadata("num", "Real", index=2),
+        ])
+        loco = RecordInsightsLOCO(m, meta=meta)
+        col = loco.transform_columns([Column.vector(np.ones((1, 3)), meta)], None)
+        parsed = RecordInsightsLOCO.parse(col.data[0])
+        assert "txt_hash" in parsed  # two hashed slots collapsed into one entry
+        assert len(parsed) == 2
+
+    def test_e2e_on_fitted_workflow(self, fitted_model):
+        model, ds, pred = fitted_model
+        sel = model.selector_model()
+        scored = model.score(ds, keep_intermediate=True)
+        vec_name = sel.inputs[1].name
+        vec_col = scored[vec_name]
+        loco = RecordInsightsLOCO(sel, top_k=5)
+        out = loco.transform_columns([vec_col.take(np.arange(20))], None)
+        assert len(out) == 20
+        parsed = RecordInsightsLOCO.parse(out.data[0])
+        assert 0 < len(parsed) <= 5
+        # strong feature should appear among the top insights for most rows
+        hits = sum(any(k.startswith("strong") for k in
+                       RecordInsightsLOCO.parse(out.data[i])) for i in range(20))
+        assert hits >= 15
+
+
+class TestCorr:
+    def test_corr_insights(self):
+        m, meta = _toy_linear_model()
+        rng = np.random.default_rng(5)
+        x = rng.normal(0, 1, (200, 3))
+        corr_t = RecordInsightsCorr(m, meta=meta, top_k=2)
+        col = corr_t.transform_columns([Column.vector(x, meta)], None)
+        assert len(col) == 200
+        parsed = {k: json.loads(v) for k, v in col.data[0].items()}
+        assert len(parsed) <= 2

@@ -1,0 +1,127 @@
+"""Local scoring parity tests (SURVEY §2.14 local module).
+
+Mirrors reference OpWorkflowModelLocalTest: the local score function's output must match
+the engine score() path exactly, record by record.
+"""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    Dataset,
+    FeatureBuilder,
+    Workflow,
+    transmogrify,
+)
+from transmogrifai_tpu.local import score_function
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.readers.files import DataReaders
+from transmogrifai_tpu.types import PickList, Real, RealNN
+
+
+@pytest.fixture(scope="module")
+def model_and_records():
+    rng = np.random.default_rng(3)
+    n = 400
+    x1 = rng.normal(0, 1, n)
+    color = rng.choice(["red", "green", "blue"], n)
+    age = np.where(rng.random(n) < 0.15, None, rng.normal(40, 10, n))
+    y = (rng.random(n) < 1 / (1 + np.exp(-(1.5 * x1 + (color == "red"))))).astype(float)
+    records = [
+        {"label": float(y[i]), "x1": float(x1[i]), "color": str(color[i]),
+         "age": None if age[i] is None else float(age[i])}
+        for i in range(n)
+    ]
+
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    f_x1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    f_color = FeatureBuilder.PickList("color").extract_field().as_predictor()
+    f_age = FeatureBuilder.Real("age").extract_field().as_predictor()
+
+    vec = transmogrify([f_x1, f_color, f_age])
+    checked = label.sanity_check(vec)
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+    pred = label.transform_with(sel, checked)
+
+    import pandas as pd
+
+    df = pd.DataFrame(records)
+    wf = (Workflow().set_result_features(label, pred)
+          .set_reader(DataReaders.Simple.dataframe(df)))
+    model = wf.train()
+    return model, records, df, label, pred
+
+
+class TestLocalScoring:
+    def test_single_record_shape(self, model_and_records):
+        model, records, df, label, pred = model_and_records
+        scorer = score_function(model)
+        out = scorer(records[0])
+        assert pred.name in out
+        pmap = out[pred.name]
+        assert "prediction" in pmap
+        assert any(k.startswith("probability") for k in pmap)
+
+    def test_parity_with_engine_score(self, model_and_records):
+        model, records, df, label, pred = model_and_records
+        scorer = score_function(model)
+        local_out = scorer.batch(records[:50])
+        ds = DataReaders.Simple.dataframe(df.head(50)).generate_dataset(
+            [f for f in _raws(model)])
+        engine = model.score(ds)
+        prob = engine[pred.name].prob
+        for i, rec_out in enumerate(local_out):
+            pm = rec_out[pred.name]
+            np.testing.assert_allclose(pm["probability_1"], prob[i, 1], rtol=1e-6)
+
+    def test_single_equals_batch(self, model_and_records):
+        model, records, *_ = model_and_records
+        scorer = score_function(model)
+        single = [scorer(r) for r in records[:5]]
+        batch = scorer.batch(records[:5])
+        for s, b in zip(single, batch):
+            assert s.keys() == b.keys()
+            for k in s:
+                if isinstance(s[k], dict):
+                    for kk in s[k]:
+                        assert s[k][kk] == pytest.approx(b[k][kk], rel=1e-9)
+
+    def test_missing_values_handled(self, model_and_records):
+        model, records, df, label, pred = model_and_records
+        scorer = score_function(model)
+        out = scorer({"label": 0.0, "x1": 0.2, "color": None, "age": None})
+        assert pred.name in out
+
+    def test_scoring_without_label(self, model_and_records):
+        """Inference records have no response field (reference local serving path)."""
+        model, records, df, label, pred = model_and_records
+        scorer = score_function(model)
+        out = scorer({"x1": 0.2, "color": "red", "age": 33.0})
+        assert pred.name in out
+        assert "prediction" in out[pred.name]
+
+    def test_throughput_smoke(self, model_and_records):
+        """Local batch path must be comfortably faster than per-record calls."""
+        import time
+
+        model, records, *_ = model_and_records
+        scorer = score_function(model)
+        scorer.batch(records)  # warm
+        t0 = time.perf_counter()
+        scorer.batch(records)
+        batch_t = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for r in records[:20]:
+            scorer(r)
+        single_t = (time.perf_counter() - t0) / 20 * len(records)
+        assert batch_t < single_t
+
+
+def _raws(model):
+    seen = {}
+    for f in model.result_features:
+        for r in f.raw_features():
+            seen.setdefault(r.uid, r)
+    return list(seen.values())

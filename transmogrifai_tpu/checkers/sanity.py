@@ -233,7 +233,7 @@ class SanityChecker(BinaryEstimator):
             correlation_type=self.correlation_type,
             correlations_feature=full,
         )
-        return SanityCheckerModel(kept_indices=kept, summary=summary)
+        return SanityCheckerModel(kept_indices=kept, summary=summary, meta=meta)
 
 
 def _to_np(v):
@@ -266,10 +266,12 @@ class SanityCheckerModel(Transformer):
     allow_label_as_input = True
 
     def __init__(self, kept_indices: List[int], summary: Optional[SanityCheckerSummary] = None,
-                 **kw):
+                 meta: Optional[VectorMetadata] = None, **kw):
         super().__init__(**kw)
         self.kept_indices = list(kept_indices)
         self.summary = summary
+        #: VectorMetadata of the PRE-drop input vector (slot provenance for insights)
+        self.meta = meta
 
     def _is_label_slot(self, feature, features) -> bool:
         return feature is features[0]

@@ -1,0 +1,24 @@
+"""Explainability — ModelInsights and record-level insights (SURVEY §2.12).
+
+Reference: core/.../ModelInsights.scala:74-801, insights/RecordInsightsLOCO.scala:88-331,
+insights/RecordInsightsCorr.scala.
+"""
+
+from .loco import RecordInsightsCorr, RecordInsightsLOCO
+from .model_insights import (
+    DerivedFeatureInsight,
+    FeatureInsights,
+    LabelSummary,
+    ModelInsights,
+    extract_model_insights,
+)
+
+__all__ = [
+    "DerivedFeatureInsight",
+    "FeatureInsights",
+    "LabelSummary",
+    "ModelInsights",
+    "extract_model_insights",
+    "RecordInsightsLOCO",
+    "RecordInsightsCorr",
+]

@@ -1,0 +1,13 @@
+"""Local (engine-free) scoring — millisecond inference without the workflow engine.
+
+Reference: local/.../OpWorkflowModelLocal.scala:93-200 (``scoreFunction: Map[String,Any]
+=> Map[String,Any]``), MLeapModelConverter.  The reference round-trips Spark models
+through MLeap bundles; here the fitted pipeline IS already a set of pure column
+functions, so the local path just binds them once and replays records through the
+fused transform DAG — the TPU analog exports the model's numeric tail as a single
+jitted scoring program (SURVEY §7.10).
+"""
+
+from .scoring import score_function
+
+__all__ = ["score_function"]

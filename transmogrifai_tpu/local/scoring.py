@@ -1,0 +1,92 @@
+"""score_function — bind a fitted WorkflowModel into a record-level closure.
+
+Reference: local/.../OpWorkflowModelLocal.scala:93-200: partition stages into row
+transformers vs wrapped models (:101-108), convert models to local functions
+(:154-200), and return ``Map[String,Any] => Map[String,Any]`` (:117-135).
+
+Design here: the single-record closure runs the SAME fitted column transformers as the
+engine path (one-row columns — exact parity by construction), while ``batch`` scores a
+list of records in one columnar pass for throughput.  Both avoid Workflow/reader
+machinery entirely: everything is bound at closure-creation time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Sequence
+
+import numpy as np
+
+from ..data.dataset import Column, Dataset
+from ..features.feature import Feature
+from ..features.generator import FeatureGeneratorStage
+from ..workflow.dag import compute_dag
+from ..workflow.fit import _resolve
+
+
+class LocalScorer:
+    """Callable scorer: ``scorer(record) -> {result feature name: value}``.
+
+    Also exposes ``batch(records)`` for columnar multi-record scoring.
+    """
+
+    def __init__(self, model):
+        self._model = model
+        self._result_features: List[Feature] = list(model.result_features)
+        # bind raw generators once (reference: stages partitioned up-front :101-108)
+        self._generators: List[FeatureGeneratorStage] = []
+        seen = set()
+        for f in self._result_features:
+            for raw in f.raw_features():
+                st = raw.origin_stage
+                if isinstance(st, FeatureGeneratorStage) and st.uid not in seen:
+                    seen.add(st.uid)
+                    self._generators.append(st)
+        self._fitted = model.fitted
+        # pre-compute the layered transform plan (no per-call DAG walk)
+        self._plan = [s for layer in compute_dag(self._result_features) for s in layer]
+
+    # -- single record (the reference scoreFunction shape) -------------------
+    def __call__(self, record: Mapping[str, Any]) -> Dict[str, Any]:
+        return self.batch([record])[0]
+
+    # -- columnar batch ------------------------------------------------------
+    def batch(self, records: Sequence[Mapping[str, Any]]) -> List[Dict[str, Any]]:
+        cols: Dict[str, Column] = {}
+        for g in self._generators:
+            try:
+                values = [g.extract(r).value for r in records]
+                cols[g.raw_name] = Column.from_values(g.ftype, values)
+            except Exception:
+                if not g.is_response:
+                    raise
+                # label may legitimately be absent at inference time — the model
+                # stages never read it (engine parity: scoring without a label)
+        ds = Dataset(cols)
+        for stage in self._plan:
+            runner = _resolve(stage, self._fitted)
+            if runner is None:
+                raise ValueError(
+                    f"Stage {stage.uid} is an unfitted estimator; cannot score locally")
+            ds = runner.transform(ds)
+        out: List[Dict[str, Any]] = [{} for _ in records]
+        for f in self._result_features:
+            if f.name not in ds:
+                continue
+            col = ds[f.name]
+            for i, v in enumerate(col.to_values()):
+                out[i][f.name] = _plain(v)
+        return out
+
+
+def _plain(v: Any):
+    """Numpy scalars/arrays -> plain python for the Map[String,Any] contract."""
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v
+
+
+def score_function(model) -> LocalScorer:
+    """Bind ``model`` into a local scorer (OpWorkflowModelLocal.scoreFunction)."""
+    return LocalScorer(model)

@@ -157,16 +157,20 @@ class ModelSelector(PredictionEstimatorBase):
             data_prep=prep_summary,
             train_evaluation=train_eval,
         )
-        return SelectedModel(model=best_model, summary=summary)
+        return SelectedModel(model=best_model, summary=summary,
+                             feature_meta=vec.meta)
 
 
 class SelectedModel(PredictionModelBase):
     """The winning fitted model + selection summary."""
 
-    def __init__(self, model: PredictionModelBase, summary: ModelSelectorSummary, **kw):
+    def __init__(self, model: PredictionModelBase, summary: ModelSelectorSummary,
+                 feature_meta=None, **kw):
         super().__init__(**kw)
         self.model = model
         self.summary = summary
+        #: VectorMetadata of the input feature vector (feeds ModelInsights/LOCO grouping)
+        self.feature_meta = feature_meta
 
     def predict_column(self, vec: Column) -> PredictionColumn:
         return self.model.predict_column(vec)

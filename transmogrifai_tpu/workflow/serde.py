@@ -140,6 +140,23 @@ def _restore_dataclass(name: str, data: dict):
         return ModelEvaluation(**data)
     if name == "PrepSummary":
         return PrepSummary(**data)
+    if name == "SanityCheckerSummary":
+        from ..checkers.sanity import ColumnStats, SanityCheckerSummary
+
+        return SanityCheckerSummary(
+            stats=[ColumnStats(**s) if isinstance(s, dict) else s
+                   for s in data.get("stats", [])],
+            dropped=data.get("dropped", {}),
+            kept_indices=list(data.get("kept_indices", [])),
+            label_distinct=data.get("label_distinct", 0),
+            sample_size=data.get("sample_size", 0),
+            correlation_type=data.get("correlation_type", "pearson"),
+            correlations_feature=data.get("correlations_feature"),
+        )
+    if name == "ColumnStats":
+        from ..checkers.sanity import ColumnStats
+
+        return ColumnStats(**data)
     return data  # unknown summaries restore as plain dicts
 
 
