@@ -1,0 +1,238 @@
+"""New model families: NaiveBayes, LinearSVC, MLP, GLM, Isotonic (SURVEY §2.9).
+
+Each model gets: learns-signal sanity, estimator behavior spec (fit/copy/serde),
+and family-specific semantics (SVC margin-only output, GLM links, PAV monotonicity).
+"""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.data.dataset import Column, Dataset
+from transmogrifai_tpu.models.glm import GeneralizedLinearRegression
+from transmogrifai_tpu.models.isotonic import IsotonicRegressionCalibrator, pav_fit
+from transmogrifai_tpu.models.mlp import MultilayerPerceptronClassifier
+from transmogrifai_tpu.models.naive_bayes import NaiveBayes
+from transmogrifai_tpu.models.svm import LinearSVC
+from transmogrifai_tpu.testkit import TestFeatureBuilder, assert_estimator_spec
+from transmogrifai_tpu.types import OPVector, RealNN
+from transmogrifai_tpu.utils.vector_metadata import VectorColumnMetadata, VectorMetadata
+
+
+def _binary_data(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (n, 4))
+    logit = 2.0 * x[:, 0] - 1.5 * x[:, 1]
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float64)
+    return x.astype(np.float32), y
+
+
+def _multiclass_data(n=600, seed=1):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (n, 3))
+    scores = np.stack([x[:, 0], x[:, 1], -x[:, 0] - x[:, 1]], axis=1)
+    y = np.argmax(scores + 0.3 * rng.normal(size=(n, 3)), axis=1).astype(np.float64)
+    return x.astype(np.float32), y
+
+
+def _accuracy(model, x, y):
+    pred = model.predict_column(Column.vector(x)).pred
+    return (pred == y).mean()
+
+
+def _vec_dataset(x, y):
+    meta = VectorMetadata("features", [
+        VectorColumnMetadata("f", "Real", index=j) for j in range(x.shape[1])])
+    label_f, _ = TestFeatureBuilder.of("label", RealNN, y.tolist(), is_response=True)
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+
+    vec_f = FeatureBuilder.of("features", OPVector).extract_field().as_predictor()
+    ds = Dataset({
+        "label": Column.from_values(RealNN, y.tolist()),
+        "features": Column.vector(x, meta),
+    })
+    return label_f, vec_f, ds
+
+
+class TestNaiveBayes:
+    def test_learns_multiclass(self):
+        x, y = _multiclass_data()
+        m = NaiveBayes()._fit_arrays(x, y, np.ones_like(y, dtype=np.float32))
+        assert _accuracy(m, x, y) > 0.55
+        pc = m.predict_column(Column.vector(x))
+        assert pc.prob.shape == (len(y), 3)
+        np.testing.assert_allclose(pc.prob.sum(axis=1), 1.0, rtol=1e-9)
+
+    def test_estimator_spec(self):
+        x, y = _binary_data(200)
+        label_f, vec_f, ds = _vec_dataset(x, y)
+        est = NaiveBayes()
+        est.set_input(label_f, vec_f)
+        assert_estimator_spec(est, ds, check_row_parity=False)
+
+
+class TestLinearSVC:
+    def test_learns_binary_margin(self):
+        x, y = _binary_data()
+        m = LinearSVC(reg_param=0.01)._fit_arrays(
+            x, y, np.ones_like(y, dtype=np.float32))
+        assert _accuracy(m, x, y) > 0.8
+        pc = m.predict_column(Column.vector(x))
+        assert pc.prob is None          # Spark parity: no probability
+        assert pc.raw is not None
+        # margin must rank like the signal
+        from transmogrifai_tpu.evaluators.metrics import au_roc
+
+        import jax.numpy as jnp
+
+        auc = float(au_roc(jnp.asarray(pc.score), jnp.asarray(y),
+                           jnp.ones_like(jnp.asarray(y))))
+        assert auc > 0.85
+
+    def test_coef_sign(self):
+        x, y = _binary_data()
+        m = LinearSVC()._fit_arrays(x, y, np.ones_like(y, dtype=np.float32))
+        assert m.coef[0] > 0 and m.coef[1] < 0
+
+    def test_estimator_spec(self):
+        x, y = _binary_data(200)
+        label_f, vec_f, ds = _vec_dataset(x, y)
+        est = LinearSVC(max_iter=50)
+        est.set_input(label_f, vec_f)
+        assert_estimator_spec(est, ds, check_row_parity=False)
+
+
+class TestMLP:
+    def test_learns_nonlinear(self):
+        rng = np.random.default_rng(4)
+        n = 600
+        x = rng.normal(0, 1, (n, 2)).astype(np.float32)
+        y = ((x[:, 0] * x[:, 1]) > 0).astype(np.float64)  # XOR-like
+        m = MultilayerPerceptronClassifier(
+            hidden_layers=(16,), max_iter=400, learning_rate=0.05
+        )._fit_arrays(x, y, np.ones_like(y, dtype=np.float32))
+        assert _accuracy(m, x, y) > 0.9
+
+    def test_multiclass_shapes(self):
+        x, y = _multiclass_data(300)
+        m = MultilayerPerceptronClassifier(hidden_layers=(8,), max_iter=150) \
+            ._fit_arrays(x, y, np.ones_like(y, dtype=np.float32))
+        pc = m.predict_column(Column.vector(x))
+        assert pc.prob.shape == (300, 3)
+
+    def test_estimator_spec(self):
+        x, y = _binary_data(150)
+        label_f, vec_f, ds = _vec_dataset(x, y)
+        est = MultilayerPerceptronClassifier(hidden_layers=(4,), max_iter=50)
+        est.set_input(label_f, vec_f)
+        assert_estimator_spec(est, ds, check_row_parity=False)
+
+
+class TestGLM:
+    def test_gaussian_matches_ols(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(0, 1, (400, 3)).astype(np.float32)
+        y = (x @ np.array([1.0, -2.0, 0.5]) + 3.0).astype(np.float64)
+        m = GeneralizedLinearRegression(family="gaussian")._fit_arrays(
+            x, y, np.ones_like(y, dtype=np.float32))
+        np.testing.assert_allclose(m.coef, [1.0, -2.0, 0.5], atol=1e-3)
+        assert m.intercept == pytest.approx(3.0, abs=1e-3)
+
+    def test_poisson_log_link(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(0, 0.5, (800, 2)).astype(np.float32)
+        mu = np.exp(0.8 * x[:, 0] - 0.4 * x[:, 1] + 1.0)
+        y = rng.poisson(mu).astype(np.float64)
+        m = GeneralizedLinearRegression(family="poisson")._fit_arrays(
+            x, y, np.ones_like(y, dtype=np.float32))
+        np.testing.assert_allclose(m.coef, [0.8, -0.4], atol=0.1)
+        pred = m.predict_column(Column.vector(x)).pred
+        assert (pred > 0).all()
+
+    def test_binomial(self):
+        x, y = _binary_data()
+        m = GeneralizedLinearRegression(family="binomial")._fit_arrays(
+            x, y, np.ones_like(y, dtype=np.float32))
+        pred = m.predict_column(Column.vector(x)).pred
+        assert ((pred >= 0) & (pred <= 1)).all()
+        assert ((pred > 0.5) == y).mean() > 0.8
+
+    def test_bad_family_rejected(self):
+        with pytest.raises(ValueError, match="family"):
+            GeneralizedLinearRegression(family="tweedie")
+
+    def test_estimator_spec(self):
+        x, _ = _binary_data(150)
+        y = (x @ np.array([1.0, 0.5, 0.0, 0.0])).astype(np.float64)
+        label_f, vec_f, ds = _vec_dataset(x, y)
+        est = GeneralizedLinearRegression()
+        est.set_input(label_f, vec_f)
+        assert_estimator_spec(est, ds, check_row_parity=False)
+
+
+class TestIsotonic:
+    def test_pav_monotone(self):
+        rng = np.random.default_rng(5)
+        s = rng.uniform(0, 1, 300)
+        y = (rng.random(300) < s).astype(np.float64)  # well-calibrated scores
+        kx, ky = pav_fit(s, y, np.ones_like(y))
+        assert (np.diff(ky) >= -1e-12).all()  # monotone non-decreasing
+        # calibrated values track the score on average
+        cal = np.interp(s, kx, ky)
+        assert abs(cal.mean() - y.mean()) < 0.02
+
+    def test_calibrator_stage(self):
+        rng = np.random.default_rng(6)
+        n = 300
+        score = rng.uniform(0, 1, n)
+        y = (rng.random(n) < score ** 2).astype(np.float64)  # mis-calibrated
+        feats, ds = TestFeatureBuilder.build(
+            {"label": y.tolist(), "score": score.tolist()},
+            {"label": RealNN, "score": RealNN}, response="label")
+        est = IsotonicRegressionCalibrator()
+        est.set_input(feats["label"], feats["score"])
+        model = est.fit(ds)
+        out = model.transform(ds)[model.output_name]
+        cal = np.array(out.to_values())
+        # calibration moves the mean toward the true positive rate
+        assert abs(cal.mean() - y.mean()) < abs(score.mean() - y.mean())
+
+    def test_decreasing_mode(self):
+        s = np.array([0.0, 1.0, 2.0, 3.0])
+        y = np.array([3.0, 2.0, 1.0, 0.0])
+        kx, ky = pav_fit(s, y, np.ones_like(y), increasing=False)
+        assert (np.diff(ky) <= 1e-12).all()
+
+
+class TestSelectorIntegration:
+    def test_defaults_include_new_families(self):
+        from transmogrifai_tpu.models.selector import (
+            BinaryClassificationModelSelector,
+            MultiClassificationModelSelector,
+            RegressionModelSelector,
+        )
+
+        bin_names = {type(e).__name__
+                     for e, _ in BinaryClassificationModelSelector.default_models()}
+        assert "LinearSVC" in bin_names
+        multi_names = {type(e).__name__
+                       for e, _ in MultiClassificationModelSelector.default_models()}
+        assert "NaiveBayes" in multi_names
+        reg_names = {type(e).__name__
+                     for e, _ in RegressionModelSelector.default_models()}
+        assert "GeneralizedLinearRegression" in reg_names
+
+    def test_selector_picks_among_new_models(self):
+        x, y = _binary_data(400)
+        label_f, vec_f, ds = _vec_dataset(x, y)
+        from transmogrifai_tpu.models.logistic import LogisticRegression
+        from transmogrifai_tpu.models.selector import BinaryClassificationModelSelector
+
+        sel = BinaryClassificationModelSelector.with_train_validation_split(
+            models=[(LogisticRegression(), [{"reg_param": 0.01}]),
+                    (LinearSVC(max_iter=50), [{"reg_param": 0.01}]),
+                    (NaiveBayes(), [{"smoothing": 1.0}])])
+        sel.set_input(label_f, vec_f)
+        model = sel.fit(ds)
+        assert model.summary.best_model_name in (
+            "LogisticRegression", "LinearSVC", "NaiveBayes")
+        assert len(model.summary.validation_results) == 3

@@ -1,0 +1,138 @@
+"""testkit tests: TestFeatureBuilder, random generators, shared behavior specs.
+
+The spec helpers are themselves exercised against real stages (numeric vectorizer,
+one-hot, scalers) the way reference suites extend OpTransformerSpec/OpEstimatorSpec.
+"""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.testkit import (
+    RandomBinary,
+    RandomIntegral,
+    RandomList,
+    RandomMap,
+    RandomMultiPickList,
+    RandomPickList,
+    RandomReal,
+    RandomText,
+    RandomVector,
+    TestFeatureBuilder,
+    assert_estimator_spec,
+    assert_transformer_spec,
+)
+from transmogrifai_tpu.types import (
+    Binary,
+    Integral,
+    MultiPickList,
+    PickList,
+    Real,
+    RealNN,
+    Text,
+    TextList,
+    TextMap,
+)
+
+
+class TestTestFeatureBuilder:
+    def test_build_features_and_dataset(self):
+        feats, ds = TestFeatureBuilder.build(
+            {"age": [30.0, None, 12.5], "label": [0.0, 1.0, 1.0]},
+            {"age": Real, "label": RealNN}, response="label")
+        assert ds.n_rows == 3
+        assert feats["label"].is_response and not feats["age"].is_response
+        assert ds["age"].fill_rate() == pytest.approx(2 / 3)
+
+    def test_of_single(self):
+        f, ds = TestFeatureBuilder.of("t", Text, ["a", None, "c"])
+        assert f.ftype is Text
+        assert ds["t"].to_values() == ["a", None, "c"]
+
+    def test_missing_ftype_raises(self):
+        with pytest.raises(KeyError, match="feature type"):
+            TestFeatureBuilder.build({"a": [1]}, {})
+
+
+class TestRandomGenerators:
+    def test_deterministic(self):
+        a = RandomReal.normal(seed=7).limit(10)
+        b = RandomReal.normal(seed=7).limit(10)
+        assert a == b
+
+    def test_probability_of_empty(self):
+        vals = RandomReal.normal(probability_of_empty=0.4, seed=1).limit(2000)
+        frac = sum(v is None for v in vals) / len(vals)
+        assert 0.35 < frac < 0.45
+
+    def test_take_returns_typed(self):
+        vals = RandomIntegral(0, 10, seed=3).take(5)
+        assert all(isinstance(v, Integral) for v in vals)
+
+    def test_binary(self):
+        vals = RandomBinary(probability_of_true=0.9, seed=2).limit(500)
+        assert sum(vals) > 400
+
+    def test_text_and_picklist(self):
+        txt = RandomText.strings(2, 4, seed=5).limit(20)
+        assert all(2 <= len(t) <= 4 for t in txt)
+        pl = RandomPickList(["a", "b"], seed=5).limit(50)
+        assert set(pl) <= {"a", "b"}
+
+    def test_emails(self):
+        vals = RandomText.emails(domain="sf.com", seed=9).limit(5)
+        assert all(v.endswith("@sf.com") for v in vals)
+
+    def test_multipicklist_list_map_vector(self):
+        mpl = RandomMultiPickList(["x", "y", "z"], seed=1).limit(20)
+        assert all(isinstance(v, set) for v in mpl)
+        lst = RandomList(RandomText.strings(seed=2), max_size=3, seed=2).limit(10)
+        assert all(isinstance(v, list) and len(v) <= 3 for v in lst)
+        mp = RandomMap(RandomText.strings(seed=3), keys=["k1", "k2"], seed=3).limit(10)
+        assert all(isinstance(v, dict) and set(v) <= {"k1", "k2"} for v in mp)
+        vec = RandomVector(4, seed=4).limit(3)
+        assert all(v.shape == (4,) for v in vec)
+
+    def test_dataset_from_generators(self):
+        feats, ds = TestFeatureBuilder.build(
+            {"x": RandomReal.normal(seed=1, probability_of_empty=0.1).limit(100),
+             "c": RandomPickList(["r", "g", "b"], seed=2).limit(100)},
+            {"x": Real, "c": PickList})
+        assert ds.n_rows == 100
+
+
+class TestSharedSpecs:
+    def test_transformer_spec_on_math(self):
+        from transmogrifai_tpu.ops.math import ScalarMathTransformer
+
+        f, ds = TestFeatureBuilder.of("x", Real, [1.0, 2.0, None])
+        stage = ScalarMathTransformer(op="multiply", scalar=2.0)
+        stage.set_input(f)
+        assert_transformer_spec(stage, ds, expected=[2.0, 4.0, None])
+
+    def test_estimator_spec_on_scaler(self):
+        from transmogrifai_tpu.ops.scalers import FillMissingWithMean
+
+        f, ds = TestFeatureBuilder.of("x", Real, [1.0, 3.0, None, None])
+        est = FillMissingWithMean()
+        est.set_input(f)
+        assert_estimator_spec(est, ds, expected=[1.0, 3.0, 2.0, 2.0])
+
+    def test_estimator_spec_on_onehot(self):
+        from transmogrifai_tpu.ops.onehot import OneHotVectorizer
+
+        feats, ds = TestFeatureBuilder.build(
+            {"c": ["a", "b", "a", None]}, {"c": PickList})
+        est = OneHotVectorizer(top_k=5, min_support=1)
+        est.set_input(feats["c"])
+        model = assert_estimator_spec(est, ds, check_row_parity=False)
+        out = model.transform(ds)[model.output_name]
+        assert out.data.shape[0] == 4
+
+    def test_spec_catches_bad_expected(self):
+        from transmogrifai_tpu.ops.math import ScalarMathTransformer
+
+        f, ds = TestFeatureBuilder.of("x", Real, [1.0])
+        stage = ScalarMathTransformer(op="multiply", scalar=2.0)
+        stage.set_input(f)
+        with pytest.raises(AssertionError):
+            assert_transformer_spec(stage, ds, expected=[999.0])

@@ -61,10 +61,13 @@ class BinaryClassificationEvaluator(Evaluator):
     def evaluate_arrays(self, y, pred, w=None):
         w = np.ones_like(y) if w is None else w
         s = jnp.asarray(pred.score)
+        # threshold metrics use the model's OWN predictions (reference evaluates the
+        # prediction column) — scores may be margins (LinearSVC), not probabilities
+        p = jnp.asarray(pred.pred)
         yj, wj = jnp.asarray(y), jnp.asarray(w)
-        tp, fp, tn, fn = (float(v) for v in M.binary_counts(s, yj, wj))
+        tp, fp, tn, fn = (float(v) for v in M.binary_counts(p, yj, wj))
         precision, recall, f1, error = (
-            float(v) for v in M.precision_recall_f1(s, yj, wj)
+            float(v) for v in M.precision_recall_f1(p, yj, wj)
         )
         return {
             "auROC": float(M.au_roc(s, yj, wj)),
@@ -189,6 +192,10 @@ class BinScoreEvaluator(Evaluator):
         self.num_bins = num_bins
 
     def evaluate_arrays(self, y, pred, w=None):
+        if pred.prob is None:
+            raise ValueError(
+                "BinScoreEvaluator needs probability outputs; this model emits only "
+                "raw margins (e.g. LinearSVC) — calibrate it first")
         w = np.ones_like(y) if w is None else w
         s = pred.score
         bins = np.clip((s * self.num_bins).astype(int), 0, self.num_bins - 1)

@@ -1,0 +1,65 @@
+"""Model library — JAX-native estimators exposing (label RealNN, features OPVector) -> Prediction.
+
+Reference: core/stages/impl/{classification,regression} (SURVEY §2.9).  Each family is a
+native TPU implementation, not a wrapper: linear models fit by IRLS/Newton on the MXU,
+trees by binned histogram growth, the CV x grid sweep by vmapped device programs.
+
+Exports resolve lazily (PEP 562) so importing a submodule (e.g. models.prediction from
+the evaluators) never drags the whole model zoo in — that would be a circular import.
+"""
+
+_EXPORTS = {
+    "PredictionEstimatorBase": ".base",
+    "PredictionModelBase": ".base",
+    "PredictionColumn": ".prediction",
+    "LinearRegression": ".linear",
+    "LinearRegressionModel": ".linear",
+    "LogisticRegression": ".logistic",
+    "LogisticRegressionModel": ".logistic",
+    "MultinomialLogisticRegression": ".softmax",
+    "MultinomialLogisticRegressionModel": ".softmax",
+    "GeneralizedLinearRegression": ".glm",
+    "GLMModel": ".glm",
+    "NaiveBayes": ".naive_bayes",
+    "NaiveBayesModel": ".naive_bayes",
+    "LinearSVC": ".svm",
+    "LinearSVCModel": ".svm",
+    "MultilayerPerceptronClassifier": ".mlp",
+    "MLPClassifierModel": ".mlp",
+    "IsotonicRegressionCalibrator": ".isotonic",
+    "IsotonicCalibratorModel": ".isotonic",
+    "DecisionTreeClassifier": ".trees",
+    "DecisionTreeRegressor": ".trees",
+    "GradientBoostedTreesClassifier": ".trees",
+    "GradientBoostedTreesRegressor": ".trees",
+    "RandomForestClassifier": ".trees",
+    "RandomForestRegressor": ".trees",
+    "XGBoostClassifier": ".trees",
+    "XGBoostRegressor": ".trees",
+    "ModelSelector": ".selector",
+    "ModelSelectorSummary": ".selector",
+    "SelectedModel": ".selector",
+    "BinaryClassificationModelSelector": ".selector",
+    "MultiClassificationModelSelector": ".selector",
+    "RegressionModelSelector": ".selector",
+    "CrossValidator": ".tuning",
+    "TrainValidationSplit": ".tuning",
+    "DataSplitter": ".tuning",
+    "DataBalancer": ".tuning",
+    "DataCutter": ".tuning",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod, __name__), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -18,6 +18,14 @@ from ..types import OPVector, Prediction, RealNN
 from .prediction import PredictionColumn
 
 
+def softmax_probs(raw: np.ndarray) -> np.ndarray:
+    """Numerically-stable row softmax over logits/log-likelihoods (shared by all
+    multiclass models)."""
+    m = raw.max(axis=1, keepdims=True)
+    e = np.exp(raw - m)
+    return e / e.sum(axis=1, keepdims=True)
+
+
 class PredictionModelBase(Transformer):
     """Fitted model transformer: scores the feature vector; label input is optional."""
 

@@ -1,0 +1,115 @@
+"""Generalized linear regression — IRLS for exponential-family GLMs on device.
+
+Reference capability: core/.../regression/OpGeneralizedLinearRegression.scala (wrapping
+Spark GeneralizedLinearRegression: gaussian/binomial/poisson/gamma families with
+canonical links).
+
+TPU-first: one IRLS step is a weighted normal-equation solve — X^T W X assembles on the
+MXU; fixed iteration count under ``lax.fori_loop`` compiles the whole fit once per
+(family, shape) combination.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import Column
+from ..stages.base import Param
+from .base import PredictionEstimatorBase, PredictionModelBase
+from .prediction import PredictionColumn
+
+FAMILIES = ("gaussian", "binomial", "poisson", "gamma")
+
+
+def _family_funcs(family: str):
+    """(inverse link mu(eta), variance V(mu)) for the canonical-ish link used."""
+    if family == "gaussian":        # identity link
+        return (lambda eta: eta), (lambda mu: jnp.ones_like(mu))
+    if family == "binomial":        # logit link
+        return jax.nn.sigmoid, (lambda mu: mu * (1.0 - mu))
+    if family == "poisson":         # log link
+        return jnp.exp, (lambda mu: mu)
+    if family == "gamma":           # log link (Spark default for gamma is inverse;
+        return jnp.exp, (lambda mu: mu * mu)  # log is the numerically-safe choice)
+    raise ValueError(f"Unknown family {family!r}; expected one of {FAMILIES}")
+
+
+@partial(jax.jit, static_argnames=("family", "max_iter"))
+def _glm_core(x: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, reg: jnp.ndarray,
+              family: str, max_iter: int) -> jnp.ndarray:
+    """IRLS with log/logit/identity links; x has trailing ones column."""
+    inv_link, var_fn = _family_funcs(family)
+    n, d1 = x.shape
+    reg_mask = jnp.ones(d1).at[-1].set(0.0)
+
+    # working-response IRLS: eta = x beta; z = eta + (y - mu) * deta/dmu; W = V(mu)*(dmu/deta)^2 / V...
+    # with canonical links dmu/deta == V(mu) simplifies to W = V(mu)
+    def step(_, beta):
+        eta = x @ beta
+        mu = inv_link(eta)
+        v = jnp.maximum(var_fn(mu), 1e-8)
+        if family == "gaussian":
+            z = y
+            wrk = w
+        else:
+            z = eta + (y - mu) / v
+            wrk = w * v
+        a = (x.T * wrk) @ x + jnp.diag(reg * reg_mask + 1e-8) * wrk.sum()
+        b = x.T @ (wrk * z)
+        return jnp.linalg.solve(a, b)
+
+    beta0 = jnp.zeros(d1, dtype=x.dtype)
+    return jax.lax.fori_loop(0, max_iter, step, beta0)
+
+
+class GeneralizedLinearRegression(PredictionEstimatorBase):
+    """GLM regressor (OpGeneralizedLinearRegression capability)."""
+
+    family = Param(default="gaussian", validator=lambda v: v in FAMILIES)
+    reg_param = Param(default=0.0)
+    max_iter = Param(default=25)
+    fit_intercept = Param(default=True)
+
+    sweepable_params = ("reg_param",)
+
+    def _fit_arrays(self, x, y, w):
+        x = np.asarray(x, dtype=np.float32)
+        xs = np.hstack([x, np.ones((x.shape[0], 1), dtype=np.float32)]) \
+            if self.fit_intercept else x
+        y32 = np.asarray(y, dtype=np.float32)
+        if self.family in ("poisson", "gamma"):
+            y32 = np.maximum(y32, 1e-8)  # support constraint
+        # gaussian/identity IRLS converges in one solve — skip the redundant iterations
+        iters = 1 if self.family == "gaussian" else int(self.max_iter)
+        beta = np.asarray(_glm_core(
+            jnp.asarray(xs), jnp.asarray(y32), jnp.asarray(w),
+            jnp.float32(self.reg_param), str(self.family), iters))
+        if self.fit_intercept:
+            coef, intercept = beta[:-1], float(beta[-1])
+        else:
+            coef, intercept = beta, 0.0
+        return GLMModel(coef=coef.astype(np.float64), intercept=intercept,
+                        family=str(self.family))
+
+
+class GLMModel(PredictionModelBase):
+    def __init__(self, coef: np.ndarray, intercept: float, family: str = "gaussian",
+                 **kw):
+        super().__init__(**kw)
+        self.coef = np.asarray(coef, dtype=np.float64)
+        self.intercept = float(intercept)
+        self.family = family
+
+    def predict_column(self, vec: Column) -> PredictionColumn:
+        eta = vec.data.astype(np.float64) @ self.coef + self.intercept
+        if self.family == "binomial":
+            mu = 1.0 / (1.0 + np.exp(-eta))
+        elif self.family in ("poisson", "gamma"):
+            mu = np.exp(np.clip(eta, -30, 30))
+        else:
+            mu = eta
+        return PredictionColumn.regression(mu)

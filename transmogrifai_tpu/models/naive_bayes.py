@@ -1,0 +1,78 @@
+"""Naive Bayes — multinomial, on-device matmul scoring.
+
+Reference capability: core/.../classification/OpNaiveBayes.scala (wrapping Spark
+NaiveBayes, default modelType="multinomial", smoothing=1.0).
+
+TPU-first: fitting is two matmuls — per-class weighted feature sums are
+``onehot(y)^T @ (w * x)`` (MXU) and scoring is ``x @ log_theta^T + log_prior``.
+Negative feature values (z-scored slots) are shifted to non-negative per fit, matching
+multinomial NB's count semantics while keeping the whole vector usable.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import Column
+from ..stages.base import Param
+from .base import PredictionEstimatorBase, PredictionModelBase
+from .prediction import PredictionColumn
+
+
+@jax.jit
+def _nb_fit(x: jnp.ndarray, y_onehot: jnp.ndarray, w: jnp.ndarray,
+            smoothing: jnp.ndarray):
+    """(log_prior (C,), log_theta (C, d)) from non-negative features."""
+    wts = y_onehot * w[:, None]                     # (n, C)
+    class_w = wts.sum(axis=0)                       # (C,)
+    feat = wts.T @ x                                # (C, d)  MXU
+    theta = (feat + smoothing) / (feat.sum(axis=1, keepdims=True)
+                                  + smoothing * x.shape[1])
+    log_prior = jnp.log(class_w / jnp.maximum(class_w.sum(), 1e-12))
+    return log_prior, jnp.log(theta)
+
+
+class NaiveBayes(PredictionEstimatorBase):
+    """Multinomial Naive Bayes (OpNaiveBayes capability)."""
+
+    smoothing = Param(default=1.0)
+
+    def _fit_arrays(self, x, y, w):
+        x = np.asarray(x, dtype=np.float32)
+        active = np.asarray(w) > 0                  # zero-weight rows (CV validation
+        xa = x[active] if active.any() else x       # folds) must not leak into the fit
+        shift = np.minimum(xa.min(axis=0), 0.0)     # make counts non-negative
+        xs = x - shift
+        classes = np.unique(y)
+        y_onehot = (y[:, None] == classes[None, :]).astype(np.float32)
+        log_prior, log_theta = _nb_fit(
+            jnp.asarray(xs), jnp.asarray(y_onehot), jnp.asarray(w),
+            jnp.float32(self.smoothing))
+        return NaiveBayesModel(
+            classes=classes.astype(np.float64),
+            log_prior=np.asarray(log_prior, dtype=np.float64),
+            log_theta=np.asarray(log_theta, dtype=np.float64),
+            shift=shift.astype(np.float64))
+
+
+class NaiveBayesModel(PredictionModelBase):
+    def __init__(self, classes: np.ndarray, log_prior: np.ndarray,
+                 log_theta: np.ndarray, shift: np.ndarray, **kw):
+        super().__init__(**kw)
+        self.classes = np.asarray(classes, dtype=np.float64)
+        self.log_prior = np.asarray(log_prior, dtype=np.float64)
+        self.log_theta = np.asarray(log_theta, dtype=np.float64)
+        self.shift = np.asarray(shift, dtype=np.float64)
+
+    def predict_column(self, vec: Column) -> PredictionColumn:
+        from .base import softmax_probs
+
+        x = np.maximum(vec.data.astype(np.float64) - self.shift, 0.0)
+        raw = x @ self.log_theta.T + self.log_prior       # (n, C) joint log-likelihood
+        prob = softmax_probs(raw)
+        pred = self.classes[np.argmax(raw, axis=1)]
+        return PredictionColumn(pred, raw, prob)

@@ -45,9 +45,15 @@ class PredictionColumn(Column):
 
     @property
     def score(self) -> np.ndarray:
-        """Positive-class probability for binary problems, else the prediction."""
+        """Positive-class probability for binary problems, else the prediction.
+
+        Models without probabilities (LinearSVC) rank by the raw margin — Spark's
+        BinaryClassificationEvaluator does the same with rawPrediction.
+        """
         if self.prob is not None and self.prob.shape[1] == 2:
             return self.prob[:, 1]
+        if self.prob is None and self.raw is not None and self.raw.shape[1] == 2:
+            return self.raw[:, 1]
         return self.pred
 
     def present(self) -> np.ndarray:

@@ -183,8 +183,8 @@ class SelectedModel(PredictionModelBase):
 class BinaryClassificationModelSelector:
     """Reference: BinaryClassificationModelSelector.scala:49-150 defaults.
 
-    Model families currently available natively: LogisticRegression (IRLS).
-    RF/GBT/LinearSVC/NaiveBayes land with the tree/SVM milestones and register here.
+    Default candidates mirror the reference set: LogisticRegression, RandomForest,
+    GBT, LinearSVC (all native JAX implementations).
     """
 
     @staticmethod
@@ -210,6 +210,12 @@ class BinaryClassificationModelSelector:
             ]
             models.append((RandomForestClassifier(), rf_grid))
             models.append((GradientBoostedTreesClassifier(), gbt_grid))
+        except ImportError:
+            pass
+        try:
+            from .svm import LinearSVC
+
+            models.append((LinearSVC(), [{"reg_param": r} for r in (0.01, 0.1)]))
         except ImportError:
             pass
         return models
@@ -262,6 +268,12 @@ class MultiClassificationModelSelector:
                                                       for d in (3, 6)]))
         except ImportError:
             pass
+        try:
+            from .naive_bayes import NaiveBayes
+
+            models.append((NaiveBayes(), [{"smoothing": 1.0}]))
+        except ImportError:
+            pass
         return models
 
     @staticmethod
@@ -297,6 +309,14 @@ class RegressionModelSelector:
                                                      for d in (3, 6)]))
             models.append((GradientBoostedTreesRegressor(), [{"num_rounds": 50,
                                                               "max_depth": 3}]))
+        except ImportError:
+            pass
+        try:
+            from .glm import GeneralizedLinearRegression
+
+            models.append((GeneralizedLinearRegression(),
+                           [{"family": "gaussian", "reg_param": r}
+                            for r in (0.0, 0.01)]))
         except ImportError:
             pass
         return models

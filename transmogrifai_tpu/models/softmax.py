@@ -120,8 +120,7 @@ class MultinomialLogisticRegressionModel(PredictionModelBase):
         self.intercept = np.asarray(intercept, dtype=np.float64)
 
     def predict_column(self, vec: Column) -> PredictionColumn:
+        from .base import softmax_probs
+
         logits = vec.data.astype(np.float64) @ self.coef + self.intercept
-        z = logits - logits.max(axis=1, keepdims=True)
-        e = np.exp(z)
-        prob = e / e.sum(axis=1, keepdims=True)
-        return PredictionColumn.classification(logits, prob)
+        return PredictionColumn.classification(logits, softmax_probs(logits))
