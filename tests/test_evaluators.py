@@ -1,0 +1,149 @@
+"""Evaluator suite tests (SURVEY §2.11): threshold curves, BinScore, Forecast,
+multiclass threshold metrics — the parts beyond the core AuROC/AuPR already covered
+by selector/workflow tests."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators.base import (
+    BinaryClassificationEvaluator,
+    BinScoreEvaluator,
+    Evaluators,
+    ForecastEvaluator,
+    MultiClassificationEvaluator,
+)
+from transmogrifai_tpu.models.prediction import PredictionColumn
+
+
+def _binary_pred(n=500, seed=0, calibrated=True):
+    rng = np.random.default_rng(seed)
+    p = rng.uniform(0, 1, n)
+    y = (rng.random(n) < (p if calibrated else p ** 3)).astype(np.float64)
+    prob = np.column_stack([1 - p, p])
+    pred = (p > 0.5).astype(np.float64)
+    return PredictionColumn(pred, np.column_stack([-p, p]), prob), y
+
+
+class TestBinaryThresholdCurves:
+    def test_curves_shape_and_monotonicity(self):
+        pc, y = _binary_pred()
+        ev = BinaryClassificationEvaluator(num_thresholds=50)
+        m = ev.evaluate_arrays(y, pc)
+        assert len(m["thresholds"]) == 50
+        assert len(m["precisionByThreshold"]) == 50
+        # thresholds descend along the rank ordering; recall ascends
+        assert m["thresholds"][0] >= m["thresholds"][-1]
+        rec = m["recallByThreshold"]
+        assert all(b >= a - 1e-9 for a, b in zip(rec, rec[1:]))
+        fpr = m["falsePositiveRateByThreshold"]
+        assert all(0.0 <= v <= 1.0 for v in fpr)
+
+    def test_tied_scores_realizable_operating_points(self):
+        """All-tied scores admit exactly one operating point."""
+        y = np.array([1.0, 0.0, 1.0, 0.0])
+        s = np.full(4, 0.5)
+        pc = PredictionColumn((s > 0.5).astype(float),
+                              np.column_stack([1 - s, s]),
+                              np.column_stack([1 - s, s]))
+        m = BinaryClassificationEvaluator(num_thresholds=4).evaluate_arrays(y, pc)
+        assert all(p == pytest.approx(0.5) for p in m["precisionByThreshold"])
+        assert all(r == pytest.approx(1.0) for r in m["recallByThreshold"])
+
+    def test_curves_off_by_default(self):
+        pc, y = _binary_pred()
+        m = BinaryClassificationEvaluator().evaluate_arrays(y, pc)
+        assert "thresholds" not in m
+
+    def test_threshold_metrics_use_own_predictions(self):
+        """Margin-only models (SVC): error must match the model's pred, not score>0.5."""
+        y = np.array([0.0, 1.0, 1.0, 0.0])
+        margins = np.array([-3.0, 0.4, 2.0, -0.2])  # raw margins, NOT probabilities
+        pc = PredictionColumn((margins > 0).astype(np.float64),
+                              raw=np.column_stack([-margins, margins]), prob=None)
+        m = BinaryClassificationEvaluator().evaluate_arrays(y, pc)
+        assert m["error"] == pytest.approx(0.0)  # margins classify perfectly
+        assert m["auROC"] == pytest.approx(1.0)
+
+
+class TestBinScore:
+    def test_calibrated_scores_lie_on_diagonal(self):
+        pc, y = _binary_pred(n=5000, calibrated=True)
+        m = BinScoreEvaluator(num_bins=10).evaluate_arrays(y, pc)
+        avg_s = np.array(m["binAvgScores"])
+        avg_y = np.array(m["binAvgLabels"])
+        assert np.abs(avg_s - avg_y).mean() < 0.05
+        assert m["brierScore"] < 0.25
+
+    def test_miscalibrated_scores_deviate(self):
+        pc, y = _binary_pred(n=5000, calibrated=False)
+        m = BinScoreEvaluator(num_bins=10).evaluate_arrays(y, pc)
+        avg_s = np.array(m["binAvgScores"])
+        avg_y = np.array(m["binAvgLabels"])
+        assert np.abs(avg_s - avg_y).mean() > 0.1
+
+    def test_rejects_margin_only_models(self):
+        y = np.array([0.0, 1.0])
+        pc = PredictionColumn(np.array([0.0, 1.0]),
+                              raw=np.array([[1.0, -1.0], [-1.0, 1.0]]), prob=None)
+        with pytest.raises(ValueError, match="probability"):
+            BinScoreEvaluator().evaluate_arrays(y, pc)
+
+
+class TestForecast:
+    def test_mase_perfect_forecast(self):
+        y = np.sin(np.arange(100) / 5.0) + 2.0
+        pc = PredictionColumn(y.copy())
+        m = ForecastEvaluator(seasonal_period=1).evaluate_arrays(y, pc)
+        assert m["mase"] == pytest.approx(0.0, abs=1e-9)
+        assert m["seasonalError"] > 0
+
+    def test_mase_naive_forecast_is_one(self):
+        rng = np.random.default_rng(1)
+        y = rng.normal(0, 1, 200)
+        naive = np.concatenate([[y[0]], y[:-1]])  # lag-1 forecast
+        m = ForecastEvaluator(seasonal_period=1).evaluate_arrays(
+            y, PredictionColumn(naive))
+        # pred_mae over all rows vs naive_mae over n-1 rows: close to 1
+        assert m["mase"] == pytest.approx(1.0, rel=0.05)
+
+
+class TestMulticlassThresholds:
+    def test_threshold_metrics(self):
+        rng = np.random.default_rng(2)
+        n = 300
+        y = rng.integers(0, 3, n).astype(np.float64)
+        prob = rng.dirichlet([1, 1, 1], n)
+        pred = np.argmax(prob, axis=1).astype(np.float64)
+        pc = PredictionColumn(pred, prob.copy(), prob)
+        ev = MultiClassificationEvaluator(thresholds=(0.0, 0.5, 0.9))
+        m = ev.evaluate_arrays(y, pc)
+        tm = m["thresholdMetrics"]
+        for topn in (1, 3):
+            cc = tm["correctCounts"][topn]
+            ic = tm["incorrectCounts"][topn]
+            npred = tm["noPredictionCounts"][topn]
+            # counts partition the dataset at every threshold
+            for c, i, np_ in zip(cc, ic, npred):
+                assert c + i + np_ == pytest.approx(n)
+            # higher threshold -> no-prediction grows
+            assert npred[0] <= npred[1] <= npred[2]
+        # top-3 of 3 classes is always a hit among predicted rows
+        assert tm["incorrectCounts"][3][0] == pytest.approx(0.0)
+
+    def test_confusion_matrix_sums(self):
+        y = np.array([0.0, 1.0, 2.0, 1.0])
+        prob = np.eye(3)[[0, 1, 1, 2]]
+        pc = PredictionColumn(np.argmax(prob, 1).astype(float), prob, prob)
+        m = MultiClassificationEvaluator().evaluate_arrays(y, pc)
+        conf = np.array(m["confusion"])
+        assert conf.sum() == 4
+        assert conf[1, 1] == 1 and conf[1, 2] == 1
+
+
+class TestFactory:
+    def test_factory_constructors(self):
+        assert Evaluators.binary_classification("auROC").default_metric == "auROC"
+        assert Evaluators.multi_classification().problem == "multiclass"
+        assert Evaluators.regression("mae").default_metric == "mae"
+        assert Evaluators.forecast(seasonal_period=7).seasonal_period == 7
+        assert Evaluators.bin_score(20).num_bins == 20

@@ -202,6 +202,27 @@ class TestIsotonic:
         kx, ky = pav_fit(s, y, np.ones_like(y), increasing=False)
         assert (np.diff(ky) <= 1e-12).all()
 
+    def test_tied_scores_pool_to_mean(self):
+        """Spark parity: ties average before PAV (quantized model scores)."""
+        s = np.array([0.3, 0.3, 0.7])
+        y = np.array([0.0, 1.0, 1.0])
+        kx, ky = pav_fit(s, y, np.ones_like(y))
+        assert np.interp(0.3, kx, ky) == pytest.approx(0.5)
+
+    def test_gamma_family_mle(self):
+        """Gamma/log-link IRLS must hit the gamma GLM score equations, not the
+        canonical-link shortcut."""
+        rng = np.random.default_rng(7)
+        n = 4000
+        x = rng.normal(0, 0.5, (n, 2)).astype(np.float32)
+        mu = np.exp(0.7 * x[:, 0] - 0.3 * x[:, 1] + 1.0)
+        shape = 5.0
+        y = rng.gamma(shape, mu / shape)
+        m = GeneralizedLinearRegression(family="gamma")._fit_arrays(
+            x, y, np.ones(n, dtype=np.float32))
+        np.testing.assert_allclose(m.coef, [0.7, -0.3], atol=0.05)
+        assert m.intercept == pytest.approx(1.0, abs=0.05)
+
 
 class TestSelectorIntegration:
     def test_defaults_include_new_families(self):

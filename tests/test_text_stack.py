@@ -1,0 +1,201 @@
+"""Text stack tests (SURVEY §2.7): tokenizer + language detect, hashing TF,
+count vectorizer, n-grams, similarities, domain parsers, MIME sniffing."""
+
+import base64
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.ops.domains import (
+    EmailToPickList,
+    MimeTypeDetector,
+    PhoneNumberValidator,
+    UrlToDomainTransformer,
+    ValidEmailTransformer,
+    ValidUrlTransformer,
+    detect_mime_type,
+    parse_phone,
+)
+from transmogrifai_tpu.ops.text import (
+    CountVectorizer,
+    HashingTF,
+    JaccardSimilarity,
+    NGramSimilarity,
+    NGramTransformer,
+    StopWordsRemover,
+    TextLenTransformer,
+    TextTokenizer,
+)
+from transmogrifai_tpu.testkit import (
+    TestFeatureBuilder,
+    assert_estimator_spec,
+    assert_transformer_spec,
+)
+from transmogrifai_tpu.types import (
+    Base64,
+    Email,
+    MultiPickList,
+    Phone,
+    Text,
+    TextList,
+    URL,
+)
+from transmogrifai_tpu.utils.text import detect_language
+
+
+class TestTokenizer:
+    def test_basic_tokenize(self):
+        f, ds = TestFeatureBuilder.of("t", Text, ["Hello, World! 42", None])
+        stage = TextTokenizer()
+        stage.set_input(f)
+        out = assert_transformer_spec(stage, ds, expected=[["hello", "world", "42"], []])
+
+    def test_stopword_removal_auto_language(self):
+        f, ds = TestFeatureBuilder.of("t", Text, [
+            "the cat sat on the mat and the dog",
+            "el gato se sienta en la alfombra y el perro",
+        ])
+        stage = TextTokenizer(remove_stop_words=True)
+        stage.set_input(f)
+        out = stage.transform(ds)[stage.output_name]
+        assert "the" not in out.data[0] and "cat" in out.data[0]
+        assert "el" not in out.data[1] and "gato" in out.data[1]
+
+    def test_detect_language(self):
+        assert detect_language("the quick brown fox jumps over the lazy dog") == "en"
+        assert detect_language("le chat est sur la table avec le chien") == "fr"
+        assert detect_language("der Hund und die Katze sind nicht im Haus") == "de"
+        assert detect_language("") == "unknown"
+
+
+class TestVectorizers:
+    def test_hashing_tf(self):
+        f, ds = TestFeatureBuilder.of("toks", TextList,
+                                      [["a", "b", "a"], [], ["c"]])
+        stage = HashingTF(num_features=32)
+        stage.set_input(f)
+        out = assert_transformer_spec(stage, ds, check_row_parity=False)
+        assert out.data.shape == (3, 32)
+        assert out.data[0].sum() == 3.0  # counts, duplicate 'a' counted twice
+        assert out.data[1].sum() == 0.0
+
+    def test_hashing_tf_binary(self):
+        f, ds = TestFeatureBuilder.of("toks", TextList, [["a", "a", "a"]])
+        stage = HashingTF(num_features=16, binary=True)
+        stage.set_input(f)
+        out = stage.transform(ds)[stage.output_name]
+        assert out.data[0].sum() == 1.0
+
+    def test_count_vectorizer_vocab(self):
+        f, ds = TestFeatureBuilder.of(
+            "toks", TextList,
+            [["apple", "banana"], ["apple"], ["apple", "cherry"], []])
+        est = CountVectorizer(vocab_size=2, min_count=1)
+        est.set_input(f)
+        model = assert_estimator_spec(est, ds, check_row_parity=False)
+        assert model.vocab[0] == "apple"  # most frequent first
+        assert len(model.vocab) == 2
+        out = model.transform(ds)[model.output_name]
+        meta_names = [c.indicator_value for c in out.meta.columns]
+        assert "apple" in meta_names
+
+    def test_count_vectorizer_min_count(self):
+        f, ds = TestFeatureBuilder.of(
+            "toks", TextList, [["x", "y"], ["x"], ["x"]])
+        est = CountVectorizer(min_count=2)
+        est.set_input(f)
+        model = est.fit(ds)
+        assert model.vocab == ["x"]
+
+
+class TestNGramsAndSimilarity:
+    def test_ngram_transformer(self):
+        f, ds = TestFeatureBuilder.of("toks", TextList, [["a", "b", "c"], ["a"]])
+        stage = NGramTransformer(n=2)
+        stage.set_input(f)
+        assert_transformer_spec(stage, ds, expected=[["a b", "b c"], []])
+
+    def test_stopwords_remover(self):
+        f, ds = TestFeatureBuilder.of("toks", TextList, [["the", "cat"], None])
+        stage = StopWordsRemover(language="en")
+        stage.set_input(f)
+        assert_transformer_spec(stage, ds, expected=[["cat"], []])
+
+    def test_text_len(self):
+        f, ds = TestFeatureBuilder.of("t", Text, ["abc", None, ""])
+        stage = TextLenTransformer()
+        stage.set_input(f)
+        assert_transformer_spec(stage, ds, expected=[3, 0, 0])
+
+    def test_ngram_similarity(self):
+        feats, ds = TestFeatureBuilder.build(
+            {"a": ["hamburger", "abc", None], "b": ["hamburgers", "xyz", "q"]},
+            {"a": Text, "b": Text})
+        stage = NGramSimilarity(n=3)
+        stage.set_input(feats["a"], feats["b"])
+        out = stage.transform(ds)[stage.output_name]
+        vals = out.to_values()
+        assert vals[0] > 0.7      # near-identical strings
+        assert vals[1] == 0.0     # disjoint
+        assert vals[2] == 0.0     # null side
+
+    def test_jaccard_similarity(self):
+        feats, ds = TestFeatureBuilder.build(
+            {"a": [{"x", "y"}, set(), {"p"}], "b": [{"y", "z"}, set(), {"q"}]},
+            {"a": MultiPickList, "b": MultiPickList})
+        stage = JaccardSimilarity()
+        stage.set_input(feats["a"], feats["b"])
+        out = stage.transform(ds)[stage.output_name]
+        vals = out.to_values()
+        assert vals[0] == pytest.approx(1 / 3)
+        assert vals[1] == 1.0     # both empty -> identical (reference semantics)
+        assert vals[2] == 0.0
+
+
+class TestDomainParsers:
+    def test_phone_validity(self):
+        assert parse_phone("(650) 555-1234", "US") is True
+        assert parse_phone("123", "US") is False
+        assert parse_phone("+1 650 555 1234", "GB") is True   # intl prefix wins
+        assert parse_phone(None, "US") is None
+
+    def test_phone_stage(self):
+        f, ds = TestFeatureBuilder.of("p", Phone,
+                                      ["650-555-1234", "12", None])
+        stage = PhoneNumberValidator(default_region="US")
+        stage.set_input(f)
+        assert_transformer_spec(stage, ds, expected=[True, False, None])
+
+    def test_email(self):
+        f, ds = TestFeatureBuilder.of(
+            "e", Email, ["a.b@example.com", "not-an-email", None])
+        v = ValidEmailTransformer()
+        v.set_input(f)
+        assert_transformer_spec(v, ds, expected=[True, False, None])
+        d = EmailToPickList()
+        d.set_input(f)
+        assert_transformer_spec(d, ds, expected=["example.com", None, None])
+
+    def test_url(self):
+        f, ds = TestFeatureBuilder.of(
+            "u", URL, ["https://Docs.Example.com/x?q=1", "nope", None])
+        v = ValidUrlTransformer()
+        v.set_input(f)
+        assert_transformer_spec(v, ds, expected=[True, False, None])
+        d = UrlToDomainTransformer()
+        d.set_input(f)
+        assert_transformer_spec(d, ds, expected=["docs.example.com", None, None])
+
+    def test_mime_detection(self):
+        pdf = base64.b64encode(b"%PDF-1.4 rest of doc").decode()
+        png = base64.b64encode(b"\x89PNG\r\n\x1a\n123").decode()
+        txt = base64.b64encode(b"plain old text").decode()
+        assert detect_mime_type(pdf) == "application/pdf"
+        assert detect_mime_type(png) == "image/png"
+        assert detect_mime_type(txt) == "text/plain"
+        assert detect_mime_type("!!!notbase64!!!") is None
+        f, ds = TestFeatureBuilder.of("b", Base64, [pdf, png, None])
+        stage = MimeTypeDetector()
+        stage.set_input(f)
+        assert_transformer_spec(
+            stage, ds, expected=["application/pdf", "image/png", None])

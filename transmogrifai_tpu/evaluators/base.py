@@ -52,8 +52,11 @@ class BinaryClassificationEvaluator(Evaluator):
 
     problem = "binary"
 
-    def __init__(self, metric: str = "auPR"):
+    def __init__(self, metric: str = "auPR", num_thresholds: int = 0):
         self.default_metric = metric
+        #: >0 adds thresholds/precision/recall/fpr curve arrays to the output
+        #: (reference emits them always; opt-in here to keep metric dicts compact)
+        self.num_thresholds = num_thresholds
 
     def metric_fn(self):
         return M.METRICS_BINARY[self.default_metric]
@@ -69,7 +72,7 @@ class BinaryClassificationEvaluator(Evaluator):
         precision, recall, f1, error = (
             float(v) for v in M.precision_recall_f1(p, yj, wj)
         )
-        return {
+        out = {
             "auROC": float(M.au_roc(s, yj, wj)),
             "auPR": float(M.au_pr(s, yj, wj)),
             "precision": precision,
@@ -78,6 +81,13 @@ class BinaryClassificationEvaluator(Evaluator):
             "error": error,
             "tp": tp, "fp": fp, "tn": tn, "fn": fn,
         }
+        if self.num_thresholds > 0:
+            th, pr, rc, fpr = M.threshold_curves(s, yj, wj, self.num_thresholds)
+            out["thresholds"] = np.asarray(th).tolist()
+            out["precisionByThreshold"] = np.asarray(pr).tolist()
+            out["recallByThreshold"] = np.asarray(rc).tolist()
+            out["falsePositiveRateByThreshold"] = np.asarray(fpr).tolist()
+        return out
 
 
 class MultiClassificationEvaluator(Evaluator):
@@ -88,9 +98,12 @@ class MultiClassificationEvaluator(Evaluator):
 
     problem = "multiclass"
 
-    def __init__(self, metric: str = "error", top_ns=(1, 3)):
+    def __init__(self, metric: str = "error", top_ns=(1, 3), thresholds=()):
         self.default_metric = metric
         self.top_ns = top_ns
+        #: when non-empty, adds reference-style ThresholdMetrics: per (topN, threshold)
+        #: correct / incorrect / no-prediction counts (max prob below threshold)
+        self.thresholds = tuple(thresholds)
 
     def metric_fn(self):
         if self.default_metric == "error":
@@ -126,6 +139,23 @@ class MultiClassificationEvaluator(Evaluator):
         for topn in self.top_ns:
             hit = (order[:, :topn] == yi[:, None]).any(axis=1)
             out[f"top{topn}_accuracy"] = float((w * hit).sum() / sw)
+        if self.thresholds:
+            max_prob = prob.max(axis=1)
+            tm = {"topNs": list(self.top_ns), "thresholds": list(self.thresholds),
+                  "correctCounts": {}, "incorrectCounts": {},
+                  "noPredictionCounts": {}}
+            for topn in self.top_ns:
+                hit = (order[:, :topn] == yi[:, None]).any(axis=1)
+                cc, ic, npred = [], [], []
+                for t in self.thresholds:
+                    predicted = max_prob >= t
+                    cc.append(float((w * (predicted & hit)).sum()))
+                    ic.append(float((w * (predicted & ~hit)).sum()))
+                    npred.append(float((w * ~predicted).sum()))
+                tm["correctCounts"][topn] = cc
+                tm["incorrectCounts"][topn] = ic
+                tm["noPredictionCounts"][topn] = npred
+            out["thresholdMetrics"] = tm
         return out
 
 

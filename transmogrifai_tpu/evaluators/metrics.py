@@ -74,6 +74,31 @@ def log_loss(scores, y, w):
     return jnp.sum(w * ll) / jnp.maximum(jnp.sum(w), EPS)
 
 
+def threshold_curves(scores, y, w, num_thresholds: int = 100):
+    """(thresholds, precision, recall, fpr) sampled along the score range.
+
+    Reference: OpBinaryClassificationEvaluator.scala:109-118 (thresholds,
+    precisionByThreshold, recallByThreshold, falsePositiveRateByThreshold).  One device
+    program: sort once, sample ``num_thresholds`` evenly-spaced rank positions.
+    """
+    order = jnp.argsort(-scores)
+    ss = scores[order]
+    ys = y[order]
+    ws = w[order]
+    tp = jnp.cumsum(ws * ys)
+    fp = jnp.cumsum(ws * (1.0 - ys))
+    pos = jnp.maximum(tp[-1], EPS)
+    neg = jnp.maximum(fp[-1], EPS)
+    n = scores.shape[0]
+    idx = jnp.clip((jnp.arange(num_thresholds) * n) // num_thresholds, 0, n - 1)
+    th = ss[idx]
+    # collapse tied scores: counts at a threshold are those of the LAST row with that
+    # score, else the reported operating points are unrealizable by any threshold
+    last = jnp.searchsorted(-ss, -th, side="right") - 1
+    return (th, tp[last] / jnp.maximum(tp[last] + fp[last], EPS),
+            tp[last] / pos, fp[last] / neg)
+
+
 # --- regression --------------------------------------------------------------
 
 def mse(pred, y, w):

@@ -46,16 +46,22 @@ def _glm_core(x: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, reg: jnp.ndarray,
     n, d1 = x.shape
     reg_mask = jnp.ones(d1).at[-1].set(0.0)
 
-    # working-response IRLS: eta = x beta; z = eta + (y - mu) * deta/dmu; W = V(mu)*(dmu/deta)^2 / V...
-    # with canonical links dmu/deta == V(mu) simplifies to W = V(mu)
+    # working-response IRLS: z = eta + (y - mu) * deta/dmu,
+    # W = w * (dmu/deta)^2 / V(mu).  binomial(logit) and poisson(log) are canonical
+    # (dmu/deta == V), but gamma uses the NON-canonical log link (dmu/deta = mu,
+    # V = mu^2), giving W = w and z = eta + (y - mu)/mu.
     def step(_, beta):
         eta = x @ beta
         mu = inv_link(eta)
-        v = jnp.maximum(var_fn(mu), 1e-8)
         if family == "gaussian":
             z = y
             wrk = w
-        else:
+        elif family == "gamma":
+            mu_s = jnp.maximum(mu, 1e-8)
+            z = eta + (y - mu) / mu_s
+            wrk = w
+        else:  # canonical links: binomial, poisson
+            v = jnp.maximum(var_fn(mu), 1e-8)
             z = eta + (y - mu) / v
             wrk = w * v
         a = (x.T * wrk) @ x + jnp.diag(reg * reg_mask + 1e-8) * wrk.sum()

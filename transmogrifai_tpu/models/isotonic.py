@@ -23,6 +23,14 @@ def pav_fit(scores: np.ndarray, y: np.ndarray, w: np.ndarray, increasing: bool =
     """Weighted PAV: returns (x knots, fitted y values), both ascending in x."""
     order = np.argsort(scores, kind="stable")
     xs, ys, ws = scores[order], y[order].astype(np.float64), w[order].astype(np.float64)
+    # pool tied x first (Spark averages ties before PAV) so duplicate scores with
+    # different labels calibrate to their weighted mean
+    ux, inv = np.unique(xs, return_inverse=True)
+    if len(ux) < len(xs):
+        wsum = np.bincount(inv, weights=ws)
+        ysum = np.bincount(inv, weights=ys * ws)
+        xs, ws = ux, wsum
+        ys = ysum / np.maximum(wsum, 1e-300)
     if not increasing:
         ys = -ys
     # blocks as (sum_y*w, sum_w, x_first, x_last); merge while decreasing

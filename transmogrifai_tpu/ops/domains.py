@@ -1,0 +1,195 @@
+"""Domain-value parsers: phone, email, URL, MIME type.
+
+Reference: core/.../feature/PhoneNumberParser.scala:1-566 (libphonenumber validity by
+region), ValidEmailTransformer.scala, EmailToPickListMapTransformer, URL handling in
+dsl/RichTextFeature.scala, MimeTypeDetector.scala (Tika magic-byte sniffing for Base64).
+
+All host-side string analysis; outputs are Binary/PickList columns that vectorize
+downstream.  The phone validity table is a reduced libphonenumber: country calling
+codes + national number length ranges for the major regions (documented divergence:
+full per-region dial plans are out of scope).
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import re
+from typing import List, Optional
+
+import numpy as np
+
+from ..data.dataset import Column, Dataset
+from ..stages.base import Param, UnaryTransformer
+from ..types import Base64 as B64Type
+from ..types import Binary, Email, Phone, PickList, Text, URL
+
+# region -> (country calling code, min national digits, max national digits)
+_PHONE_PLANS = {
+    "US": ("1", 10, 10), "CA": ("1", 10, 10), "GB": ("44", 9, 10),
+    "DE": ("49", 6, 11), "FR": ("33", 9, 9), "ES": ("34", 9, 9),
+    "IT": ("39", 8, 11), "AU": ("61", 9, 9), "JP": ("81", 9, 10),
+    "CN": ("86", 10, 11), "IN": ("91", 10, 10), "BR": ("55", 10, 11),
+    "MX": ("52", 10, 10), "NL": ("31", 9, 9), "SE": ("46", 7, 9),
+}
+
+_EMAIL_RE = re.compile(
+    r"^[A-Za-z0-9.!#$%&'*+/=?^_`{|}~-]+@[A-Za-z0-9](?:[A-Za-z0-9-]{0,61}[A-Za-z0-9])?"
+    r"(?:\.[A-Za-z0-9](?:[A-Za-z0-9-]{0,61}[A-Za-z0-9])?)+$")
+
+_URL_RE = re.compile(
+    r"^(?:(?P<scheme>https?|ftp)://)"
+    r"(?P<host>[A-Za-z0-9](?:[A-Za-z0-9.-]*[A-Za-z0-9])?)"
+    r"(?::\d{1,5})?(?:[/?#].*)?$", re.IGNORECASE)
+
+
+def parse_phone(value: Optional[str], default_region: str = "US") -> Optional[bool]:
+    """Validity of a phone number for the region (PhoneNumberParser.validate)."""
+    if not value:
+        return None
+    digits = re.sub(r"[^\d+]", "", value)
+    if not digits or digits in ("+",):
+        return False
+    plan = _PHONE_PLANS.get(default_region.upper())
+    if digits.startswith("+"):
+        body = digits[1:]
+        for code, lo, hi in _PHONE_PLANS.values():
+            if body.startswith(code) and lo <= len(body) - len(code) <= hi:
+                return True
+        return False
+    if plan is None:
+        return 6 <= len(digits) <= 15  # ITU E.164 envelope
+    code, lo, hi = plan
+    if digits.startswith(code) and lo <= len(digits) - len(code) <= hi:
+        return True
+    return lo <= len(digits) <= hi
+
+
+def is_valid_email(value: Optional[str]) -> Optional[bool]:
+    if not value:
+        return None
+    return _EMAIL_RE.match(value) is not None
+
+
+def email_prefix(value: Optional[str]) -> Optional[str]:
+    if not value or not is_valid_email(value):
+        return None
+    return value.split("@", 1)[0]
+
+
+def email_domain(value: Optional[str]) -> Optional[str]:
+    if not value or not is_valid_email(value):
+        return None
+    return value.split("@", 1)[1].lower()
+
+
+def is_valid_url(value: Optional[str]) -> Optional[bool]:
+    if not value:
+        return None
+    m = _URL_RE.match(value)
+    return m is not None and "." in m.group("host")
+
+
+def url_domain(value: Optional[str]) -> Optional[str]:
+    if not value:
+        return None
+    m = _URL_RE.match(value)
+    if m is None or "." not in m.group("host"):
+        return None
+    return m.group("host").lower()
+
+
+_MAGIC = [
+    (b"%PDF", "application/pdf"),
+    (b"\x89PNG", "image/png"),
+    (b"\xff\xd8\xff", "image/jpeg"),
+    (b"GIF8", "image/gif"),
+    (b"PK\x03\x04", "application/zip"),
+    (b"\x1f\x8b", "application/gzip"),
+    (b"BM", "image/bmp"),
+    (b"RIFF", "audio/wav"),
+    (b"OggS", "audio/ogg"),
+    (b"<?xml", "application/xml"),
+    (b"{", "application/json"),
+    (b"<html", "text/html"),
+    (b"<!DOC", "text/html"),
+]
+
+
+def detect_mime_type(b64_value: Optional[str]) -> Optional[str]:
+    """Magic-byte MIME sniffing of base64 content (MimeTypeDetector/Tika capability)."""
+    if not b64_value:
+        return None
+    try:
+        head = base64.b64decode(b64_value[:64], validate=True)
+    except (binascii.Error, ValueError):
+        return None
+    for magic, mime in _MAGIC:
+        if head.startswith(magic):
+            return mime
+    try:
+        head.decode("ascii")
+        return "text/plain"
+    except UnicodeDecodeError:
+        return "application/octet-stream"
+
+
+# ---------------------------------------------------------------------------
+# Stages
+# ---------------------------------------------------------------------------
+
+class _UnaryValueTransformer(UnaryTransformer):
+    """Common shell: apply a module-level parse fn over one text-like column."""
+
+    _fn = None  # override
+
+    def transform_columns(self, cols: List[Column], dataset) -> Column:
+        fn = type(self)._fn
+        return Column.from_values(self.output_type,
+                                  [fn(v) for v in cols[0].data])
+
+
+class PhoneNumberValidator(_UnaryValueTransformer):
+    """Phone -> Binary validity (OpPhoneNumberParser capability)."""
+
+    input_types = (Phone,)
+    output_type = Binary
+
+    default_region = Param(default="US")
+
+    def transform_columns(self, cols: List[Column], dataset) -> Column:
+        region = self.default_region
+        return Column.from_values(
+            Binary, [parse_phone(v, region) for v in cols[0].data])
+
+
+class ValidEmailTransformer(_UnaryValueTransformer):
+    input_types = (Email,)
+    output_type = Binary
+    _fn = staticmethod(is_valid_email)
+
+
+class EmailToPickList(_UnaryValueTransformer):
+    """Email -> domain PickList (EmailToPickListMapTransformer capability)."""
+
+    input_types = (Email,)
+    output_type = PickList
+    _fn = staticmethod(email_domain)
+
+
+class ValidUrlTransformer(_UnaryValueTransformer):
+    input_types = (URL,)
+    output_type = Binary
+    _fn = staticmethod(is_valid_url)
+
+
+class UrlToDomainTransformer(_UnaryValueTransformer):
+    input_types = (URL,)
+    output_type = PickList
+    _fn = staticmethod(url_domain)
+
+
+class MimeTypeDetector(_UnaryValueTransformer):
+    input_types = (B64Type,)
+    output_type = PickList
+    _fn = staticmethod(detect_mime_type)

@@ -46,3 +46,51 @@ def ngrams(tokens: Sequence[str], n: int = 2) -> List[str]:
     if n <= 1:
         return list(tokens)
     return [" ".join(tokens[i:i + n]) for i in range(len(tokens) - n + 1)]
+
+
+def char_ngrams(text: str, n: int = 3) -> List[str]:
+    """Character n-grams (reference NGramSimilarity's Lucene char-ngram analyzer)."""
+    if len(text) < n:
+        return [text] if text else []
+    return [text[i:i + n] for i in range(len(text) - n + 1)]
+
+
+# per-language stopword cores for the frequency-overlap language heuristic
+# (reference uses the optimaize LanguageDetector; this is the same signal reduced
+# to the highest-frequency function words)
+_LANG_STOPWORDS = {
+    "en": frozenset("the and of to in is you that it he was for on are as with his "
+                    "they at be this have from or had by not but what all were we "
+                    "when your can said there use an each which she do how their "
+                    "if will up other about out many then them these so some her "
+                    "would make like him into time has look two more".split()),
+    "es": frozenset("de la que el en y a los del se las por un para con no una su "
+                    "al lo como más pero sus le ya o este sí porque esta entre "
+                    "cuando muy sin sobre también me hasta hay donde quien desde "
+                    "todo nos durante todos uno les ni contra otros".split()),
+    "fr": frozenset("de la le et les des en un du une que est pour qui dans a par "
+                    "plus pas au sur ne se ce il sont la avec son au ses mais "
+                    "comme ou si leur y dont elle deux ses tout nous sa".split()),
+    "de": frozenset("der die und in den von zu das mit sich des auf für ist im dem "
+                    "nicht ein eine als auch es an werden aus er hat dass sie nach "
+                    "wird bei einer um am sind noch wie einem über einen so zum".split()),
+}
+
+
+def detect_language(text: Optional[str]) -> str:
+    """Best-effort language id by stop-word overlap; 'unknown' when no signal."""
+    if not text:
+        return "unknown"
+    tokens = set(_TOKEN_RE.findall(text.lower()))
+    if not tokens:
+        return "unknown"
+    best, best_score = "unknown", 0
+    for lang, stops in _LANG_STOPWORDS.items():
+        score = len(tokens & stops)
+        if score > best_score:
+            best, best_score = lang, score
+    return best
+
+
+def stop_words_for(language: str) -> frozenset:
+    return _LANG_STOPWORDS.get(language, STOP_WORDS)
