@@ -1,0 +1,229 @@
+"""WorkflowRunner run types, OpParams config, App scaffold, CLI generator.
+
+Mirrors reference OpWorkflowRunnerTest (all run types end-to-end incl. save/load) and
+cli generator tests.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, Workflow, transmogrify
+from transmogrifai_tpu.evaluators.base import Evaluators
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.models.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.params import OpParams
+from transmogrifai_tpu.readers.files import DataReaders, StreamingReader
+from transmogrifai_tpu.workflow.runner import App, RunType, WorkflowRunner
+
+
+def _df(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, n)
+    c = rng.choice(["a", "b"], n)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(2 * x + (c == "a"))))).astype(float)
+    return pd.DataFrame({"label": y, "x": x, "c": c})
+
+
+def _workflow():
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    fx = FeatureBuilder.Real("x").extract_field().as_predictor()
+    fc = FeatureBuilder.PickList("c").extract_field().as_predictor()
+    vec = transmogrify([fx, fc])
+    checked = label.sanity_check(vec)
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+    pred = label.transform_with(sel, checked)
+    return Workflow().set_result_features(label, pred), pred
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("runner")
+    df = _df()
+    wf, pred = _workflow()
+    reader = DataReaders.Simple.dataframe(df)
+    runner = WorkflowRunner(workflow=wf, train_reader=reader,
+                            scoring_reader=reader,
+                            evaluator=Evaluators.binary_classification())
+    params = OpParams(model_location=str(tmp / "model"),
+                      metrics_location=str(tmp / "train_metrics.json"))
+    result = runner.run(RunType.TRAIN, params)
+    return runner, params, result, df, tmp
+
+
+class TestRunner:
+    def test_train_saves_model_and_metrics(self, trained):
+        runner, params, result, df, tmp = trained
+        assert os.path.isdir(params.model_location)
+        assert os.path.exists(params.metrics_location)
+        assert result.metrics["bestModelName"] == "LogisticRegression"
+        with open(params.metrics_location) as fh:
+            blob = json.load(fh)
+        assert blob["runType"] == "train"
+
+    def test_score(self, trained):
+        runner, params, result, df, tmp = trained
+        p = OpParams(model_location=params.model_location,
+                     write_location=str(tmp / "scores.csv"))
+        r = runner.run(RunType.SCORE, p)
+        assert r.metrics["auROC"] > 0.7
+        assert os.path.exists(p.write_location)
+        assert len(pd.read_csv(p.write_location)) == len(df)
+
+    def test_evaluate(self, trained):
+        runner, params, result, df, tmp = trained
+        r = runner.run(RunType.EVALUATE,
+                       OpParams(model_location=params.model_location))
+        assert "auPR" in r.metrics
+
+    def test_streaming_score(self, trained):
+        runner, params, result, df, tmp = trained
+        batches = [df.iloc[:100], df.iloc[100:200], df.iloc[200:]]
+        runner.streaming_reader = StreamingReader(
+            [DataReaders.Simple.dataframe(b) for b in batches])
+        r = runner.run(RunType.STREAMING_SCORE,
+                       OpParams(model_location=params.model_location,
+                                write_location=str(tmp / "stream.csv")))
+        assert r.metrics["batches"] == 3
+        assert os.path.exists(str(tmp / "stream_0.csv"))
+
+    def test_missing_model_location_raises(self, trained):
+        runner, *_ = trained
+        with pytest.raises(ValueError, match="model_location"):
+            runner.run(RunType.SCORE, OpParams())
+
+    def test_end_handler_called(self, trained):
+        runner, params, *_ = trained
+        seen = []
+        runner.add_application_end_handler(lambda r: seen.append(r.run_type))
+        runner.run(RunType.EVALUATE, OpParams(model_location=params.model_location))
+        assert seen == [RunType.EVALUATE]
+
+
+class TestOpParams:
+    def test_json_roundtrip(self, tmp_path):
+        p = OpParams(stage_params={"SanityChecker": {"max_correlation": 0.8}},
+                     model_location="/m", custom_params={"k": 1})
+        path = str(tmp_path / "p.json")
+        p.save(path)
+        q = OpParams.from_file(path)
+        assert q.stage_params == p.stage_params
+        assert q.model_location == "/m"
+
+    def test_simple_yaml(self):
+        p = OpParams.from_string(
+            "stageParams:\n  SanityChecker:\n    max_correlation: 0.8\n"
+            "modelLocation: /tmp/m\n")
+        assert p.stage_params["SanityChecker"]["max_correlation"] == 0.8
+        assert p.model_location == "/tmp/m"
+
+    def test_later_config_overrides_earlier_config(self):
+        """Only CODE-set params are protected; config can re-override config."""
+        from transmogrifai_tpu.checkers.sanity import SanityChecker
+
+        stage = SanityChecker()
+        OpParams(stage_params={"SanityChecker": {"max_correlation": 0.5}}) \
+            .apply_to_stages([stage])
+        OpParams(stage_params={"SanityChecker": {"max_correlation": 0.9}}) \
+            .apply_to_stages([stage])
+        assert stage.max_correlation == 0.9
+
+    def test_streaming_dataframe_batches(self, trained):
+        runner, params, result, df, tmp = trained
+        runner.streaming_reader = StreamingReader([df.iloc[:50], df.iloc[50:100]])
+        r = runner.run(RunType.STREAMING_SCORE,
+                       OpParams(model_location=params.model_location))
+        assert r.metrics["batches"] == 2
+
+    def test_code_wins_over_config(self):
+        from transmogrifai_tpu.checkers.sanity import SanityChecker
+
+        code_set = SanityChecker(max_correlation=0.7)
+        config_only = SanityChecker()
+        p = OpParams(stage_params={"SanityChecker": {"max_correlation": 0.5}})
+        p.apply_to_stages([code_set, config_only])
+        assert code_set.max_correlation == 0.7   # code wins
+        assert config_only.max_correlation == 0.5
+
+    def test_unknown_param_rejected(self):
+        from transmogrifai_tpu.checkers.sanity import SanityChecker
+
+        p = OpParams(stage_params={"SanityChecker": {"nope": 1}})
+        with pytest.raises(ValueError, match="no param"):
+            p.apply_to_stages([SanityChecker()])
+
+    def test_workflow_set_parameters(self):
+        wf, pred = _workflow()
+        p = OpParams(stage_params={"SanityChecker": {"max_correlation": 0.66}})
+        wf.set_parameters(p)
+        from transmogrifai_tpu.checkers.sanity import SanityChecker
+        from transmogrifai_tpu.workflow.dag import all_stages
+
+        sc = next(s for s in all_stages(wf.result_features)
+                  if isinstance(s, SanityChecker))
+        assert sc.max_correlation == 0.66
+
+
+class TestApp:
+    def test_app_main(self, trained, tmp_path):
+        runner, params, *_ = trained
+
+        class MyApp(App):
+            def runner(self, p):
+                return runner
+
+        r = MyApp().main(["--run-type", "evaluate",
+                          "--model-location", params.model_location])
+        assert "auPR" in r.metrics
+
+
+class TestCliGen:
+    def test_generate_and_run_project(self, tmp_path):
+        from transmogrifai_tpu.cli import detect_problem_kind, generate_project
+
+        csv = str(tmp_path / "data.csv")
+        _df(150, seed=3).to_csv(csv, index=False)
+        assert detect_problem_kind(csv, "label").value == "binary"
+        out, kind = generate_project(csv, "label", str(tmp_path / "proj"),
+                                     name="my-test-app")
+        assert kind.value == "binary"
+        assert os.path.exists(os.path.join(out, "main.py"))
+        assert os.path.exists(os.path.join(out, "README.md"))
+        # the generated project must actually train end-to-end
+        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "main.py", "--run-type", "train",
+             "--model-location", str(tmp_path / "m"),
+             "--metrics-location", str(tmp_path / "metrics.json")],
+            cwd=out, env=env, capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert os.path.exists(str(tmp_path / "metrics.json"))
+
+    def test_regression_detection(self, tmp_path):
+        from transmogrifai_tpu.cli import detect_problem_kind
+
+        csv = str(tmp_path / "r.csv")
+        pd.DataFrame({"y": np.random.default_rng(0).normal(0, 1, 100),
+                      "x": range(100)}).to_csv(csv, index=False)
+        assert detect_problem_kind(csv, "y").value == "regression"
+
+    def test_multiclass_detection(self, tmp_path):
+        from transmogrifai_tpu.cli import detect_problem_kind
+
+        csv = str(tmp_path / "m.csv")
+        pd.DataFrame({"y": [0, 1, 2] * 30, "x": range(90)}).to_csv(csv, index=False)
+        assert detect_problem_kind(csv, "y").value == "multiclass"
+
+    def test_bad_response_rejected(self, tmp_path):
+        from transmogrifai_tpu.cli import generate_project
+
+        csv = str(tmp_path / "d.csv")
+        _df(50).to_csv(csv, index=False)
+        with pytest.raises(ValueError, match="response"):
+            generate_project(csv, "nope", str(tmp_path / "p"))

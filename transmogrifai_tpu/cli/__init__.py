@@ -1,0 +1,12 @@
+"""CLI — the ``gen`` project generator (reference cli module, SURVEY §2.14).
+
+Reference: cli/.../CliExec.scala, gen/ProjectGenerator.scala, SchemaSource (Avro schema
+or CSV auto-inference), ProblemKind detection, templates/simple scaffold.
+
+Usage: ``python -m transmogrifai_tpu.cli gen --input data.csv --response label \
+--id id --output ./myproject --name MyApp``
+"""
+
+from .gen import ProblemKind, detect_problem_kind, generate_project, infer_schema
+
+__all__ = ["generate_project", "infer_schema", "detect_problem_kind", "ProblemKind"]

@@ -77,6 +77,11 @@ class StreamingReader:
         for batch in self.batches:
             if isinstance(batch, Dataset):
                 yield batch
+            elif isinstance(batch, Reader):
+                yield batch.generate_dataset(raw_features)
+            elif hasattr(batch, "columns") and hasattr(batch, "iloc"):
+                # pandas DataFrame: columnar fast path, not iteration over col names
+                yield DataFrameReader(batch).generate_dataset(raw_features)
             else:
                 yield rows_to_dataset(list(batch), raw_features)
 

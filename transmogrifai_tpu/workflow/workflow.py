@@ -31,11 +31,14 @@ class Workflow:
         self._raw_feature_filter = None
         self._blacklist: List[str] = []
         self._warm_models: Dict[str, Transformer] = {}
+        self._op_params = None
 
     # -- configuration -------------------------------------------------------
     def set_result_features(self, *features: Feature) -> "Workflow":
         self.result_features = list(features)
         self._validate_dag()
+        if self._op_params is not None:
+            self._op_params.apply_to_stages(all_stages(self.result_features))
         return self
 
     def set_input_dataset(self, ds: Dataset) -> "Workflow":
@@ -54,6 +57,14 @@ class Workflow:
     def with_model_stages(self, model: "WorkflowModel") -> "Workflow":
         """Warm-start: reuse fitted stages by uid (OpWorkflow.withModelStages :457-461)."""
         self._warm_models.update(model.fitted)
+        return self
+
+    def set_parameters(self, params) -> "Workflow":
+        """Inject OpParams stage overrides; params set in code win
+        (OpWorkflow.setStageParameters :166-188)."""
+        self._op_params = params
+        if self.result_features:
+            params.apply_to_stages(all_stages(self.result_features))
         return self
 
     # -- validation (reference OpWorkflow.scala:265-323) -----------------------
