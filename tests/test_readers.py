@@ -1,0 +1,190 @@
+"""Reader tests: custom/aggregate/conditional readers and typed joins.
+
+Reference test model: readers module suites (SURVEY §2.5, §4) — DataReader row
+generation, aggregate readers' leakage-safe cutoff semantics, and
+JoinedDataReader joins (JoinedDataReader.scala:1-442).
+"""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.aggregators.monoid import CutOffTime
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.readers.base import (
+    AggregateReader,
+    ConditionalReader,
+    CustomReader,
+)
+from transmogrifai_tpu.readers.joined import (
+    JoinedReader,
+    JoinType,
+    TimeBasedFilter,
+    TimeColumn,
+)
+
+
+def people_features():
+    name = FeatureBuilder.Text("name").extract(lambda r: r["name"]).as_predictor()
+    age = FeatureBuilder.Real("age").extract(lambda r: r["age"]).as_predictor()
+    return name, age
+
+
+PEOPLE = [
+    {"id": "a", "name": "ann", "age": 30.0},
+    {"id": "b", "name": "bob", "age": 40.0},
+    {"id": "c", "name": "cat", "age": 50.0},
+]
+
+PURCHASES = [
+    {"id": "a", "amount": 10.0, "t": 100},
+    {"id": "a", "amount": 5.0, "t": 200},
+    {"id": "b", "amount": 7.0, "t": 150},
+    {"id": "d", "amount": 99.0, "t": 300},
+]
+
+
+class TestAggregateReaders:
+    def test_aggregate_sums_events_per_key(self):
+        amount = (FeatureBuilder.Real("amount")
+                  .extract(lambda r: r["amount"]).as_predictor())
+        reader = AggregateReader(
+            CustomReader(lambda: PURCHASES, key_fn=lambda r: r["id"]),
+            key_fn=lambda r: r["id"], time_fn=lambda r: r["t"])
+        ds = reader.generate_dataset([amount])
+        # keys sorted: a, b, d — amounts monoid-summed per key
+        assert ds["amount"].to_values() == [15.0, 7.0, 99.0]
+
+    def test_aggregate_cutoff_excludes_late_predictors(self):
+        amount = (FeatureBuilder.Real("amount")
+                  .extract(lambda r: r["amount"]).as_predictor())
+        reader = AggregateReader(
+            CustomReader(lambda: PURCHASES, key_fn=lambda r: r["id"]),
+            key_fn=lambda r: r["id"], time_fn=lambda r: r["t"],
+            cutoff=CutOffTime.unix(150))
+        ds = reader.generate_dataset([amount])
+        # predictors fold events strictly before t=150: a keeps t=100 only, b none
+        vals = ds["amount"].to_values()
+        assert vals[0] == 10.0
+        assert vals[1] in (None, 0.0) or vals[1] is None
+
+    def test_conditional_reader_drops_keys_without_condition(self):
+        amount = (FeatureBuilder.Real("amount")
+                  .extract(lambda r: r["amount"]).as_predictor())
+        reader = ConditionalReader(
+            CustomReader(lambda: PURCHASES, key_fn=lambda r: r["id"]),
+            key_fn=lambda r: r["id"], time_fn=lambda r: r["t"],
+            condition_fn=lambda r: r["amount"] < 8.0)
+        ds = reader.generate_dataset([amount])
+        # only keys a (amount 5 @200) and b (7 @150) have a condition event
+        assert ds.n_rows == 2
+
+
+class TestJoinedReader:
+    def make_readers(self):
+        left = CustomReader(lambda: PEOPLE, key_fn=lambda r: r["id"])
+        right = CustomReader(lambda: PURCHASES, key_fn=lambda r: r["id"])
+        return left, right
+
+    def features(self):
+        name, age = people_features()
+        amount = (FeatureBuilder.Real("amount")
+                  .extract(lambda r: r["amount"]).as_predictor())
+        return name, age, amount
+
+    def test_inner_join_duplicates_left_rows(self):
+        name, age, amount = self.features()
+        left, right = self.make_readers()
+        ds = JoinedReader(left, right, ["name", "age"],
+                          JoinType.INNER).generate_dataset([name, age, amount])
+        # a matches 2 purchases, b matches 1, c none, d unmatched-right dropped
+        assert ds.n_rows == 3
+        assert sorted(ds["name"].to_values()) == ["ann", "ann", "bob"]
+        assert sorted(ds["amount"].to_values()) == [5.0, 7.0, 10.0]
+
+    def test_left_outer_fills_missing_right(self):
+        name, age, amount = self.features()
+        left, right = self.make_readers()
+        ds = JoinedReader(left, right, ["name", "age"],
+                          JoinType.LEFT_OUTER).generate_dataset([name, age, amount])
+        assert ds.n_rows == 4  # c kept with empty amount
+        rows = list(zip(ds["name"].to_values(), ds["amount"].to_values()))
+        assert ("cat", None) in rows
+
+    def test_full_outer_keeps_unmatched_right(self):
+        name, age, amount = self.features()
+        left, right = self.make_readers()
+        ds = JoinedReader(left, right, ["name", "age"],
+                          JoinType.FULL_OUTER).generate_dataset([name, age, amount])
+        assert ds.n_rows == 5  # + unmatched right key d
+        rows = list(zip(ds["name"].to_values(), ds["amount"].to_values()))
+        assert (None, 99.0) in rows
+
+    def test_missing_key_fn_raises(self):
+        name, age, amount = self.features()
+        left = CustomReader(lambda: PEOPLE)  # no key_fn
+        right = CustomReader(lambda: PURCHASES, key_fn=lambda r: r["id"])
+        with pytest.raises(ValueError, match="key_fn"):
+            JoinedReader(left, right, ["name", "age"]).generate_dataset(
+                [name, age, amount])
+
+    def test_join_with_conditional_right_side(self):
+        """Readers that drop keys (ConditionalReader) join on their kept keys only."""
+        name, age, amount = self.features()
+        left, _ = self.make_readers()
+        right = ConditionalReader(
+            CustomReader(lambda: PURCHASES, key_fn=lambda r: r["id"]),
+            key_fn=lambda r: r["id"], time_fn=lambda r: r["t"],
+            condition_fn=lambda r: r["amount"] < 8.0)  # keeps keys a, b only
+        ds = JoinedReader(left, right, ["name", "age"],
+                          JoinType.LEFT_OUTER).generate_dataset([name, age, amount])
+        assert ds.n_rows == 3
+        rows = dict(zip(ds["name"].to_values(), ds["amount"].to_values()))
+        assert rows["cat"] is None  # no conditional row for c
+
+    def test_secondary_aggregation_requires_time_columns(self):
+        name, age, amount = self.features()
+        left, right = self.make_readers()
+        reader = JoinedReader(left, right, ["name", "age"]).with_secondary_aggregation(
+            TimeBasedFilter(condition=TimeColumn("signup"), primary=TimeColumn("t")))
+        with pytest.raises(ValueError, match="time columns"):
+            reader.generate_dataset([name, age, amount])
+
+    def test_chained_left_deep_join(self):
+        name, age, amount = self.features()
+        visits = [{"id": "a", "visits": 3.0}, {"id": "c", "visits": 1.0}]
+        nvisits = (FeatureBuilder.Real("visits")
+                   .extract(lambda r: r["visits"]).as_predictor())
+        left, right = self.make_readers()
+        inner = JoinedReader(left, right, ["name", "age"], JoinType.LEFT_OUTER)
+        outer = JoinedReader(
+            inner, CustomReader(lambda: visits, key_fn=lambda r: r["id"]),
+            ["name", "age", "amount"], JoinType.LEFT_OUTER)
+        ds = outer.generate_dataset([name, age, amount, nvisits])
+        assert ds.n_rows == 4
+        rows = dict(zip(ds["name"].to_values(), ds["visits"].to_values()))
+        assert rows["ann"] == 3.0 and rows["cat"] == 1.0 and rows["bob"] is None
+
+
+class TestJoinedAggregateReader:
+    def test_secondary_aggregation_folds_child_rows(self):
+        name, age = people_features()
+        amount = (FeatureBuilder.Real("amount")
+                  .extract(lambda r: r["amount"]).as_predictor())
+        t = (FeatureBuilder.Date("t").extract(lambda r: r["t"]).as_predictor())
+        cutoff = (FeatureBuilder.Date("signup")
+                  .extract(lambda r: r.get("signup")).as_predictor())
+        people = [dict(p, signup=250) for p in PEOPLE]
+        left = CustomReader(lambda: people, key_fn=lambda r: r["id"])
+        right = CustomReader(lambda: PURCHASES, key_fn=lambda r: r["id"])
+        reader = JoinedReader(
+            left, right, ["name", "age", "signup"], JoinType.LEFT_OUTER,
+        ).with_secondary_aggregation(TimeBasedFilter(
+            condition=TimeColumn("signup"), primary=TimeColumn("t", keep=False)))
+        ds = reader.generate_dataset([name, age, amount, t, cutoff])
+        assert "t" not in ds.names
+        by_name = dict(zip(ds["name"].to_values(), ds["amount"].to_values()))
+        # one row per key; a's two purchases (both before signup=250) summed
+        assert ds.n_rows == 3
+        assert by_name["ann"] == 15.0
+        assert by_name["bob"] == 7.0
+        assert by_name["cat"] is None

@@ -21,6 +21,8 @@ from transmogrifai_tpu.params import OpParams
 from transmogrifai_tpu.readers.files import DataReaders, StreamingReader
 from transmogrifai_tpu.workflow.runner import App, RunType, WorkflowRunner
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def _df(n=300, seed=0):
     rng = np.random.default_rng(seed)
@@ -196,7 +198,7 @@ class TestCliGen:
         assert os.path.exists(os.path.join(out, "main.py"))
         assert os.path.exists(os.path.join(out, "README.md"))
         # the generated project must actually train end-to-end
-        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
         r = subprocess.run(
             [sys.executable, "main.py", "--run-type", "train",
              "--model-location", str(tmp_path / "m"),
@@ -219,6 +221,37 @@ class TestCliGen:
         csv = str(tmp_path / "m.csv")
         pd.DataFrame({"y": [0, 1, 2] * 30, "x": range(90)}).to_csv(csv, index=False)
         assert detect_problem_kind(csv, "y").value == "multiclass"
+
+    def test_string_label_detection(self, tmp_path):
+        from transmogrifai_tpu.cli import detect_problem_kind
+
+        csv = str(tmp_path / "s.csv")
+        pd.DataFrame({"y": ["cat", "dog", "bird"] * 30,
+                      "x": range(90)}).to_csv(csv, index=False)
+        assert detect_problem_kind(csv, "y").value == "multiclass"
+
+    def test_string_label_project_trains(self, tmp_path):
+        """String-labeled response: generator must label-encode, not crash at train."""
+        from transmogrifai_tpu.cli import generate_project
+
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=120)
+        df = pd.DataFrame({
+            "x": x,
+            "z": rng.normal(size=120),
+            "label": np.where(x + 0.3 * rng.normal(size=120) > 0, "yes", "no"),
+        })
+        csv = str(tmp_path / "s.csv")
+        df.to_csv(csv, index=False)
+        out, kind = generate_project(csv, "label", str(tmp_path / "proj"))
+        assert kind.value == "binary"
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "main.py", "--run-type", "train",
+             "--model-location", str(tmp_path / "m"),
+             "--metrics-location", str(tmp_path / "metrics.json")],
+            cwd=out, env=env, capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, r.stderr[-2000:]
 
     def test_bad_response_rejected(self, tmp_path):
         from transmogrifai_tpu.cli import generate_project

@@ -25,6 +25,9 @@ from .models.selector import (
 )
 from .evaluators.base import Evaluators
 from .readers.files import DataReaders
+from .readers.joined import (  # noqa: F401
+    JoinedReader, JoinType, TimeColumn, TimeBasedFilter,
+)
 from . import dsl  # noqa: F401 — attaches the rich-feature DSL methods
 
 __all__ = [

@@ -126,6 +126,10 @@ class AggregateReader(Reader):
         return self.inner.read_records()
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        return self.generate_dataset_with_keys(raw_features)[0]
+
+    def generate_dataset_with_keys(self, raw_features: Sequence[Feature]):
+        """(dataset, row keys) — aggregate readers emit one row per kept key."""
         gens = _generators(raw_features)
         by_key: Dict[str, List[Any]] = {}
         for r in self.read_records():
@@ -147,8 +151,7 @@ class AggregateReader(Reader):
                     window_ms=g.aggregate_window_ms,
                 ))
             cols[f.name] = Column.from_values(g.ftype, values)
-        out = Dataset(cols)
-        return out
+        return Dataset(cols), keys
 
 
 class ConditionalReader(AggregateReader):
@@ -165,10 +168,14 @@ class ConditionalReader(AggregateReader):
         self.drop_if_no_condition = drop_if_no_condition
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        return self.generate_dataset_with_keys(raw_features)[0]
+
+    def generate_dataset_with_keys(self, raw_features: Sequence[Feature]):
         gens = _generators(raw_features)
         by_key: Dict[str, List[Any]] = {}
         for r in self.read_records():
             by_key.setdefault(self.key_fn(r), []).append(r)
+        kept: List[str] = []
         cols_values: Dict[str, List[Any]] = {f.name: [] for f in raw_features}
         for k in sorted(by_key):
             recs = by_key[k]
@@ -179,6 +186,7 @@ class ConditionalReader(AggregateReader):
                 cutoff_ms = None
             else:
                 cutoff_ms = min(times)
+            kept.append(k)
             for f, g in zip(raw_features, gens):
                 events = [Event(self.time_fn(r), g.extract(r).value, g.is_response)
                           for r in recs]
@@ -192,4 +200,4 @@ class ConditionalReader(AggregateReader):
         return Dataset({
             f.name: Column.from_values(g.ftype, cols_values[f.name])
             for f, g in zip(raw_features, gens)
-        })
+        }), kept

@@ -19,7 +19,7 @@ from ..evaluators.base import Evaluator
 from ..features.feature import Feature
 from ..params import OpParams
 from .dag import all_stages
-from .workflow import Workflow, WorkflowModel
+from .workflow import Workflow, WorkflowModel, dedup_raw_features
 
 
 class RunType(enum.Enum):
@@ -127,9 +127,7 @@ class WorkflowRunner:
         model = self._load_model(params)
         if self.streaming_reader is None:
             raise ValueError("streaming_score run needs a streaming_reader")
-        raws = []
-        for f in model.result_features:
-            raws.extend(f.raw_features())
+        raws = dedup_raw_features(model.result_features)
         outs = []
         for i, batch in enumerate(self.streaming_reader.stream_datasets(raws)):
             scored = model.score(batch)

@@ -21,6 +21,19 @@ from .dag import all_stages, compute_dag, raw_feature_generators
 from .fit import fit_dag, transform_dag
 
 
+def dedup_raw_features(result_features: Sequence[Feature]) -> List[Feature]:
+    """All raw ancestors of the result features, deduplicated by uid.
+
+    Shared ancestors (e.g. a key column feeding both label and predictors) must be
+    extracted once, not once per result feature.
+    """
+    out: Dict[str, Feature] = {}
+    for f in result_features:
+        for r in f.raw_features():
+            out.setdefault(r.uid, r)
+    return list(out.values())
+
+
 class Workflow:
     """Lazy DAG of stages reached from the result features; ``train()`` fits it."""
 
@@ -77,11 +90,7 @@ class Workflow:
 
     # -- data ----------------------------------------------------------------
     def raw_features(self) -> List[Feature]:
-        out: Dict[str, Feature] = {}
-        for f in self.result_features:
-            for r in f.raw_features():
-                out.setdefault(r.uid, r)
-        return list(out.values())
+        return dedup_raw_features(self.result_features)
 
     def generate_raw_data(self) -> Dataset:
         if self._reader is not None:
@@ -118,6 +127,10 @@ class Workflow:
             blacklist=blacklist,
             rff_summary=rff_summary,
         )
+        # the fitted model inherits the workflow's reader (reference: OpWorkflowModel
+        # shares OpWorkflowCore state); override with set_reader for a scoring source
+        if self._reader is not None:
+            model.set_reader(self._reader)
 
         # holdout evaluation on the test reserve (reference HasTestEval semantics)
         if test_ds is not None and test_ds.n_rows > 0:
@@ -146,10 +159,8 @@ class WorkflowModel:
         if dataset is None:
             if self._reader is None:
                 raise ValueError("score() needs a dataset or a reader")
-            raws = []
-            for f in self.result_features:
-                raws.extend(f.raw_features())
-            dataset = self._reader.generate_dataset(raws)
+            dataset = self._reader.generate_dataset(
+                dedup_raw_features(self.result_features))
         out = transform_dag(dataset, self.result_features, self.fitted)
         if keep_intermediate:
             return out
