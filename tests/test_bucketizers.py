@@ -1,0 +1,138 @@
+"""DecisionTreeNumericBucketizer tests (reference DecisionTreeNumericBucketizerTest)."""
+
+import numpy as np
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.ops.bucketizers import (
+    DecisionTreeNumericBucketizer,
+    DecisionTreeNumericMapBucketizer,
+    find_tree_splits,
+)
+from transmogrifai_tpu.testkit.specs import assert_estimator_spec
+from transmogrifai_tpu.types import Real, RealMap, RealNN
+from transmogrifai_tpu.utils.vector_metadata import NULL_INDICATOR
+
+
+def _label():
+    return FeatureBuilder.of("label", RealNN).extract_field().as_response()
+
+
+class TestFindTreeSplits:
+    def test_perfect_split(self):
+        v = np.array([1.0, 2.0, 3.0, 10.0, 11.0, 12.0])
+        y = np.array([0, 0, 0, 1, 1, 1])
+        splits = find_tree_splits(v, y)
+        assert len(splits) >= 1
+        assert 3.0 <= splits[0] < 10.0  # separates the two groups
+
+    def test_no_signal_no_split(self):
+        rng = np.random.default_rng(0)
+        v = rng.normal(size=200)
+        y = rng.integers(0, 2, 200)
+        assert find_tree_splits(v, y, min_info_gain=0.05) == []
+
+    def test_constant_label_or_value(self):
+        v = np.array([1.0, 2.0, 3.0])
+        assert find_tree_splits(v, np.zeros(3)) == []
+        assert find_tree_splits(np.ones(3), np.array([0, 1, 0])) == []
+
+    def test_respects_max_depth(self):
+        # 4 clusters, alternating labels -> needs depth 2 for all 3 thresholds
+        v = np.concatenate([np.full(20, c) for c in [0.0, 10.0, 20.0, 30.0]])
+        y = np.concatenate([np.full(20, c) for c in [0, 1, 0, 1]])
+        assert len(find_tree_splits(v, y, max_depth=1)) == 1
+        assert len(find_tree_splits(v, y, max_depth=3)) == 3
+
+    def test_nan_values_dropped(self):
+        v = np.array([1.0, np.nan, 2.0, 10.0, np.nan, 11.0])
+        y = np.array([0, 1, 0, 1, 0, 1])
+        splits = find_tree_splits(v, y)
+        assert len(splits) == 1
+
+
+class TestDecisionTreeNumericBucketizer:
+    def _fixture(self):
+        label = _label()
+        x = FeatureBuilder.of("x", Real).extract_field().as_predictor()
+        vals = [1.0, 2.0, 3.0, None, 10.0, 11.0, 12.0, None]
+        ys = [0.0, 0.0, 0.0, 0.0, 1.0, 1.0, 1.0, 1.0]
+        ds = Dataset.from_features({"label": ys, "x": vals},
+                                   {"label": RealNN, "x": Real})
+        return label, x, ds
+
+    def test_fit_transform_and_spec(self):
+        label, x, ds = self._fixture()
+        stage = DecisionTreeNumericBucketizer()
+        out = label.transform_with(stage, x)
+        model = assert_estimator_spec(stage, ds)
+        col = model.transform(ds)[out.name]
+        # 2 buckets + null indicator
+        assert col.data.shape == (8, 3)
+        np.testing.assert_allclose(col.data.sum(axis=1), 1.0)  # one-hot rows
+        # nulls land in the null column
+        np.testing.assert_allclose(col.data[3], [0, 0, 1])
+        np.testing.assert_allclose(col.data[7], [0, 0, 1])
+        # low values bucket 0, high values bucket 1
+        assert col.data[0, 0] == 1.0 and col.data[4, 1] == 1.0
+        meta = col.meta
+        assert meta.columns[-1].indicator_value == NULL_INDICATOR
+
+    def test_no_split_collapses_to_null_indicator(self):
+        label = _label()
+        x = FeatureBuilder.of("x", Real).extract_field().as_predictor()
+        rng = np.random.default_rng(1)
+        ds = Dataset.from_features(
+            {"label": rng.integers(0, 2, 100).astype(float).tolist(),
+             "x": rng.normal(size=100).tolist()},
+            {"label": RealNN, "x": Real})
+        stage = DecisionTreeNumericBucketizer(min_info_gain=0.1)
+        out = label.transform_with(stage, x)
+        model = stage.fit(ds)
+        col = model.transform(ds)[out.name]
+        assert col.data.shape == (100, 1)  # only the null indicator
+        assert not model.should_split
+
+    def test_track_invalid(self):
+        label, x, ds = self._fixture()
+        stage = DecisionTreeNumericBucketizer(track_invalid=True)
+        label.transform_with(stage, x)
+        model = stage.fit(ds)
+        # +inf is invalid (finite check) -> OutOfBounds column
+        ds2 = Dataset.from_features({"label": [0.0], "x": [np.inf]},
+                                    {"label": RealNN, "x": Real})
+        col = model.transform(ds2)[model.output_name]
+        assert col.data.shape == (1, 4)  # 2 buckets + invalid + null
+        np.testing.assert_allclose(col.data[0], [0, 0, 1, 0])
+
+    def test_dsl_auto_bucketize(self):
+        label, x, ds = self._fixture()
+        out = x.auto_bucketize(label)
+        stage = out.origin_stage
+        assert isinstance(stage, DecisionTreeNumericBucketizer)
+        model = stage.fit(ds)
+        assert model.transform(ds)[out.name].data.shape[1] == 3
+
+
+class TestDecisionTreeNumericMapBucketizer:
+    def test_per_key_splits(self):
+        label = _label()
+        m = FeatureBuilder.of("m", RealMap).extract_field().as_predictor()
+        n = 40
+        ys = [float(i % 2) for i in range(n)]
+        maps = [{"signal": 5.0 + 10 * (i % 2), "noise": float((i * 7) % 13)}
+                for i in range(n)]
+        maps[0] = {"noise": 1.0}  # one row missing 'signal'
+        ds = Dataset.from_features({"label": ys, "m": maps},
+                                   {"label": RealNN, "m": RealMap})
+        stage = DecisionTreeNumericMapBucketizer(min_info_gain=0.05)
+        out = label.transform_with(stage, m)
+        model = stage.fit(ds)
+        col = model.transform(ds)[out.name]
+        # signal key: 2 buckets + null; noise key: null only
+        assert col.data.shape == (n, 4)
+        groupings = [c.grouping for c in col.meta.columns]
+        assert "signal" in groupings and "noise" in groupings
+        # the missing-signal row hits signal's null indicator
+        sig_null = [i for i, c in enumerate(col.meta.columns)
+                    if c.grouping == "signal" and c.indicator_value == NULL_INDICATOR][0]
+        assert col.data[0, sig_null] == 1.0

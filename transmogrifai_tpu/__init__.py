@@ -28,6 +28,7 @@ from .readers.files import DataReaders
 from .readers.joined import (  # noqa: F401
     JoinedReader, JoinType, TimeColumn, TimeBasedFilter,
 )
+from .ops import bucketizers  # noqa: F401 — registers decision-tree bucketizer stages
 from . import dsl  # noqa: F401 — attaches the rich-feature DSL methods
 
 __all__ = [

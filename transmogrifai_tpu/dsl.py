@@ -50,6 +50,18 @@ def _bucketize(self: Feature, splits: Sequence[float], track_nulls: bool = True)
         NumericBucketizer(splits=tuple(splits), track_nulls=track_nulls))
 
 
+def _auto_bucketize(self: Feature, label: Feature, track_nulls: bool = True,
+                    track_invalid: bool = False, min_info_gain: float = 0.01) -> Feature:
+    """Label-aware bucketing (reference RichNumericFeature.autoBucketize)."""
+    from .ops.bucketizers import DecisionTreeNumericBucketizer
+
+    return label.transform_with(
+        DecisionTreeNumericBucketizer(
+            track_nulls=track_nulls, track_invalid=track_invalid,
+            min_info_gain=min_info_gain),
+        self)
+
+
 def _map_to(self: Feature, fn: Callable, output_type: Type[FeatureType],
             name: Optional[str] = None) -> Feature:
     """Apply a per-value function (reference ``feature.map[T](fn)``)."""
@@ -83,6 +95,7 @@ Feature.pivot = _pivot
 Feature.fill_missing_with_mean = _fill_missing_with_mean
 Feature.z_normalize = _z_normalize
 Feature.bucketize = _bucketize
+Feature.auto_bucketize = _auto_bucketize
 Feature.map_to = _map_to
 Feature.alias = _alias
 Feature.sanity_check = _sanity_check
