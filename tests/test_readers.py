@@ -65,7 +65,7 @@ class TestAggregateReaders:
         # predictors fold events strictly before t=150: a keeps t=100 only, b none
         vals = ds["amount"].to_values()
         assert vals[0] == 10.0
-        assert vals[1] in (None, 0.0) or vals[1] is None
+        assert vals[1] is None  # empty aggregate stays empty, not zero-filled
 
     def test_conditional_reader_drops_keys_without_condition(self):
         amount = (FeatureBuilder.Real("amount")
@@ -163,6 +163,90 @@ class TestJoinedReader:
         assert ds.n_rows == 4
         rows = dict(zip(ds["name"].to_values(), ds["visits"].to_values()))
         assert rows["ann"] == 3.0 and rows["cat"] == 1.0 and rows["bob"] is None
+
+
+class TestJoinedReaderRegressions:
+    def test_one_sided_feature_request(self):
+        """Requesting only left-side features must not crash (scoring subsets)."""
+        name, age = people_features()
+        left = CustomReader(lambda: PEOPLE, key_fn=lambda r: r["id"])
+        right = CustomReader(lambda: PURCHASES, key_fn=lambda r: r["id"])
+        ds = JoinedReader(left, right, ["name", "age"],
+                          JoinType.INNER).generate_dataset([name, age])
+        # inner-join row multiplicity still applies even with no right columns
+        assert sorted(ds["name"].to_values()) == ["ann", "ann", "bob"]
+
+    def test_typoed_left_feature_name_raises(self):
+        name, age = people_features()
+        amount = (FeatureBuilder.Real("amount")
+                  .extract(lambda r: r["amount"]).as_predictor())
+        left = CustomReader(lambda: PEOPLE, key_fn=lambda r: r["id"])
+        right = CustomReader(lambda: PURCHASES, key_fn=lambda r: r["id"])
+        with pytest.raises(ValueError, match="typos"):
+            JoinedReader(left, right, ["Name", "age"]).generate_dataset(
+                [name, age, amount])
+
+    def test_absent_left_names_tolerated_for_subsets(self):
+        """Left names not in the request (scoring subsets) must not raise."""
+        amount = (FeatureBuilder.Real("amount")
+                  .extract(lambda r: r["amount"]).as_predictor())
+        left = CustomReader(lambda: PEOPLE, key_fn=lambda r: r["id"])
+        right = CustomReader(lambda: PURCHASES, key_fn=lambda r: r["id"])
+        ds = JoinedReader(left, right, ["name", "age"],
+                          JoinType.INNER).generate_dataset([amount])
+        assert sorted(ds["amount"].to_values()) == [5.0, 7.0, 10.0]
+
+    def test_join_keeps_dataframe_reader_cleaning(self):
+        """DataFrameReader sides keep their columnar NaN/dtype cleaning in joins."""
+        import pandas as pd
+
+        from transmogrifai_tpu.readers.base import DataFrameReader
+
+        from transmogrifai_tpu.types import Integral
+
+        age_int = (FeatureBuilder.of("age", Integral)
+                   .extract_field().as_predictor())
+        amount = (FeatureBuilder.Real("amount")
+                  .extract(lambda r: r["amount"]).as_predictor())
+        df = pd.DataFrame({"id": ["a", "b", "c"], "age": [30, None, 50]})
+        left = DataFrameReader(df, key_fn=lambda r: r["id"])
+        right = CustomReader(lambda: PURCHASES, key_fn=lambda r: r["id"])
+        ds = JoinedReader(left, right, ["age"],
+                          JoinType.LEFT_OUTER).generate_dataset([age_int, amount])
+        by = dict(zip(ds["age"].to_values(), ds["amount"].to_values()))
+        # pandas upcasts int+NaN to float64; the join must still yield clean Integrals
+        assert 30 in by and None in by
+        assert all(isinstance(a, int) for a in ds["age"].to_values() if a is not None)
+
+    def test_nested_aggregate_reader_still_aggregates(self):
+        """A JoinedAggregateReader nested in an outer join must keep its cutoff."""
+        name, age = people_features()
+        amount = (FeatureBuilder.Real("amount")
+                  .extract(lambda r: r["amount"]).as_predictor())
+        t = FeatureBuilder.Date("t").extract(lambda r: r["t"]).as_predictor()
+        signup = (FeatureBuilder.Date("signup")
+                  .extract(lambda r: r.get("signup")).as_predictor())
+        nvisits = (FeatureBuilder.Real("visits")
+                   .extract(lambda r: r["visits"]).as_predictor())
+        people = [dict(p, signup=250) for p in PEOPLE]
+        visits = [{"id": "a", "visits": 3.0}, {"id": "b", "visits": 2.0}]
+        left = CustomReader(lambda: people, key_fn=lambda r: r["id"])
+        right = CustomReader(lambda: PURCHASES, key_fn=lambda r: r["id"])
+        agg = JoinedReader(
+            left, right, ["name", "age", "signup"], JoinType.LEFT_OUTER,
+        ).with_secondary_aggregation(TimeBasedFilter(
+            condition=TimeColumn("signup"), primary=TimeColumn("t")))
+        outer = JoinedReader(
+            agg, CustomReader(lambda: visits, key_fn=lambda r: r["id"]),
+            ["name", "age", "signup", "amount", "t"], JoinType.LEFT_OUTER)
+        ds = outer.generate_dataset([name, age, signup, amount, t, nvisits])
+        by_name = dict(zip(ds["name"].to_values(), ds["amount"].to_values()))
+        # one row per key and NO post-cutoff leakage: ann keeps 10+5 (both < 250),
+        # if aggregation were skipped ann would appear twice
+        assert ds.n_rows == 3
+        assert by_name["ann"] == 15.0 and by_name["bob"] == 7.0
+        vis = dict(zip(ds["name"].to_values(), ds["visits"].to_values()))
+        assert vis["ann"] == 3.0 and vis["cat"] is None
 
 
 class TestJoinedAggregateReader:

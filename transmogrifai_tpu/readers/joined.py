@@ -117,6 +117,19 @@ class JoinedReader(Reader):
 
     # -- internals -----------------------------------------------------------
     def _partition(self, raw_features: Sequence[Feature]):
+        # A left name absent from the request is fine (scoring subsets request fewer
+        # features), but one that case-insensitively matches a requested feature is
+        # almost certainly a typo that would silently route the feature to the
+        # wrong reader.
+        names = {f.name for f in raw_features}
+        lowered = {n.lower(): n for n in names}
+        for unknown in self.left_feature_names - names:
+            hit = lowered.get(unknown.lower())
+            if hit is not None:
+                raise ValueError(
+                    f"left_feature_names entry {unknown!r} does not match any requested "
+                    f"raw feature but differs only in case from {hit!r} — check for "
+                    "typos; names must match exactly")
         lf = [f for f in raw_features if f.name in self.left_feature_names]
         rf = [f for f in raw_features if f.name not in self.left_feature_names]
         return lf, rf
@@ -133,10 +146,23 @@ class JoinedReader(Reader):
             # aggregate/conditional readers: one row per kept key (keys may drop)
             ds, keys = reader.generate_dataset_with_keys(features)
             return ds, [str(k) for k in keys]
-        records = list(reader.read_records())
-        keys = [str(reader.key_fn(r)) for r in records]
+        from .base import Reader as _BaseReader, rows_to_dataset
+
+        if type(reader).generate_dataset is _BaseReader.generate_dataset:
+            # record-based reader: one materialized pass — keys and rows come from
+            # the SAME read so a non-deterministic source (DB query, directory
+            # listing) cannot pair keys with the wrong rows, and the source is
+            # not read twice
+            records = list(reader.read_records())
+            keys = [str(reader.key_fn(r)) for r in records]
+            ds = rows_to_dataset(records, features)
+            return ds, keys
+        # columnar reader (e.g. DataFrameReader): keep its fast path + dtype/NaN
+        # cleaning; a second row pass for keys is deterministic over the
+        # materialized frame
         ds = reader.generate_dataset(features)
-        if ds.n_rows != len(keys):
+        keys = [str(reader.key_fn(r)) for r in reader.read_records()]
+        if features and ds.n_rows != len(keys):
             raise ValueError(
                 f"reader produced {ds.n_rows} rows for {len(keys)} keys")
         return ds, keys
@@ -174,9 +200,15 @@ class JoinedAggregateReader(JoinedReader):
         super().__init__(left, right, left_feature_names, join_type)
         self.time_filter = time_filter
 
-    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
-        joined, keys = self._generate_with_keys(raw_features)
-        lf, rf = self._partition(raw_features)
+    def _generate_with_keys(self, raw_features: Sequence[Feature]) -> Tuple[Dataset, List[str]]:
+        """Join, then fold child rows per key.
+
+        Overridden here (not just ``generate_dataset``) so the aggregation also runs
+        when this reader is nested as a side of another join — otherwise post-cutoff
+        child events would leak into the outer join's rows.
+        """
+        joined, keys = super()._generate_with_keys(raw_features)
+        lf, _ = self._partition(raw_features)
         gens = dict(zip([f.name for f in raw_features], _generators(raw_features)))
 
         cond_name = self.time_filter.condition.name
@@ -227,7 +259,10 @@ class JoinedAggregateReader(JoinedReader):
                     window_ms=self.time_filter.window_ms,
                 ))
             cols[f.name] = Column.from_values(g.ftype, out)
+        return Dataset(cols), ordered
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        ds, _ = self._generate_with_keys(raw_features)
         drop = [tc.name for tc in (self.time_filter.condition, self.time_filter.primary)
-                if not tc.keep and tc.name in cols]
-        ds = Dataset(cols)
+                if not tc.keep and tc.name in ds]
         return ds.drop(drop) if drop else ds
