@@ -1,0 +1,85 @@
+"""SelectedModelCombiner tests (reference SelectedModelCombinerTest)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder, Workflow
+from transmogrifai_tpu.models.combiner import SelectedModelCombiner
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.models.prediction import PredictionColumn
+from transmogrifai_tpu.models.selector import ModelSelector
+from transmogrifai_tpu.models.tuning import CrossValidator
+from transmogrifai_tpu.evaluators.base import BinaryClassificationEvaluator
+from transmogrifai_tpu.types import Prediction, Real, RealNN
+
+
+def _fixture(n=200, seed=3):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = ((x1 + 0.3 * rng.normal(size=n)) > 0).astype(float)
+    label = FeatureBuilder.of("y", RealNN).extract_field().as_response()
+    f1 = FeatureBuilder.of("x1", Real).extract_field().as_predictor()
+    f2 = FeatureBuilder.of("x2", Real).extract_field().as_predictor()
+    ds = Dataset.from_features(
+        {"y": y.tolist(), "x1": x1.tolist(), "x2": x2.tolist()},
+        {"y": RealNN, "x1": Real, "x2": Real})
+    return label, f1, f2, ds
+
+
+def _selector(seed):
+    ev = BinaryClassificationEvaluator()
+    return ModelSelector(
+        models=[(LogisticRegression(), [{"reg_param": 0.01}])],
+        validator=CrossValidator(ev, num_folds=2, seed=seed),
+        splitter=None)
+
+
+class TestSelectedModelCombiner:
+    def _trained(self, strategy):
+        label, f1, f2, ds = _fixture()
+        from transmogrifai_tpu import transmogrify
+
+        # strong model on x1 (signal), weak model on x2 (noise)
+        v1 = transmogrify([f1])
+        v2 = transmogrify([f2])
+        p1 = _selector(1).set_input(label, v1).get_output()
+        p2 = _selector(2).set_input(label, v2).get_output()
+        comb = SelectedModelCombiner(combination_strategy=strategy)
+        out = comb.set_input(label, p1, p2).get_output()
+        wf = Workflow().set_input_dataset(ds).set_result_features(label, out)
+        model = wf.train()
+        return model, out, comb, ds
+
+    def test_best_picks_stronger_side(self):
+        model, out, comb, ds = self._trained("best")
+        fitted = model.fitted[comb.uid]
+        assert fitted.weight1 == 1.0 and fitted.weight2 == 0.0
+        assert fitted.metric1 > fitted.metric2
+
+    def test_weighted_blends_probabilities(self):
+        model, out, comb, ds = self._trained("weighted")
+        fitted = model.fitted[comb.uid]
+        assert 0.5 < fitted.weight1 < 1.0
+        np.testing.assert_allclose(fitted.weight1 + fitted.weight2, 1.0)
+        col = model.score(ds)[out.name]
+        assert isinstance(col, PredictionColumn)
+        np.testing.assert_allclose(col.prob.sum(axis=1), 1.0, rtol=1e-6)
+
+    def test_equal_weights(self):
+        model, out, comb, ds = self._trained("equal")
+        fitted = model.fitted[comb.uid]
+        assert fitted.weight1 == fitted.weight2 == 0.5
+
+    def test_mismatched_problem_types_raise(self):
+        from transmogrifai_tpu.models.combiner import _combine
+
+        p_cls = PredictionColumn.classification(
+            np.zeros((3, 2)), np.full((3, 2), 0.5))
+        p_reg = PredictionColumn.regression(np.zeros(3))
+        with pytest.raises(ValueError, match="classifier with a regressor"):
+            _combine(p_cls, p_reg, 0.5, 0.5)
+
+    def test_bad_strategy_rejected(self):
+        with pytest.raises(ValueError, match="combination_strategy"):
+            SelectedModelCombiner(combination_strategy="median")

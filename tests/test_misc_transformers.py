@@ -1,0 +1,275 @@
+"""Misc transformer tests: Exists/Filter/Replace/Substring/ToOccur/DropIndicesBy,
+Scaler/Descaler, TimePeriod transformers, DateListVectorizer (SURVEY §2.7)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.ops.dates import (
+    ALL_TIME_PERIODS,
+    DateListVectorizer,
+    TimePeriodListTransformer,
+    TimePeriodMapTransformer,
+    TimePeriodTransformer,
+    extract_time_period,
+)
+from transmogrifai_tpu.ops.misc import (
+    DescalerTransformer,
+    DropIndicesByTransformer,
+    ExistsTransformer,
+    FilterTransformer,
+    ReplaceTransformer,
+    ScalerTransformer,
+    SubstringTransformer,
+    ToOccurTransformer,
+)
+from transmogrifai_tpu.testkit.specs import assert_transformer_spec
+from transmogrifai_tpu.types import (
+    Date,
+    DateList,
+    DateMap,
+    Real,
+    Text,
+)
+from transmogrifai_tpu.utils.vector_metadata import NULL_INDICATOR
+
+# 2018-06-13 11:00:00 UTC, a Wednesday
+WED_MS = 1528887600000
+_DAY = 24 * 3600 * 1000
+
+
+def _feat(name, ftype):
+    return FeatureBuilder.of(name, ftype).extract_field().as_predictor()
+
+
+def _is_none(v):
+    return v is None
+
+
+def _over_two(v):
+    return v is not None and v > 2.0
+
+
+class TestValueTransformers:
+    def test_exists(self):
+        f = _feat("x", Real)
+        t = ExistsTransformer(predicate=_over_two, input_type=Real)
+        f.transform_with(t)
+        ds = Dataset.from_features({"x": [1.0, 3.0, None]}, {"x": Real})
+        out = assert_transformer_spec(t, ds, expected=[False, True, False],
+                                      check_serde=False)
+
+    def test_filter_with_default(self):
+        f = _feat("x", Real)
+        t = FilterTransformer(predicate=_over_two, default=-1.0, input_type=Real)
+        f.transform_with(t)
+        ds = Dataset.from_features({"x": [1.0, 3.0, None]}, {"x": Real})
+        assert_transformer_spec(t, ds, expected=[-1.0, 3.0, -1.0], check_serde=False)
+
+    def test_replace(self):
+        f = _feat("s", Text)
+        t = ReplaceTransformer(input_type=Text, old_value="n/a", new_value=None)
+        f.transform_with(t)
+        ds = Dataset.from_features({"s": ["a", "n/a", "b"]}, {"s": Text})
+        assert_transformer_spec(t, ds, expected=["a", None, "b"])
+
+    def test_substring(self):
+        sub, full = _feat("sub", Text), _feat("full", Text)
+        t = SubstringTransformer()
+        sub.transform_with(t, full)
+        ds = Dataset.from_features(
+            {"sub": ["Cat", "dog", None], "full": ["concatenate", "bird", "x"]},
+            {"sub": Text, "full": Text})
+        assert_transformer_spec(t, ds, expected=[True, False, None])
+
+    def test_to_occur(self):
+        f = _feat("x", Real)
+        t = ToOccurTransformer(input_type=Real)
+        f.transform_with(t)
+        ds = Dataset.from_features({"x": [1.5, 0.0, None]}, {"x": Real})
+        assert_transformer_spec(t, ds, expected=[1.0, 0.0, 0.0], check_serde=False)
+
+
+class TestDropIndicesBy:
+    def test_drops_null_indicators(self):
+        a, b = _feat("a", Real), _feat("b", Real)
+        from transmogrifai_tpu.ops.numeric import NumericVectorizer
+
+        stage = NumericVectorizer()
+        vec = a.transform_with(stage, b)
+        ds = Dataset.from_features({"a": [1.0, None], "b": [2.0, 3.0]},
+                                   {"a": Real, "b": Real})
+        model = stage.fit(ds)
+        ds2 = model.transform(ds)
+        drop = DropIndicesByTransformer(
+            match_fn=lambda cm: cm.is_null_indicator)
+        vec2 = vec.transform_with(drop)
+        out = drop.transform(ds2)[vec2.name]
+        assert out.data.shape[1] == 2  # null columns gone
+        assert all(not c.is_null_indicator for c in out.meta.columns)
+        # index_in_vector re-assigned compactly
+        assert [c.index for c in out.meta.columns] == [0, 1]
+
+
+class TestScalerDescaler:
+    def test_linear_roundtrip(self):
+        f = _feat("x", Real)
+        scaler = ScalerTransformer(scaling_type="linear", slope=2.0, intercept=3.0)
+        scaled = f.transform_with(scaler)
+        pred = _feat("pred", Real)
+        descaler = DescalerTransformer()
+        out = pred.transform_with(descaler, scaled)
+        ds = Dataset.from_features({"x": [1.0, 2.0], "pred": [5.0, 7.0]},
+                                   {"x": Real, "pred": Real})
+        ds = scaler.transform(ds)
+        assert ds[scaled.name].to_values() == [5.0, 7.0]
+        got = descaler.transform(ds)[out.name]
+        assert got.to_values() == [1.0, 2.0]
+
+    def test_log_scaler(self):
+        f = _feat("x", Real)
+        scaler = ScalerTransformer(scaling_type="logarithmic")
+        scaled = f.transform_with(scaler)
+        ds = Dataset.from_features({"x": [float(np.e)]}, {"x": Real})
+        assert scaler.transform(ds)[scaled.name].to_values() == [1.0]
+
+    def test_descaler_requires_scaler_origin(self):
+        pred, other = _feat("pred", Real), _feat("other", Real)
+        descaler = DescalerTransformer()
+        pred.transform_with(descaler, other)
+        ds = Dataset.from_features({"pred": [1.0], "other": [2.0]},
+                                   {"pred": Real, "other": Real})
+        with pytest.raises(ValueError, match="ScalerTransformer"):
+            descaler.transform(ds)
+
+
+class TestTimePeriods:
+    def test_known_date_ordinals(self):
+        ms = np.array([WED_MS])
+        assert extract_time_period(ms, "DayOfWeek")[0] == 3  # Wednesday
+        assert extract_time_period(ms, "DayOfMonth")[0] == 13
+        assert extract_time_period(ms, "MonthOfYear")[0] == 6
+        assert extract_time_period(ms, "HourOfDay")[0] == 11
+        assert extract_time_period(ms, "DayOfYear")[0] == 164
+        # June 2018: the 1st was a Friday (Mon-start, minimal 1 day) -> 13th in week 3
+        assert extract_time_period(ms, "WeekOfMonth")[0] == 3
+
+    def test_all_periods_in_bounds(self):
+        rng = np.random.default_rng(0)
+        ms = rng.integers(0, 2_000_000_000_000, 500)
+        bounds = {"DayOfMonth": (1, 31), "DayOfWeek": (1, 7), "DayOfYear": (1, 366),
+                  "HourOfDay": (0, 23), "MonthOfYear": (1, 12),
+                  "WeekOfMonth": (1, 6), "WeekOfYear": (1, 54)}
+        for p in ALL_TIME_PERIODS:
+            vals = extract_time_period(ms, p)
+            lo, hi = bounds[p]
+            assert vals.min() >= lo and vals.max() <= hi, p
+
+    def test_time_period_transformer(self):
+        f = _feat("d", Date)
+        t = TimePeriodTransformer(period="DayOfWeek")
+        f.transform_with(t)
+        ds = Dataset.from_features({"d": [WED_MS, None]}, {"d": Date})
+        assert_transformer_spec(t, ds, expected=[3, None])
+
+    def test_time_period_map(self):
+        f = _feat("m", DateMap)
+        t = TimePeriodMapTransformer(period="MonthOfYear")
+        f.transform_with(t)
+        ds = Dataset.from_features({"m": [{"a": WED_MS}, None]}, {"m": DateMap})
+        out = t.transform(ds)[t.output_name]
+        assert out.to_values() == [{"a": 6}, {}]  # empty map stays empty
+
+    def test_time_period_list(self):
+        f = _feat("l", DateList)
+        t = TimePeriodListTransformer(period="HourOfDay", max_elements=4)
+        f.transform_with(t)
+        ds = Dataset.from_features({"l": [[WED_MS, WED_MS + 3600_000], None]},
+                                   {"l": DateList})
+        out = t.transform(ds)[t.output_name]
+        # pad slots are -1 so a padded slot can't pose as a real midnight event
+        np.testing.assert_allclose(out.data[0], [11, 12, -1, -1])
+        np.testing.assert_allclose(out.data[1], -1)
+
+    def test_time_period_list_warns_on_truncation(self):
+        f = _feat("l", DateList)
+        t = TimePeriodListTransformer(period="HourOfDay", max_elements=2)
+        f.transform_with(t)
+        ds = Dataset.from_features({"l": [[WED_MS, WED_MS, WED_MS]]},
+                                   {"l": DateList})
+        with pytest.warns(UserWarning, match="excess events"):
+            t.transform(ds)
+
+    def test_integral_output_roundtrips(self):
+        """TimePeriodTransformer output must re-materialize as Integral (int, not float)."""
+        from transmogrifai_tpu.data.dataset import Column
+        from transmogrifai_tpu.types import Integral
+
+        f = _feat("d", Date)
+        t = TimePeriodTransformer(period="DayOfWeek")
+        f.transform_with(t)
+        ds = Dataset.from_features({"d": [WED_MS, None]}, {"d": Date})
+        col = t.transform(ds)[t.output_name]
+        again = Column.from_values(Integral, col.to_values())
+        assert again.to_values() == [3, None]
+
+
+class TestDateListVectorizer:
+    def _ds(self):
+        return Dataset.from_features(
+            {"l": [[WED_MS - 5 * _DAY, WED_MS - 2 * _DAY], None]},
+            {"l": DateList})
+
+    def test_since_first_and_last(self):
+        f = _feat("l", DateList)
+        t = DateListVectorizer(pivot="SinceFirst", reference_date_ms=WED_MS)
+        f.transform_with(t)
+        out = t.transform(self._ds())[t.output_name]
+        np.testing.assert_allclose(out.data[0], [5.0, 0.0])  # days + null col
+        np.testing.assert_allclose(out.data[1], [0.0, 1.0])  # fill + null
+        t2 = DateListVectorizer(pivot="SinceLast", reference_date_ms=WED_MS)
+        _feat("l", DateList).transform_with(t2)
+        out2 = t2.transform(self._ds())[t2.output_name]
+        np.testing.assert_allclose(out2.data[0], [2.0, 0.0])
+
+    def test_mode_day_one_hot(self):
+        f = _feat("l", DateList)
+        # Friday + Friday + Monday -> mode Friday (dow 5)
+        ds = Dataset.from_features(
+            {"l": [[WED_MS + 2 * _DAY, WED_MS + 9 * _DAY, WED_MS + 5 * _DAY]]},
+            {"l": DateList})
+        t = DateListVectorizer(pivot="ModeDay")
+        f.transform_with(t)
+        out = t.transform(ds)[t.output_name]
+        assert out.data.shape == (1, 8)  # 7 days + null
+        assert out.data[0, 4] == 1.0  # Friday == index 4 (1-based dow 5)
+        assert out.meta.columns[-1].indicator_value == NULL_INDICATOR
+
+
+class TestRandomParamBuilder:
+    def test_distributions(self):
+        from transmogrifai_tpu.models.random_param import RandomParamBuilder
+
+        grids = (RandomParamBuilder(seed=7)
+                 .exponential("reg", 1e-4, 1e-1)
+                 .uniform("depth", 2, 8, integer=True)
+                 .subset("net", [0.0, 0.5, 1.0])
+                 .build(25))
+        assert len(grids) == 25
+        for g in grids:
+            assert 1e-4 <= g["reg"] <= 1e-1
+            assert isinstance(g["depth"], int) and 2 <= g["depth"] <= 8
+            assert g["net"] in (0.0, 0.5, 1.0)
+        # log-uniform: median should sit near the geometric mean, far below midpoint
+        regs = sorted(g["reg"] for g in grids)
+        assert regs[len(regs) // 2] < 0.02
+
+    def test_validation(self):
+        from transmogrifai_tpu.models.random_param import RandomParamBuilder
+
+        with pytest.raises(ValueError, match="less than max"):
+            RandomParamBuilder().uniform("a", 5, 2)
+        with pytest.raises(ValueError, match="0 < min"):
+            RandomParamBuilder().exponential("a", 0.0, 1.0)
+        with pytest.raises(ValueError, match="no param"):
+            RandomParamBuilder().build(3)

@@ -29,6 +29,8 @@ from .readers.joined import (  # noqa: F401
     JoinedReader, JoinType, TimeColumn, TimeBasedFilter,
 )
 from .ops import bucketizers  # noqa: F401 — registers decision-tree bucketizer stages
+from .ops import misc  # noqa: F401 — registers misc value transformers + scalers
+from .models import combiner as _combiner  # noqa: F401 — registers SelectedModelCombiner
 from . import dsl  # noqa: F401 — attaches the rich-feature DSL methods
 
 __all__ = [

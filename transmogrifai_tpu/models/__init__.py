@@ -42,6 +42,9 @@ _EXPORTS = {
     "BinaryClassificationModelSelector": ".selector",
     "MultiClassificationModelSelector": ".selector",
     "RegressionModelSelector": ".selector",
+    "RandomParamBuilder": ".random_param",
+    "SelectedModelCombiner": ".combiner",
+    "SelectedCombinerModel": ".combiner",
     "CrossValidator": ".tuning",
     "TrainValidationSplit": ".tuning",
     "DataSplitter": ".tuning",

@@ -1,41 +1,230 @@
-"""Date vectorization: unit-circle projection of time periods.
+"""Date stages: unit-circle projection, time-period extraction, date-list pivots.
 
-Reference: core/.../feature/DateToUnitCircleTransformer.scala — sin/cos of
-HourOfDay/DayOfWeek/DayOfMonth/DayOfYear so cyclic time is metrically smooth for models.
+Reference: core/.../feature/DateToUnitCircleTransformer.scala (sin/cos of cyclic time),
+features/.../feature/TimePeriod.scala (7 calendar periods), TimePeriodTransformer.scala,
+TimePeriodListTransformer.scala, TimePeriodMapTransformer.scala,
+DateListVectorizer.scala:1-309 (SinceFirst/SinceLast/ModeDay/ModeMonth/ModeHour pivots).
 """
 
 from __future__ import annotations
 
 import datetime as _dt
+import time as _time
 from typing import List
 
 import numpy as np
 
 from ..data.dataset import Column
-from ..stages.base import Param, SequenceTransformer
-from ..types import Date, OPVector
-from ..utils.vector_metadata import VectorColumnMetadata, VectorMetadata
+from ..stages.base import Param, SequenceTransformer, UnaryTransformer
+from ..types import Date, DateList, DateMap, Integral, IntegralMap, OPVector
+from ..utils.vector_metadata import (
+    NULL_INDICATOR,
+    VectorColumnMetadata,
+    VectorMetadata,
+)
 
 TIME_PERIODS = ("HourOfDay", "DayOfWeek", "DayOfMonth", "DayOfYear")
 _PERIOD_SIZE = {"HourOfDay": 24.0, "DayOfWeek": 7.0, "DayOfMonth": 31.0, "DayOfYear": 366.0}
 
 
 def _period_values(ms: np.ndarray, period: str) -> np.ndarray:
-    """Vectorized extraction of the period ordinal from epoch-millis (UTC)."""
+    """Period ordinal as float64, 0-based (angle convention for the unit circle)."""
+    vals = extract_time_period(ms, period).astype(np.float64)
+    if period in ("DayOfWeek", "DayOfMonth", "DayOfYear"):
+        vals -= 1.0  # extract_time_period is 1-based for these
+    return vals
+
+
+#: the 7 calendar periods of TimePeriod.scala:53-59 (java.time 1-based conventions)
+ALL_TIME_PERIODS = ("DayOfMonth", "DayOfWeek", "DayOfYear", "HourOfDay",
+                    "MonthOfYear", "WeekOfMonth", "WeekOfYear")
+
+
+def extract_time_period(ms: np.ndarray, period: str) -> np.ndarray:
+    """Vectorized calendar-period ordinal from epoch-millis (UTC).
+
+    Conventions match the reference's java.time extraction (TimePeriod.scala:53-59):
+    DayOfMonth 1-31, DayOfWeek 1=Mon..7=Sun, DayOfYear 1-366, HourOfDay 0-23,
+    MonthOfYear 1-12, WeekOfMonth/WeekOfYear with Monday-start weeks and minimal
+    1-day first week (WeekFields.of(MONDAY, 1)).
+    """
     secs = ms.astype("datetime64[ms]").astype("datetime64[s]")
     days = secs.astype("datetime64[D]")
     if period == "HourOfDay":
-        return ((secs - days).astype("timedelta64[h]").astype(np.float64)) % 24
+        return ((secs - days).astype("timedelta64[h]").astype(np.int64)) % 24
     if period == "DayOfWeek":
-        # 1970-01-01 is a Thursday; Monday=0
-        return ((days.astype(np.int64) + 3) % 7).astype(np.float64)
+        return ((days.astype(np.int64) + 3) % 7) + 1  # 1970-01-01 was a Thursday
     if period == "DayOfMonth":
-        months = days.astype("datetime64[M]")
-        return (days - months).astype(np.int64).astype(np.float64)  # 0-based
+        return (days - days.astype("datetime64[M]")).astype(np.int64) + 1
     if period == "DayOfYear":
-        years = days.astype("datetime64[Y]")
-        return (days - years).astype(np.int64).astype(np.float64)  # 0-based
+        return (days - days.astype("datetime64[Y]")).astype(np.int64) + 1
+    if period == "MonthOfYear":
+        return (days.astype("datetime64[M]").astype(np.int64) % 12) + 1
+    if period in ("WeekOfMonth", "WeekOfYear"):
+        unit = "M" if period == "WeekOfMonth" else "Y"
+        first = days.astype(f"datetime64[{unit}]").astype("datetime64[D]")
+        first_dow = (first.astype(np.int64) + 3) % 7  # Mon=0 of the 1st day
+        ordinal = (days - first).astype(np.int64)  # 0-based day within month/year
+        return (ordinal + first_dow) // 7 + 1
     raise ValueError(f"Unknown time period {period!r}")
+
+
+class TimePeriodTransformer(UnaryTransformer):
+    """Date -> Integral calendar ordinal (reference TimePeriodTransformer.scala)."""
+
+    input_types = (Date,)
+    output_type = Integral
+
+    period = Param(default="DayOfMonth", validator=lambda v: v in ALL_TIME_PERIODS)
+
+    def transform_columns(self, cols, dataset):
+        col = cols[0]
+        vals = extract_time_period(col.data.astype(np.int64), self.period)
+        return Column(Integral, vals.astype(np.int64), col.present())
+
+
+class TimePeriodListTransformer(UnaryTransformer):
+    """DateList -> OPVector of per-event ordinals (TimePeriodListTransformer.scala)."""
+
+    input_types = (DateList,)
+    output_type = OPVector
+
+    period = Param(default="DayOfMonth", validator=lambda v: v in ALL_TIME_PERIODS)
+    max_elements = Param(default=16, doc="static width: lists pad/truncate to this")
+    pad_value = Param(default=-1.0, doc="slot filler for missing events; -1 cannot "
+                                        "collide with any real ordinal (HourOfDay min is 0)")
+
+    def transform_columns(self, cols, dataset):
+        import warnings
+
+        f = self.inputs[0]
+        lists = cols[0].to_values()
+        width = self.max_elements
+        block = np.full((len(lists), width), float(self.pad_value), dtype=np.float32)
+        truncated = 0
+        for i, lst in enumerate(lists):
+            if not lst:
+                continue
+            if len(lst) > width:
+                truncated += 1
+            ms = np.asarray(lst[:width], dtype=np.int64)
+            block[i, :len(ms)] = extract_time_period(ms, self.period)
+        if truncated:
+            warnings.warn(
+                f"{type(self).__name__}: {truncated} rows had more than "
+                f"{width} events; excess events were dropped (raise max_elements)")
+        meta_cols = [VectorColumnMetadata(
+            f.name, f.ftype.__name__, grouping=f.name,
+            descriptor_value=f"{self.period}_{j}") for j in range(width)]
+        meta = VectorMetadata(self.output_name, meta_cols,
+                              {f.name: f.history().to_dict()}).reindexed()
+        return Column.vector(block, meta)
+
+
+class TimePeriodMapTransformer(UnaryTransformer):
+    """DateMap -> IntegralMap of ordinals (TimePeriodMapTransformer.scala)."""
+
+    input_types = (DateMap,)
+    output_type = IntegralMap
+
+    period = Param(default="DayOfMonth", validator=lambda v: v in ALL_TIME_PERIODS)
+
+    def transform_columns(self, cols, dataset):
+        out = []
+        for m in cols[0].to_values():
+            if not m:
+                out.append(None)
+            else:
+                ks = list(m)
+                ms = np.asarray([int(m[k]) for k in ks], dtype=np.int64)
+                ords = extract_time_period(ms, self.period)
+                out.append({k: int(o) for k, o in zip(ks, ords)})
+        return Column.from_values(IntegralMap, out)
+
+
+DATE_LIST_PIVOTS = ("SinceFirst", "SinceLast", "ModeDay", "ModeMonth", "ModeHour")
+_MODE_SPECS = {
+    # pivot -> (period, cardinality, 1-based)
+    "ModeDay": ("DayOfWeek", 7, True),
+    "ModeMonth": ("MonthOfYear", 12, True),
+    "ModeHour": ("HourOfDay", 24, False),
+}
+_DAY_MS = 24 * 3600 * 1000
+
+
+class DateListVectorizer(SequenceTransformer):
+    """Pivot DateList features (reference DateListVectorizer.scala:103-309).
+
+    SinceFirst/SinceLast: days between the first/last event and ``reference_date``.
+    ModeDay/ModeMonth/ModeHour: one-hot of the modal weekday/month/hour.
+    """
+
+    sequence_input_type = DateList
+    output_type = OPVector
+
+    pivot = Param(default="SinceFirst", validator=lambda v: v in DATE_LIST_PIVOTS)
+    fill_value = Param(default=0.0, doc="SinceFirst/SinceLast value for empty lists")
+    reference_date_ms = Param(default=None, doc="epoch millis; None = now at transform")
+    track_nulls = Param(default=True)
+
+    def _since_block(self, lists, ref_ms: int, first: bool):
+        out = np.full(len(lists), float(self.fill_value))
+        present = np.zeros(len(lists), dtype=np.bool_)
+        for i, lst in enumerate(lists):
+            if lst:
+                t = min(lst) if first else max(lst)
+                out[i] = (ref_ms - int(t)) / _DAY_MS
+                present[i] = True
+        return out, present
+
+    def _mode_block(self, lists, pivot: str):
+        period, card, one_based = _MODE_SPECS[pivot]
+        n = len(lists)
+        block = np.zeros((n, card), dtype=np.float32)
+        present = np.zeros(n, dtype=np.bool_)
+        for i, lst in enumerate(lists):
+            if not lst:
+                continue
+            ords = extract_time_period(np.asarray(lst, dtype=np.int64), period)
+            vals, counts = np.unique(ords, return_counts=True)
+            mode = int(vals[np.argmax(counts)]) - (1 if one_based else 0)
+            block[i, mode] = 1.0
+            present[i] = True
+        return block, present
+
+    def transform_columns(self, cols: List[Column], dataset):
+        ref_ms = self.reference_date_ms
+        if ref_ms is None:
+            ref_ms = int(_time.time() * 1000)
+        blocks: List[np.ndarray] = []
+        meta_cols: List[VectorColumnMetadata] = []
+        for f, col in zip(self.inputs, cols):
+            lists = col.to_values()
+            if self.pivot in ("SinceFirst", "SinceLast"):
+                vals, present = self._since_block(
+                    lists, ref_ms, first=self.pivot == "SinceFirst")
+                blocks.append(vals[:, None].astype(np.float32))
+                meta_cols.append(VectorColumnMetadata(
+                    f.name, f.ftype.__name__, grouping=f.name,
+                    descriptor_value=self.pivot))
+            else:
+                block, present = self._mode_block(lists, self.pivot)
+                blocks.append(block)
+                period, card, one_based = _MODE_SPECS[self.pivot]
+                lo = 1 if one_based else 0
+                for j in range(card):
+                    meta_cols.append(VectorColumnMetadata(
+                        f.name, f.ftype.__name__, grouping=f.name,
+                        indicator_value=f"{period}_{j + lo}"))
+            if self.track_nulls:
+                blocks.append((~present).astype(np.float32)[:, None])
+                meta_cols.append(VectorColumnMetadata(
+                    f.name, f.ftype.__name__, grouping=f.name,
+                    indicator_value=NULL_INDICATOR))
+        meta = VectorMetadata(self.output_name, meta_cols,
+                              {f.name: f.history().to_dict() for f in self.inputs},
+                              ).reindexed()
+        return Column.vector(np.hstack(blocks), meta)
 
 
 class DateToUnitCircleVectorizer(SequenceTransformer):
