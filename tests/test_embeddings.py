@@ -1,0 +1,102 @@
+"""Word2Vec + LDA embedding stages (SURVEY §2.7: OpWord2Vec, OpLDA)."""
+
+import numpy as np
+
+from transmogrifai_tpu.ops.embeddings import LDA, LDAModel, Word2Vec, Word2VecModel
+from transmogrifai_tpu.testkit import TestFeatureBuilder, assert_estimator_spec
+from transmogrifai_tpu.types import TextList
+
+CORPUS = [
+    ["cat", "dog", "cat", "pet"],
+    ["dog", "pet", "leash", "walk"],
+    ["cat", "pet", "purr"],
+    ["stock", "market", "trade"],
+    ["market", "trade", "price", "stock"],
+    ["price", "stock", "dividend"],
+    [],
+]
+
+
+def _fixture():
+    return TestFeatureBuilder.of("doc", TextList, CORPUS)
+
+
+class TestWord2Vec:
+    def test_spec_and_shapes(self):
+        f, ds = _fixture()
+        est = Word2Vec(embedding_dim=8, window_size=2, epochs=2).set_input(f)
+        model = assert_estimator_spec(est, ds, check_row_parity=False)
+        out = model.transform(ds)[model.output_name]
+        block = np.asarray(out.data)
+        assert block.shape == (len(CORPUS), 8)
+        # empty doc -> zero vector
+        np.testing.assert_allclose(block[-1], 0.0)
+
+    def test_doc_vector_is_mean_of_word_vectors(self):
+        f, ds = _fixture()
+        model = Word2Vec(embedding_dim=4, epochs=1).set_input(f).fit(ds)
+        vecs = {t: model.vectors[j] for j, t in enumerate(model.vocab)}
+        block = np.asarray(model.transform(ds)[model.output_name].data)
+        expect = np.mean([vecs["cat"], vecs["dog"], vecs["cat"], vecs["pet"]], axis=0)
+        np.testing.assert_allclose(block[0], expect, rtol=1e-5)
+
+    def test_min_count_filters_vocab(self):
+        f, ds = _fixture()
+        model = Word2Vec(embedding_dim=4, min_count=2, epochs=1).set_input(f).fit(ds)
+        assert "purr" not in model.vocab  # appears once
+        assert "cat" in model.vocab
+
+    def test_similar_words_closer_than_dissimilar(self):
+        # pets cluster vs finance cluster after enough epochs on a tiny corpus
+        f, ds = TestFeatureBuilder.of("doc", TextList, CORPUS[:-1] * 20)
+        model = Word2Vec(embedding_dim=16, window_size=3, epochs=10,
+                         learning_rate=0.1).set_input(f).fit(ds)
+        v = {t: model.vectors[j] for j, t in enumerate(model.vocab)}
+
+        def cos(a, b):
+            return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+
+        assert cos(v["cat"], v["dog"]) > cos(v["cat"], v["stock"])
+
+    def test_empty_corpus(self):
+        f, ds = TestFeatureBuilder.of("doc", TextList, [[], []])
+        model = Word2Vec(embedding_dim=4).set_input(f).fit(ds)
+        assert isinstance(model, Word2VecModel)
+        block = np.asarray(model.transform(ds)[model.output_name].data)
+        assert block.shape[0] == 2
+
+
+class TestLDA:
+    def test_spec_and_simplex_output(self):
+        f, ds = _fixture()
+        est = LDA(k=3, max_iter=10).set_input(f)
+        model = assert_estimator_spec(est, ds, check_row_parity=False)
+        block = np.asarray(model.transform(ds)[model.output_name].data)
+        assert block.shape == (len(CORPUS), 3)
+        np.testing.assert_allclose(block.sum(axis=1), 1.0, rtol=1e-4)
+        assert (block >= 0).all()
+
+    def test_topics_separate_clusters(self):
+        f, ds = TestFeatureBuilder.of("doc", TextList, CORPUS[:-1] * 10)
+        model = LDA(k=2, max_iter=30).set_input(f).fit(ds)
+        block = np.asarray(model.transform(ds)[model.output_name].data)
+        pet_topic = block[0].argmax()
+        fin_topic = block[3].argmax()
+        assert pet_topic != fin_topic
+        # docs in the same cluster share the dominant topic
+        assert block[2].argmax() == pet_topic
+        assert block[4].argmax() == fin_topic
+
+    def test_empty_corpus_uniform(self):
+        f, ds = TestFeatureBuilder.of("doc", TextList, [[], []])
+        model = LDA(k=4).set_input(f).fit(ds)
+        assert isinstance(model, LDAModel)
+        block = np.asarray(model.transform(ds)[model.output_name].data)
+        np.testing.assert_allclose(block, 0.25)
+
+    def test_metadata_topic_columns(self):
+        f, ds = _fixture()
+        model = LDA(k=3, max_iter=5).set_input(f).fit(ds)
+        out = model.transform(ds)[model.output_name]
+        descs = [c.descriptor_value for c in out.meta.columns]
+        assert descs == ["topic_0", "topic_1", "topic_2"]

@@ -1,0 +1,119 @@
+"""NameEntityRecognizer + sentence splitter (SURVEY §2.7/§2.13 NER stack)."""
+
+from transmogrifai_tpu.ops.ner import (
+    DATE,
+    LOCATION,
+    MONEY,
+    ORGANIZATION,
+    PERCENTAGE,
+    PERSON,
+    TIME,
+    NameEntityRecognizer,
+    RuleNameEntityTagger,
+    ner_tokenize,
+)
+from transmogrifai_tpu.testkit import TestFeatureBuilder, assert_transformer_spec
+from transmogrifai_tpu.types import MultiPickListMap, Text
+from transmogrifai_tpu.utils.text import split_sentences
+
+
+class TestSentenceSplitter:
+    def test_basic_split(self):
+        s = split_sentences("One sentence. Another one! A third?")
+        assert s == ["One sentence.", "Another one!", "A third?"]
+
+    def test_abbreviations_not_boundaries(self):
+        s = split_sentences("Dr. Smith from Acme Inc. arrived. He left.")
+        assert len(s) == 2
+        assert s[0].startswith("Dr. Smith")
+
+    def test_decimals_and_initials(self):
+        s = split_sentences("Pi is 3.14 roughly. J. Doe agrees.")
+        assert s == ["Pi is 3.14 roughly.", "J. Doe agrees."]
+
+    def test_common_words_are_boundaries(self):
+        assert split_sentences("The answer is no. We moved on.") == [
+            "The answer is no.", "We moved on."]
+        assert split_sentences("So did I. He left.") == ["So did I.", "He left."]
+
+    def test_empty_and_none(self):
+        assert split_sentences("") == []
+        assert split_sentences(None) == []
+        assert split_sentences("no terminator") == ["no terminator"]
+
+
+class TestTagger:
+    def setup_method(self):
+        self.tagger = RuleNameEntityTagger()
+
+    def test_money_percent_time(self):
+        tags = self.tagger.tag("She paid $5,000 for 25% equity at 9:30am")
+        assert MONEY in tags["$5,000"]
+        assert PERCENTAGE in tags["25%"]
+        assert TIME in tags["9:30am"]
+
+    def test_dates(self):
+        tags = self.tagger.tag("Due 2021-03-15 or by March next Friday")
+        assert DATE in tags["2021-03-15"]
+        assert DATE in tags["March"]
+        assert DATE in tags["Friday"]
+
+    def test_person_honorific_and_gazetteer(self):
+        tags = self.tagger.tag("Talk to Mr. Jones and Sarah Connor today")
+        assert PERSON in tags["Jones"]
+        assert PERSON in tags["Sarah"]
+        assert PERSON in tags["Connor"]
+
+    def test_location(self):
+        tags = self.tagger.tag("Flights from Paris to Tokyo and Texas")
+        assert LOCATION in tags["Paris"]
+        assert LOCATION in tags["Tokyo"]
+        assert LOCATION in tags["Texas"]
+
+    def test_organization_suffix(self):
+        tags = self.tagger.tag("He works at Acme Widgets Inc. in sales")
+        assert ORGANIZATION in (tags.get("Inc.") or tags.get("Inc") or set())
+        assert ORGANIZATION in tags["Acme"]
+        assert ORGANIZATION in tags["Widgets"]
+
+    def test_lowercase_words_untagged(self):
+        tags = self.tagger.tag("the quick brown fox jumps")
+        assert tags == {}
+
+    def test_tokenizer_keeps_shapes(self):
+        toks = ner_tokenize("Pay $3.50 (50%) at 5pm on 2020-01-01!")
+        assert "$3.50" in toks
+        assert "50%" in toks
+        assert "5pm" in toks
+        assert "2020-01-01" in toks
+
+
+class TestNameEntityRecognizerStage:
+    def test_stage_spec(self):
+        texts = [
+            "Mr. John Smith visited Paris. He paid $100 for 10% of Acme Corp.",
+            "Meeting on Monday at 10:00 in Berlin",
+            None,
+            "",
+        ]
+        f, ds = TestFeatureBuilder.of("bio", Text, texts)
+        stage = NameEntityRecognizer().set_input(f)
+        out = assert_transformer_spec(stage, ds)
+        rows = out.to_values()
+        r0 = rows[0]
+        assert PERSON in r0["John"]
+        assert PERSON in r0["Smith"]
+        assert LOCATION in r0["Paris"]
+        assert MONEY in r0["$100"]
+        assert PERCENTAGE in r0["10%"]
+        r1 = rows[1]
+        assert DATE in r1["Monday"]
+        assert TIME in r1["10:00"]
+        assert LOCATION in r1["Berlin"]
+        assert rows[2] == {} or rows[2] is None
+        assert rows[3] == {} or rows[3] is None
+
+    def test_output_type(self):
+        f, ds = TestFeatureBuilder.of("bio", Text, ["Anna lives in Rome."])
+        stage = NameEntityRecognizer().set_input(f)
+        assert stage.get_output().ftype is MultiPickListMap

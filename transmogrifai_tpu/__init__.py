@@ -30,6 +30,8 @@ from .readers.joined import (  # noqa: F401
 )
 from .ops import bucketizers  # noqa: F401 — registers decision-tree bucketizer stages
 from .ops import misc  # noqa: F401 — registers misc value transformers + scalers
+from .ops import embeddings as _embeddings  # noqa: F401 — registers Word2Vec/LDA
+from .ops import ner as _ner  # noqa: F401 — registers NameEntityRecognizer
 from .models import combiner as _combiner  # noqa: F401 — registers SelectedModelCombiner
 from . import dsl  # noqa: F401 — attaches the rich-feature DSL methods
 

@@ -1,0 +1,326 @@
+"""Text embedding estimators: Word2Vec and LDA, trained on device.
+
+Reference capabilities: OpWord2Vec (core/.../feature/OpWord2Vec.scala — wraps Spark
+Word2Vec; doc vector = average of word vectors) and OpLDA
+(core/.../feature/OpLDA.scala:1-199 — wraps Spark LDA; output = per-doc topic
+distribution of size k).  SURVEY §2.7 "Text basics".
+
+TPU-first design (not a translation): vocabulary and pair generation run on host
+(strings never reach the device, SURVEY §7.9); the training loops are single jitted
+XLA programs over dense matmuls —
+- Word2Vec: skip-gram with negative sampling; each SGD step is a batched
+  gather → dot → scatter-add, scanned with ``lax.scan`` so the whole epoch is one
+  compiled program on the MXU.
+- LDA: Hoffman-style batch variational Bayes; the E-step inner loop is
+  ``expElogtheta @ expElogbeta`` (docs × topics × vocab matmuls) — dense,
+  bfloat16-friendly, embarrassingly row-shardable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..data.dataset import Column
+from ..stages.base import Param, Transformer, UnaryEstimator, UnaryTransformer
+from ..types import OPVector, TextList
+from ..utils.vector_metadata import VectorColumnMetadata, VectorMetadata
+
+
+def _build_vocab(docs, min_count: int, max_vocab: int) -> List[str]:
+    counts: Dict[str, int] = {}
+    for toks in docs:
+        for t in toks or ():
+            counts[t] = counts.get(t, 0) + 1
+    vocab = sorted((t for t, c in counts.items() if c >= min_count),
+                   key=lambda t: (-counts[t], t))
+    return vocab[:max_vocab]
+
+
+# ---------------------------------------------------------------------------
+# Word2Vec
+# ---------------------------------------------------------------------------
+
+class Word2Vec(UnaryEstimator):
+    """TextList -> OPVector: skip-gram embeddings, doc vector = mean of word vectors.
+
+    Capability parity with OpWord2Vec (Spark Word2Vec wrapper): vocabulary with
+    ``min_count``, window-based contexts, fixed-dim output, averaged transform.
+    Training is negative-sampling SGD (vs Spark's hierarchical softmax) — the
+    TPU-friendly formulation (dense batched matmuls, no tree traversal).
+    """
+
+    input_types = (TextList,)
+    output_type = OPVector
+
+    embedding_dim = Param(default=64)
+    window_size = Param(default=5)
+    min_count = Param(default=1)
+    max_vocab = Param(default=10_000)
+    num_negatives = Param(default=4)
+    epochs = Param(default=3)
+    batch_size = Param(default=1024)
+    learning_rate = Param(default=0.05)
+    seed = Param(default=42)
+
+    def fit_columns(self, cols: List[Column], dataset) -> Transformer:
+        docs = cols[0].data
+        vocab = _build_vocab(docs, self.min_count, self.max_vocab)
+        dim = int(self.embedding_dim)
+        if not vocab:
+            return Word2VecModel(vocab=[], vectors=np.zeros((0, dim), np.float32))
+        index = {t: j for j, t in enumerate(vocab)}
+
+        # Host-side pair generation (center, context) within the window.
+        centers: List[int] = []
+        contexts: List[int] = []
+        for toks in docs:
+            ids = [index[t] for t in (toks or ()) if t in index]
+            for i, c in enumerate(ids):
+                lo = max(0, i - self.window_size)
+                hi = min(len(ids), i + self.window_size + 1)
+                for j in range(lo, hi):
+                    if j != i:
+                        centers.append(c)
+                        contexts.append(ids[j])
+        if not centers:
+            return Word2VecModel(vocab=vocab,
+                                 vectors=np.zeros((len(vocab), dim), np.float32))
+
+        rng = np.random.default_rng(self.seed)
+        centers_a = np.asarray(centers, np.int32)
+        contexts_a = np.asarray(contexts, np.int32)
+
+        # Unigram^0.75 negative-sampling distribution (word2vec standard).
+        freq = np.bincount(contexts_a, minlength=len(vocab)).astype(np.float64)
+        p = freq ** 0.75
+        p /= p.sum()
+
+        vectors = _train_skipgram(
+            len(vocab), dim, centers_a, contexts_a, p, int(self.epochs),
+            int(self.batch_size), int(self.num_negatives),
+            float(self.learning_rate), int(self.seed), rng)
+        return Word2VecModel(vocab=vocab, vectors=np.asarray(vectors, np.float32))
+
+
+def _train_skipgram(v_size, dim, centers, contexts, neg_p, epochs, batch,
+                    num_neg, lr, seed, rng):
+    """Epoch-wise jitted lax.scan over SGD steps; returns the input embeddings.
+
+    One epoch of (shuffled pairs + fresh negatives) lives on device at a time —
+    the per-epoch shapes are identical, so the scan compiles once and the epoch
+    loop replays the cached executable with new data.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    w_in = jax.random.uniform(k1, (v_size, dim), jnp.float32, -0.5 / dim, 0.5 / dim)
+    w_out = jax.random.uniform(k2, (v_size, dim), jnp.float32, -0.5 / dim, 0.5 / dim)
+
+    def loss_fn(params, c, x, neg, w):
+        wi, wo = params
+        vin = wi[c]                                  # (B, d)
+        vpos = wo[x]                                 # (B, d)
+        vneg = wo[neg]                               # (B, K, d)
+        pos_logit = jnp.sum(vin * vpos, axis=-1)     # (B,)
+        neg_logit = jnp.einsum("bd,bkd->bk", vin, vneg)
+        pos_ll = jax.nn.log_sigmoid(pos_logit)
+        neg_ll = jnp.sum(jax.nn.log_sigmoid(-neg_logit), axis=-1)
+        return -jnp.sum(w * (pos_ll + neg_ll)) / jnp.maximum(jnp.sum(w), 1.0)
+
+    grad_fn = jax.grad(loss_fn)
+
+    def step(params, batch_data):
+        c, x, neg, w = batch_data
+        g_in, g_out = grad_fn(params, c, x, neg, w)
+        wi, wo = params
+        return (wi - lr * g_in, wo - lr * g_out), 0.0
+
+    @jax.jit
+    def run_epoch(wi, wo, cs, xs, negs, ws):
+        (wi, wo), _ = jax.lax.scan(step, (wi, wo), (cs, xs, negs, ws))
+        return wi, wo
+
+    n_pairs = len(centers)
+    steps_per_epoch = max(1, -(-n_pairs // batch))
+    padded = steps_per_epoch * batch
+    for _ in range(epochs):
+        order = rng.permutation(n_pairs)
+        c = np.zeros(padded, np.int32)
+        x = np.zeros(padded, np.int32)
+        w = np.zeros(padded, np.float32)
+        c[:n_pairs] = centers[order]
+        x[:n_pairs] = contexts[order]
+        w[:n_pairs] = 1.0
+        neg = rng.choice(v_size, size=(padded, num_neg), p=neg_p).astype(np.int32)
+        w_in, w_out = run_epoch(
+            w_in, w_out,
+            jnp.asarray(c.reshape(steps_per_epoch, batch)),
+            jnp.asarray(x.reshape(steps_per_epoch, batch)),
+            jnp.asarray(neg.reshape(steps_per_epoch, batch, num_neg)),
+            jnp.asarray(w.reshape(steps_per_epoch, batch)))
+    return w_in
+
+
+class Word2VecModel(UnaryTransformer):
+    """Averages fitted word vectors over each document's in-vocab tokens."""
+
+    input_types = (TextList,)
+    output_type = OPVector
+
+    def __init__(self, vocab: List[str], vectors: np.ndarray, **kw):
+        super().__init__(**kw)
+        self.vocab = list(vocab)
+        self.vectors = np.asarray(vectors, np.float32)
+
+    def transform_columns(self, cols: List[Column], dataset) -> Column:
+        f = self.inputs[0]
+        index = {t: j for j, t in enumerate(self.vocab)}
+        dim = self.vectors.shape[1] if self.vectors.ndim == 2 else 0
+        block = np.zeros((len(cols[0]), dim), np.float32)
+        for i, toks in enumerate(cols[0].data):
+            ids = [index[t] for t in (toks or ()) if t in index]
+            if ids:
+                block[i] = self.vectors[ids].mean(axis=0)
+        meta_cols = [
+            VectorColumnMetadata(f.name, f.ftype.__name__, grouping=f.name,
+                                 descriptor_value=f"w2v_{b}")
+            for b in range(dim)
+        ]
+        meta = VectorMetadata(self.output_name, meta_cols).reindexed()
+        return Column.vector(block, meta)
+
+
+# ---------------------------------------------------------------------------
+# LDA
+# ---------------------------------------------------------------------------
+
+class LDA(UnaryEstimator):
+    """TextList -> OPVector of k topic proportions (OpLDA capability).
+
+    Batch variational Bayes (Hoffman et al.): the E-step inner loop and the
+    M-step sufficient statistics are dense (docs × topics) @ (topics × vocab)
+    matmuls — a single jitted program per fit.
+    """
+
+    input_types = (TextList,)
+    output_type = OPVector
+
+    k = Param(default=10, validator=lambda v: v >= 2)
+    max_iter = Param(default=20)
+    inner_iter = Param(default=5, doc="gamma updates per E-step")
+    min_count = Param(default=1)
+    max_vocab = Param(default=10_000)
+    seed = Param(default=42)
+
+    def fit_columns(self, cols: List[Column], dataset) -> Transformer:
+        docs = cols[0].data
+        vocab = _build_vocab(docs, self.min_count, self.max_vocab)
+        k = int(self.k)
+        if not vocab:
+            return LDAModel(vocab=[], topic_word=np.full((k, 1), 1.0, np.float32),
+                            k=k, inner_iter=int(self.inner_iter))
+        index = {t: j for j, t in enumerate(vocab)}
+        x = np.zeros((len(docs), len(vocab)), np.float32)
+        for i, toks in enumerate(docs):
+            for t in toks or ():
+                j = index.get(t)
+                if j is not None:
+                    x[i, j] += 1.0
+        lam = _fit_lda(x, k, int(self.max_iter), int(self.inner_iter),
+                       int(self.seed))
+        return LDAModel(vocab=vocab, topic_word=np.asarray(lam, np.float32), k=k,
+                        inner_iter=int(self.inner_iter))
+
+
+def _elog_dirichlet(a):
+    from jax.scipy.special import digamma
+    return digamma(a) - digamma(a.sum(axis=-1, keepdims=True))
+
+
+def _e_step(x, lam, alpha, n_iter):
+    """Returns (gamma, expElogtheta, X/phinorm) after n_iter fixed-point updates."""
+    import jax
+    import jax.numpy as jnp
+
+    exp_elog_beta = jnp.exp(_elog_dirichlet(lam))            # (k, V)
+    gamma0 = jnp.ones((x.shape[0], lam.shape[0]), jnp.float32)
+
+    def body(gamma, _):
+        exp_elog_theta = jnp.exp(_elog_dirichlet(gamma))      # (D, k)
+        phinorm = exp_elog_theta @ exp_elog_beta + 1e-30      # (D, V)
+        gamma_new = alpha + exp_elog_theta * ((x / phinorm) @ exp_elog_beta.T)
+        return gamma_new, 0.0
+
+    gamma, _ = jax.lax.scan(body, gamma0, None, length=n_iter)
+    exp_elog_theta = jnp.exp(_elog_dirichlet(gamma))
+    phinorm = exp_elog_theta @ exp_elog_beta + 1e-30
+    return gamma, exp_elog_theta, x / phinorm, exp_elog_beta
+
+
+def _fit_lda(x, k, max_iter, inner_iter, seed):
+    import jax
+    import jax.numpy as jnp
+
+    alpha = 1.0 / k
+    eta = 1.0 / k
+    key = jax.random.PRNGKey(seed)
+    lam0 = jax.random.gamma(key, 100.0, (k, x.shape[1])) / 100.0
+
+    @jax.jit
+    def run(lam, xd):
+        def outer(lam, _):
+            _, exp_elog_theta, ratio, exp_elog_beta = _e_step(
+                xd, lam, alpha, inner_iter)
+            sstats = exp_elog_beta * (exp_elog_theta.T @ ratio)
+            return eta + sstats, 0.0
+
+        lam, _ = jax.lax.scan(outer, lam, None, length=max_iter)
+        return lam
+
+    return run(lam0.astype(jnp.float32), jnp.asarray(x))
+
+
+class LDAModel(UnaryTransformer):
+    """Infers per-doc topic proportions with the fitted topic-word matrix."""
+
+    input_types = (TextList,)
+    output_type = OPVector
+
+    def __init__(self, vocab: List[str], topic_word: np.ndarray, k: int,
+                 inner_iter: int = 5, **kw):
+        super().__init__(**kw)
+        self.vocab = list(vocab)
+        self.topic_word = np.asarray(topic_word, np.float32)
+        self.k = int(k)
+        self.inner_iter = int(inner_iter)
+
+    def transform_columns(self, cols: List[Column], dataset) -> Column:
+        import jax.numpy as jnp
+
+        f = self.inputs[0]
+        n = len(cols[0])
+        if not self.vocab:
+            block = np.full((n, self.k), 1.0 / self.k, np.float32)
+        else:
+            index = {t: j for j, t in enumerate(self.vocab)}
+            x = np.zeros((n, len(self.vocab)), np.float32)
+            for i, toks in enumerate(cols[0].data):
+                for t in toks or ():
+                    j = index.get(t)
+                    if j is not None:
+                        x[i, j] += 1.0
+            gamma, _, _, _ = _e_step(jnp.asarray(x), jnp.asarray(self.topic_word),
+                                     1.0 / self.k, self.inner_iter)
+            gamma = np.asarray(gamma)
+            block = (gamma / gamma.sum(axis=1, keepdims=True)).astype(np.float32)
+        meta_cols = [
+            VectorColumnMetadata(f.name, f.ftype.__name__, grouping=f.name,
+                                 descriptor_value=f"topic_{b}")
+            for b in range(self.k)
+        ]
+        meta = VectorMetadata(self.output_name, meta_cols).reindexed()
+        return Column.vector(block, meta)

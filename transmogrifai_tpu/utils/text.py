@@ -94,3 +94,41 @@ def detect_language(text: Optional[str]) -> str:
 
 def stop_words_for(language: str) -> frozenset:
     return _LANG_STOPWORDS.get(language, STOP_WORDS)
+
+
+_ABBREVIATIONS = frozenset({
+    "mr", "mrs", "ms", "dr", "prof", "sr", "jr", "st", "vs", "inc",
+    "corp", "ltd", "dept", "univ", "approx", "fig",
+    "e.g", "i.e", "u.s", "u.k",
+})
+
+_SENTENCE_END_RE = re.compile(r"([.!?]+)(\s+|$)")
+
+
+def split_sentences(text: Optional[str]) -> List[str]:
+    """Abbreviation-aware sentence splitter (OpenNLPSentenceSplitter role).
+
+    Splits on ./!/? followed by whitespace, except after known abbreviations
+    and single initials ("J. Doe" — but not the pronoun "I").
+    """
+    if not text:
+        return []
+    sentences: List[str] = []
+    start = 0
+    for m in _SENTENCE_END_RE.finditer(text):
+        end = m.end(1)
+        prev_word = text[start:m.start(1)].rsplit(None, 1)
+        last = prev_word[-1] if prev_word else ""
+        low = last.lower().rstrip(".")
+        if m.group(1) == ".":
+            is_initial = len(last) == 1 and last.isupper() and last != "I"
+            if low in _ABBREVIATIONS or is_initial:
+                continue  # abbreviation or initial, not a boundary
+        chunk = text[start:end].strip()
+        if chunk:
+            sentences.append(chunk)
+        start = m.end()
+    tail = text[start:].strip()
+    if tail:
+        sentences.append(tail)
+    return sentences
