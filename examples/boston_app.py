@@ -1,0 +1,60 @@
+"""Boston housing regression as a production App (reference OpBoston).
+
+Mirror of helloworld/.../boston/OpBoston.scala:45 — regression model selection
+over transmogrified numeric features, run through the WorkflowRunner.
+
+Run:  python examples/boston_app.py --run-type train --model-location /tmp/boston_model
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from transmogrifai_tpu import FeatureBuilder, Workflow, transmogrify
+from transmogrifai_tpu.models.selector import RegressionModelSelector
+from transmogrifai_tpu.readers.files import DataReaders
+from transmogrifai_tpu.types import Real, RealNN
+from transmogrifai_tpu.workflow.runner import App, WorkflowRunner
+
+FEATURES = ["crim", "zn", "indus", "nox", "rm", "age", "dis", "rad", "tax",
+            "ptratio", "lstat"]
+
+
+def boston_dataframe(n: int = 500, seed: int = 11):
+    """Synthetic housing-shaped data with a known linear+noise response."""
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    cols = {name: rng.normal(size=n) * s + m for name, (m, s) in {
+        "crim": (3.6, 8.6), "zn": (11.4, 23.3), "indus": (11.1, 6.9),
+        "nox": (0.55, 0.12), "rm": (6.3, 0.7), "age": (68.6, 28.1),
+        "dis": (3.8, 2.1), "rad": (9.5, 8.7), "tax": (408.0, 168.5),
+        "ptratio": (18.5, 2.2), "lstat": (12.7, 7.1),
+    }.items()}
+    med_v = (22.5 + 5.0 * (cols["rm"] - 6.3) - 0.6 * (cols["lstat"] - 12.7)
+             - 0.5 * (cols["crim"] - 3.6) / 8.6 + rng.normal(0, 2.0, n))
+    cols["medv"] = med_v
+    return pd.DataFrame(cols)
+
+
+class OpBoston(App):
+    def build_workflow(self) -> Workflow:
+        medv = FeatureBuilder.RealNN("medv").extract_field().as_response()
+        predictors = [FeatureBuilder.Real(name).extract_field().as_predictor()
+                      for name in FEATURES]
+        features = transmogrify(predictors)
+        selector = RegressionModelSelector.with_cross_validation(num_folds=3)
+        prediction = medv.transform_with(selector, features)
+        return (Workflow()
+                .set_reader(DataReaders.Simple.dataframe(boston_dataframe()))
+                .set_result_features(medv, prediction))
+
+    def runner(self, params) -> WorkflowRunner:
+        return WorkflowRunner(
+            workflow=self.build_workflow(),
+            scoring_reader=DataReaders.Simple.dataframe(boston_dataframe(seed=12)),
+        )
+
+
+if __name__ == "__main__":
+    OpBoston().main()

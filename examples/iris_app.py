@@ -1,0 +1,76 @@
+"""Iris multiclass classification as a production App (reference OpIris).
+
+Mirror of helloworld/.../iris/OpIris.scala:43 — an OpAppWithRunner: feature
+definitions + a WorkflowRunner dispatched by run type from the command line.
+
+Run:  python examples/iris_app.py --run-type train --model-location /tmp/iris_model
+      python examples/iris_app.py --run-type score --model-location /tmp/iris_model \
+          --write-location /tmp/iris_scores
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from transmogrifai_tpu import FeatureBuilder, Workflow, transmogrify
+from transmogrifai_tpu.models.selector import MultiClassificationModelSelector
+from transmogrifai_tpu.ops.onehot import StringIndexer
+from transmogrifai_tpu.readers.files import DataReaders
+from transmogrifai_tpu.types import PickList, Real
+from transmogrifai_tpu.workflow.runner import App, WorkflowRunner
+
+
+def iris_dataframe(n_per_class: int = 50, seed: int = 3):
+    """Synthetic iris-shaped data: three gaussian species clusters."""
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    species = ["setosa", "versicolor", "virginica"]
+    centers = {
+        "setosa": (5.0, 3.4, 1.5, 0.25),
+        "versicolor": (5.9, 2.8, 4.3, 1.3),
+        "virginica": (6.6, 3.0, 5.5, 2.0),
+    }
+    rows = []
+    for sp in species:
+        c = centers[sp]
+        for _ in range(n_per_class):
+            rows.append({
+                "sepalLength": rng.normal(c[0], 0.35),
+                "sepalWidth": rng.normal(c[1], 0.3),
+                "petalLength": rng.normal(c[2], 0.35),
+                "petalWidth": rng.normal(c[3], 0.15),
+                "irisClass": sp,
+            })
+    return pd.DataFrame(rows)
+
+
+class OpIris(App):
+    """Multiclass AutoML app: indexed text label + transmogrified measurements."""
+
+    def build_workflow(self) -> Workflow:
+        iris_class = (FeatureBuilder.PickList("irisClass")
+                      .extract_field().as_response())
+        sepal_length = FeatureBuilder.Real("sepalLength").extract_field().as_predictor()
+        sepal_width = FeatureBuilder.Real("sepalWidth").extract_field().as_predictor()
+        petal_length = FeatureBuilder.Real("petalLength").extract_field().as_predictor()
+        petal_width = FeatureBuilder.Real("petalWidth").extract_field().as_predictor()
+
+        # text label -> RealNN class index (OpIris: label.indexed())
+        label = iris_class.transform_with(StringIndexer())
+        features = transmogrify([sepal_length, sepal_width, petal_length, petal_width])
+        selector = MultiClassificationModelSelector.with_cross_validation(num_folds=3)
+        prediction = label.transform_with(selector, features)
+        return (Workflow()
+                .set_reader(DataReaders.Simple.dataframe(iris_dataframe()))
+                .set_result_features(label, prediction))
+
+    def runner(self, params) -> WorkflowRunner:
+        return WorkflowRunner(
+            workflow=self.build_workflow(),
+            scoring_reader=DataReaders.Simple.dataframe(iris_dataframe(seed=4)),
+        )
+
+
+if __name__ == "__main__":
+    OpIris().main()

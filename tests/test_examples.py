@@ -1,0 +1,46 @@
+"""Smoke tests keeping examples/ runnable (reference helloworld role, SURVEY §2.14)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+
+class TestExamples:
+    def test_titanic_simple(self):
+        import titanic_simple
+
+        metrics = titanic_simple.main()
+        assert metrics["auPR"] > 0.5
+
+    def test_iris_app_train_and_score(self, tmp_path):
+        from iris_app import OpIris
+
+        model_loc = str(tmp_path / "iris_model")
+        res = OpIris().main(["--run-type", "train", "--model-location", model_loc])
+        assert res.metrics
+        assert os.path.exists(model_loc)
+        res2 = OpIris().main(["--run-type", "score", "--model-location", model_loc,
+                              "--write-location", str(tmp_path / "scores")])
+        assert res2.run_type.value == "score"
+
+    def test_boston_app_train(self, tmp_path):
+        from boston_app import OpBoston
+
+        model_loc = str(tmp_path / "boston_model")
+        res = OpBoston().main(["--run-type", "train", "--model-location", model_loc])
+        assert res.metrics
+        assert os.path.exists(model_loc)
+
+    def test_dataprep_readers(self, capsys):
+        import dataprep_readers
+
+        (agg_keys, agg_ds), (cond_keys, cond_ds) = dataprep_readers.main()
+        agg = dict(zip(agg_keys, agg_ds["amount"].to_values()))
+        # cutoff=250 keeps a:{100,200}, b:{150} (strictly-before semantics)
+        assert agg == {"a": 30.0, "b": 5.0}
+        cond = dict(zip(cond_keys, cond_ds["amount"].to_values()))
+        # first 'south' purchase: a@300 -> before: 10+20; b@150 -> nothing before
+        assert cond == {"a": 30.0, "b": None}

@@ -1,0 +1,107 @@
+"""Metrics listener + profiling hooks (SURVEY §5.1 OpSparkListener equivalent)."""
+
+import json
+import os
+
+import numpy as np
+
+from transmogrifai_tpu import FeatureBuilder, Workflow
+from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.ops.numeric import NumericVectorizer
+from transmogrifai_tpu.types import Real, RealNN
+from transmogrifai_tpu.utils.listener import (
+    AppMetrics,
+    OpMetricsListener,
+    StageMetrics,
+    add_listener,
+    remove_listener,
+)
+
+
+def _tiny_workflow():
+    rng = np.random.default_rng(0)
+    ds = Dataset.from_features(
+        {"x": rng.normal(size=20).tolist(), "label": (rng.random(20) > 0.5).astype(float).tolist()},
+        {"x": Real, "label": RealNN})
+    x = FeatureBuilder.of("x", Real).extract_field().as_predictor()
+    vec = x.transform_with(NumericVectorizer())
+    wf = Workflow().set_input_dataset(ds).set_result_features(vec)
+    return wf, ds, vec
+
+
+class TestListenerCollection:
+    def test_collects_fit_and_transform_metrics(self):
+        listener = add_listener(OpMetricsListener())
+        try:
+            wf, ds, vec = _tiny_workflow()
+            model = wf.train()
+            model.score(ds)
+        finally:
+            remove_listener(listener)
+        phases = {(m.stage_class, m.phase) for m in listener.metrics.stage_metrics}
+        assert ("NumericVectorizer", "fit") in phases
+        assert ("NumericVectorizerModel", "transform") in phases
+        for m in listener.metrics.stage_metrics:
+            assert m.wall_ms >= 0
+            assert m.n_rows == 20
+            assert m.stage_uid
+
+    def test_no_listener_no_collection(self):
+        wf, ds, _ = _tiny_workflow()
+        wf.train()  # must not raise or collect anywhere
+
+    def test_app_metrics_serde(self):
+        m = AppMetrics(run_type="train", started_at=1.0, ended_at=3.5)
+        m.stage_metrics.append(StageMetrics(
+            stage_uid="u1", stage_class="C", operation_name="op", phase="fit",
+            wall_ms=5.0, n_rows=10, n_cols_in=2, n_cols_out=3, started_at=1.0))
+        d = json.loads(m.to_json())
+        assert d["appDurationMs"] == 2500.0
+        assert d["stageMetrics"][0]["stage_uid"] == "u1"
+
+    def test_log_mode(self, caplog):
+        import logging
+        listener = add_listener(OpMetricsListener(log_stage_metrics=True,
+                                                  collect_stage_metrics=False))
+        try:
+            with caplog.at_level(logging.INFO, logger="transmogrifai_tpu.metrics"):
+                wf, _, _ = _tiny_workflow()
+                wf.train()
+        finally:
+            remove_listener(listener)
+        assert listener.metrics.stage_metrics == []
+        assert any("NumericVectorizer" in r.message for r in caplog.records)
+
+
+class TestRunnerIntegration:
+    def test_runner_exports_app_metrics(self, tmp_path):
+        from transmogrifai_tpu.params import OpParams
+        from transmogrifai_tpu.workflow.runner import RunType, WorkflowRunner
+
+        wf, ds, vec = _tiny_workflow()
+        metrics_path = os.path.join(tmp_path, "metrics.json")
+        model_path = os.path.join(tmp_path, "model")
+        runner = WorkflowRunner(workflow=wf)
+        params = OpParams(model_location=model_path,
+                          metrics_location=metrics_path,
+                          collect_stage_metrics=True)
+        result = runner.run(RunType.TRAIN, params)
+        assert "appMetrics" in result.metrics
+        app = result.metrics["appMetrics"]
+        assert app["runType"] == "train"
+        assert len(app["stageMetrics"]) > 0
+        with open(metrics_path) as fh:
+            on_disk = json.load(fh)
+        assert on_disk["metrics"]["appMetrics"]["stageMetrics"]
+
+    def test_listener_removed_after_run(self, tmp_path):
+        from transmogrifai_tpu.params import OpParams
+        from transmogrifai_tpu.utils.listener import active_listeners
+        from transmogrifai_tpu.workflow.runner import RunType, WorkflowRunner
+
+        wf, _, _ = _tiny_workflow()
+        runner = WorkflowRunner(workflow=wf)
+        params = OpParams(model_location=os.path.join(tmp_path, "m"),
+                          collect_stage_metrics=True)
+        runner.run(RunType.TRAIN, params)
+        assert active_listeners() == []

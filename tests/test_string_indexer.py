@@ -1,0 +1,73 @@
+"""StringIndexer / IndexToString (SURVEY §2.7: OpStringIndexer, OpIndexToString)."""
+
+import pytest
+
+from transmogrifai_tpu.ops.onehot import IndexToString, StringIndexer
+from transmogrifai_tpu.testkit import (
+    TestFeatureBuilder,
+    assert_estimator_spec,
+    assert_transformer_spec,
+)
+from transmogrifai_tpu.types import PickList, Real, Text
+
+VALUES = ["b", "a", "b", "c", "b", "a"]
+
+
+class TestStringIndexer:
+    def test_frequency_ordering_and_spec(self):
+        f, ds = TestFeatureBuilder.of("s", PickList, VALUES)
+        est = StringIndexer().set_input(f)
+        model = assert_estimator_spec(
+            est, ds, expected=[0.0, 1.0, 0.0, 2.0, 0.0, 1.0])
+        assert model.labels == ["b", "a", "c"]
+
+    def test_unseen_label_error(self):
+        f, ds = TestFeatureBuilder.of("s", PickList, VALUES)
+        model = StringIndexer().set_input(f).fit(ds)
+        _, ds2 = TestFeatureBuilder.of("s", PickList, ["zzz"])
+        with pytest.raises(ValueError, match="unseen"):
+            model.transform(ds2)
+
+    def test_unseen_label_keep(self):
+        f, ds = TestFeatureBuilder.of("s", PickList, VALUES)
+        model = StringIndexer(handle_invalid="keep").set_input(f).fit(ds)
+        _, ds2 = TestFeatureBuilder.of("s", PickList, ["zzz", "b"])
+        assert model.transform(ds2)[model.output_name].to_values() == [3.0, 0.0]
+
+    def test_response_flag_propagates(self):
+        f, ds = TestFeatureBuilder.of("s", PickList, VALUES, is_response=True)
+        out = StringIndexer().set_input(f).get_output()
+        assert out.is_response
+
+
+class TestIndexToString:
+    def test_round_trip(self):
+        f, ds = TestFeatureBuilder.of("s", PickList, VALUES)
+        indexer = StringIndexer().set_input(f).fit(ds)
+        indexed = indexer.transform(ds)
+        idx_feature = indexer.get_output()
+        inv = IndexToString(labels=indexer.labels).set_input(idx_feature)
+        assert_transformer_spec(inv, indexed, expected=VALUES)
+
+    def test_out_of_range_is_none(self):
+        f, ds = TestFeatureBuilder.of("i", Real, [0.0, 5.0, None])
+        inv = IndexToString(labels=["x", "y"]).set_input(f)
+        assert inv.transform(ds)[inv.output_name].to_values() == ["x", None, None]
+
+    def test_nan_is_none(self):
+        f, ds = TestFeatureBuilder.of("i", Real, [float("nan"), 1.0])
+        inv = IndexToString(labels=["x", "y"]).set_input(f)
+        assert inv.transform(ds)[inv.output_name].to_values() == [None, "y"]
+
+
+class TestMissingValues:
+    def test_fit_with_missing_errors_fast(self):
+        f, ds = TestFeatureBuilder.of("s", PickList, ["a", None, "b"])
+        with pytest.raises(ValueError, match="missing"):
+            StringIndexer().set_input(f).fit(ds)
+
+    def test_fit_with_missing_keep_maps_to_unseen(self):
+        f, ds = TestFeatureBuilder.of("s", PickList, ["a", None, "b", "a"])
+        model = StringIndexer(handle_invalid="keep").set_input(f).fit(ds)
+        # labels: a (2), b (1); None -> unseen index 2
+        assert model.transform(ds)[model.output_name].to_values() == [0.0, 2.0, 1.0, 0.0]

@@ -130,7 +130,8 @@ def ner_tokenize(sentence: str) -> List[str]:
 
 
 def _is_capitalized(tok: str) -> bool:
-    return bool(tok) and tok[0].isupper() and tok[1:].islower()
+    """Proper-noun shape: leading uppercase (covers Xxxx, McDonald, O'Brien, IBM)."""
+    return bool(tok) and tok[0].isupper()
 
 
 class RuleNameEntityTagger:

@@ -15,8 +15,14 @@ from typing import Dict, List, Sequence
 import numpy as np
 
 from ..data.dataset import Column
-from ..stages.base import Param, SequenceEstimator, Transformer
-from ..types import MultiPickList, OPSet, OPVector, Text
+from ..stages.base import (
+    Param,
+    SequenceEstimator,
+    Transformer,
+    UnaryEstimator,
+    UnaryTransformer,
+)
+from ..types import MultiPickList, OPSet, OPVector, Real, RealNN, Text
 from ..utils.vector_metadata import (
     NULL_INDICATOR,
     OTHER_INDICATOR,
@@ -178,3 +184,87 @@ class MultiPickListVectorizerModel(OneHotVectorizerModel):
                         block[i, j] = 1.0
             blocks.append(block)
         return Column.vector(np.hstack(blocks), self._meta())
+
+
+class StringIndexer(UnaryEstimator):
+    """Text-like -> RealNN label index by descending frequency (OpStringIndexer).
+
+    Index 0 is the most frequent value (Spark StringIndexer ordering).  Unseen
+    values at transform time follow ``handle_invalid``: "error" (reference
+    default) or "skip"-equivalent NaN via "keep" mapping to an extra index.
+    """
+
+    input_types = (Text,)
+    output_type = RealNN
+    # label indexing is the canonical use (reference: label.indexed() on responses)
+    allow_label_as_input = True
+
+    @property
+    def output_is_response(self):
+        return any(f.is_response for f in self._input_features)
+
+    handle_invalid = Param(default="error",
+                           validator=lambda v: v in ("error", "keep"))
+
+    def fit_columns(self, cols: List[Column], dataset) -> Transformer:
+        values = list(cols[0].data)
+        if self.handle_invalid == "error" and any(v is None for v in values):
+            raise ValueError(
+                "StringIndexer: input contains missing values; use "
+                "handle_invalid='keep' to map them to the unseen index")
+        counts = Counter(v for v in values if v is not None)
+        labels = [t for t, _ in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))]
+        return StringIndexerModel(labels=labels, handle_invalid=self.handle_invalid)
+
+
+class StringIndexerModel(UnaryTransformer):
+    input_types = (Text,)
+    output_type = RealNN
+    allow_label_as_input = True
+
+    @property
+    def output_is_response(self):
+        return any(f.is_response for f in self._input_features)
+
+    def __init__(self, labels: List[str], handle_invalid: str = "error", **kw):
+        super().__init__(**kw)
+        self.labels = list(labels)
+        self.handle_invalid = handle_invalid
+
+    def transform_columns(self, cols: List[Column], dataset) -> Column:
+        index = {t: float(j) for j, t in enumerate(self.labels)}
+        unseen = float(len(self.labels))
+        out = np.empty(len(cols[0]), np.float64)
+        for i, v in enumerate(cols[0].data):
+            j = index.get(v) if v is not None else None
+            if j is None:
+                if self.handle_invalid == "error":
+                    what = "missing value" if v is None else f"unseen label {v!r}"
+                    raise ValueError(
+                        f"StringIndexer: {what} (handle_invalid='error'; use "
+                        "'keep' to map to the unseen index)")
+                j = unseen
+            out[i] = j
+        return Column.from_values(RealNN, out.tolist())
+
+
+class IndexToString(UnaryTransformer):
+    """RealNN index -> Text label, inverse of StringIndexer (OpIndexToString)."""
+
+    input_types = (Real,)
+    output_type = Text
+
+    def __init__(self, labels: List[str], **kw):
+        super().__init__(**kw)
+        self.labels = list(labels)
+
+    def transform_columns(self, cols: List[Column], dataset) -> Column:
+        out = []
+        for v in cols[0].to_values():
+            if v is None or (isinstance(v, float) and v != v):  # missing or NaN
+                out.append(None)
+            elif not 0 <= int(v) < len(self.labels):
+                out.append(None)
+            else:
+                out.append(self.labels[int(v)])
+        return Column.from_values(Text, out)

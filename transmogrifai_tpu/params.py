@@ -39,6 +39,12 @@ class OpParams:
     batch_duration_secs: int = 1
     custom_tag: Optional[str] = None
     custom_params: Dict[str, Any] = field(default_factory=dict)
+    #: log each stage's metrics as it completes (OpParams.scala:93-95)
+    log_stage_metrics: bool = False
+    #: collect per-stage metrics and export with run metrics
+    collect_stage_metrics: bool = False
+    #: directory for a jax.profiler trace of the run (§5.1 TPU equivalent)
+    profile_trace_dir: Optional[str] = None
 
     # -- loading -------------------------------------------------------------
     @staticmethod
@@ -56,6 +62,9 @@ class OpParams:
             batch_duration_secs=d.get("batchDurationSecs", 1),
             custom_tag=d.get("customTagName"),
             custom_params=d.get("customParams", {}),
+            log_stage_metrics=d.get("logStageMetrics", False),
+            collect_stage_metrics=d.get("collectStageMetrics", False),
+            profile_trace_dir=d.get("profileTraceDir"),
         )
 
     @staticmethod
@@ -86,6 +95,9 @@ class OpParams:
             "batchDurationSecs": self.batch_duration_secs,
             "customTagName": self.custom_tag,
             "customParams": self.custom_params,
+            "logStageMetrics": self.log_stage_metrics,
+            "collectStageMetrics": self.collect_stage_metrics,
+            "profileTraceDir": self.profile_trace_dir,
         }
 
     def save(self, path: str) -> None:

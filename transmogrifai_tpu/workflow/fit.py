@@ -15,6 +15,7 @@ from typing import Dict, List, Sequence, Tuple
 from ..data.dataset import Dataset
 from ..features.feature import Feature
 from ..stages.base import Estimator, PipelineStage, Transformer
+from ..utils.listener import stage_timer
 from .dag import compute_dag
 
 
@@ -34,10 +35,14 @@ def fit_dag(
         for stage in layer:
             runner = _resolve(stage, fitted)
             if runner is None:
-                model = stage.fit(dataset)
+                with stage_timer(stage, "fit", dataset) as finish:
+                    model = stage.fit(dataset)
+                    finish(None)
                 fitted[stage.uid] = model
                 runner = model
-            dataset = runner.transform(dataset)
+            with stage_timer(runner, "transform", dataset) as finish:
+                dataset = runner.transform(dataset)
+                finish(dataset)
     return dataset, fitted
 
 
@@ -55,7 +60,9 @@ def transform_dag(
                     f"Stage {stage.uid} is an unfitted estimator; cannot score. "
                     "Train the workflow first."
                 )
-            dataset = runner.transform(dataset)
+            with stage_timer(runner, "transform", dataset) as finish:
+                dataset = runner.transform(dataset)
+                finish(dataset)
     return dataset
 
 

@@ -18,6 +18,12 @@ from typing import Any, Callable, Dict, List, Optional
 from ..evaluators.base import Evaluator
 from ..features.feature import Feature
 from ..params import OpParams
+from ..utils.listener import (
+    OpMetricsListener,
+    add_listener,
+    profile_trace,
+    remove_listener,
+)
 from .dag import all_stages
 from .workflow import Workflow, WorkflowModel, dedup_raw_features
 
@@ -78,7 +84,23 @@ class WorkflowRunner:
             RunType.FEATURES: self._features,
             RunType.EVALUATE: self._evaluate,
         }[run_type]
-        result = handler(params)
+        listener = None
+        if params.log_stage_metrics or params.collect_stage_metrics:
+            listener = add_listener(OpMetricsListener(
+                log_stage_metrics=params.log_stage_metrics,
+                collect_stage_metrics=params.collect_stage_metrics,
+                custom_tag=params.custom_tag))
+            listener.on_app_start(run_type.value)
+        try:
+            with profile_trace(params.profile_trace_dir):
+                result = handler(params)
+        finally:
+            if listener is not None:
+                listener.on_app_end()
+                remove_listener(listener)
+        if listener is not None and params.collect_stage_metrics:
+            result.metrics = dict(result.metrics)
+            result.metrics["appMetrics"] = listener.metrics.to_dict()
         if params.metrics_location and result.metrics:
             _write_json(params.metrics_location, result.to_dict())
         for fn in self._end_handlers:
