@@ -1,0 +1,88 @@
+"""Column-sharded wide-feature statistics on the 8-device mesh (SURVEY §5.7)."""
+
+import jax
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.parallel.mesh import make_mesh
+from transmogrifai_tpu.parallel.wide import (
+    pad_cols,
+    shard_cols,
+    wide_col_stats,
+    wide_full_corr,
+    wide_gram_ring,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert jax.device_count() == 8, "conftest must provide 8 virtual devices"
+    return make_mesh()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(256, 40)).astype(np.float32)
+    y = (rng.random(256) > 0.5).astype(np.float32)
+    return x, y
+
+
+class TestWideColStats:
+    def test_matches_numpy(self, mesh, data):
+        x, y = data
+        xd, d_valid = shard_cols(x, mesh)
+        mean, var, xmin, xmax, corr = (np.asarray(v)[:d_valid]
+                                       for v in wide_col_stats(xd, y, mesh))
+        np.testing.assert_allclose(mean, x.mean(0), rtol=1e-4)
+        np.testing.assert_allclose(var, x.var(0), rtol=1e-3)
+        np.testing.assert_allclose(xmin, x.min(0), rtol=1e-5)
+        np.testing.assert_allclose(xmax, x.max(0), rtol=1e-5)
+        expected_corr = np.array([np.corrcoef(x[:, j], y)[0, 1]
+                                  for j in range(x.shape[1])])
+        np.testing.assert_allclose(corr, expected_corr, atol=1e-3)
+
+    def test_sharding_layout(self, mesh, data):
+        x, y = data
+        xd, _ = shard_cols(x, mesh)
+        # columns split over 8 devices: every shard holds all rows, d/8 columns
+        shard_shapes = {s.data.shape for s in xd.addressable_shards}
+        assert shard_shapes == {(256, 5)}  # 40 cols / 8 devices
+
+
+class TestWideGramRing:
+    def test_gram_matches_numpy(self, mesh, data):
+        x, _ = data
+        xd, d_valid = shard_cols(x, mesh)
+        gram = np.asarray(wide_gram_ring(xd, mesh))[:d_valid, :d_valid]
+        expected = x.T @ x / x.shape[0]
+        np.testing.assert_allclose(gram, expected, atol=1e-3)
+
+    def test_full_corr_matches_numpy(self, mesh, data):
+        x, _ = data
+        xd, d_valid = shard_cols(x, mesh)
+        corr = np.asarray(wide_full_corr(xd, mesh, d_valid=d_valid))
+        expected = np.corrcoef(x.T)
+        np.testing.assert_allclose(corr, expected, atol=2e-3)
+
+    def test_uneven_columns_padded(self, mesh):
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(64, 13)).astype(np.float32)  # 13 % 8 != 0
+        xd, d_valid = shard_cols(x, mesh)
+        assert d_valid == 13
+        assert xd.shape[1] == 16
+        corr = np.asarray(wide_full_corr(xd, mesh, d_valid=d_valid))
+        np.testing.assert_allclose(corr, np.corrcoef(x.T), atol=2e-3)
+
+
+class TestPadCols:
+    def test_no_pad_when_even(self):
+        x = np.ones((4, 16))
+        padded, d = pad_cols(x, 8)
+        assert padded.shape == (4, 16) and d == 16
+
+    def test_pad_is_zero(self):
+        x = np.ones((4, 5))
+        padded, d = pad_cols(x, 8)
+        assert padded.shape == (4, 8) and d == 5
+        assert (padded[:, 5:] == 0).all()

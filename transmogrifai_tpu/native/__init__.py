@@ -1,0 +1,149 @@
+"""On-demand-compiled C++ host kernels with transparent Python fallback.
+
+The shared library builds once per source hash (g++ -O3) into the user cache dir
+and loads via ctypes — no pybind11/pip needed.  ``available()`` reports whether
+the native path is active; every caller has a numpy/pure-Python fallback, so the
+framework works identically (slower) without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "fasthost.cpp")
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _default_cache_dir() -> str:
+    """Per-user cache dir — never a shared world-writable location, so another
+    local user cannot pre-plant a library at the predictable path."""
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    if not os.path.isdir(os.path.dirname(base) or "/"):
+        base = os.path.join(tempfile.gettempdir(),
+                            f"transmogrifai_tpu_u{os.getuid()}")
+    return os.path.join(base, "transmogrifai_tpu", "native")
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    try:
+        with open(_SRC, "rb") as fh:
+            src = fh.read()
+        tag = hashlib.sha256(src).hexdigest()[:16]
+        cache_dir = os.environ.get("TRANSMOGRIFAI_TPU_NATIVE_CACHE",
+                                   _default_cache_dir())
+        os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+        lib_path = os.path.join(cache_dir, f"_fasthost_{tag}.so")
+        if os.path.exists(lib_path) and os.stat(lib_path).st_uid != os.getuid():
+            return None  # refuse to load a library we don't own
+        if not os.path.exists(lib_path):
+            tmp = lib_path + f".tmp{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, lib_path)  # atomic vs concurrent builders
+        lib = ctypes.CDLL(lib_path)
+        lib.murmur3_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)]
+        lib.murmur3_batch.restype = None
+        lib.hash_count_block.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_uint32, ctypes.c_int32, ctypes.POINTER(ctypes.c_float)]
+        lib.hash_count_block.restype = None
+        return lib
+    except Exception:
+        return None
+
+
+#: below this many strings the Python fallback is faster than paying a cold
+#: g++ compile inside the first transform — the build only triggers past it
+#: (or via an explicit warmup()).
+_BUILD_THRESHOLD = 2048
+
+
+def _lib(force: bool = False) -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if not _TRIED and force:
+        _TRIED = True
+        _LIB = _build_and_load()
+    return _LIB
+
+
+def warmup() -> bool:
+    """Build/load the native library now (e.g. at app startup); True if active."""
+    return _lib(force=True) is not None
+
+
+def available() -> bool:
+    return _lib(force=True) is not None
+
+
+def _pack(tokens: Sequence[str]) -> Tuple[bytes, np.ndarray]:
+    """Pack strings into one UTF-8 buffer + int64 offsets (n+1)."""
+    encoded = [t.encode("utf-8") for t in tokens]
+    offsets = np.zeros(len(encoded) + 1, np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    return b"".join(encoded), offsets
+
+
+def murmur3_batch(tokens: Sequence[str], seed: int = 42) -> np.ndarray:
+    """uint32 murmur3 of each token; native when possible, else the Python hash."""
+    lib = _lib(force=len(tokens) >= _BUILD_THRESHOLD)
+    if lib is None or not tokens:
+        from ..utils.hashing import murmur3_32
+
+        return np.array([murmur3_32(t, seed) for t in tokens], np.uint32)
+    buf, offsets = _pack(tokens)
+    out = np.empty(len(tokens), np.uint32)
+    lib.murmur3_batch(
+        buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(tokens), seed,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+    return out
+
+
+def hash_count_block(docs: Sequence[Optional[Sequence[str]]], width: int,
+                     binary: bool = False, seed: int = 42) -> np.ndarray:
+    """(n_docs, width) float32 hashed token counts — the HashingTF kernel.
+
+    Native single pass over all tokens when available; numpy/Python otherwise.
+    """
+    n_rows = len(docs)
+    out = np.zeros((n_rows, width), np.float32)
+    tokens: List[str] = []
+    row_ids: List[int] = []
+    for i, toks in enumerate(docs):
+        for t in toks or ():
+            tokens.append(t)
+            row_ids.append(i)
+    if not tokens:
+        return out
+    lib = _lib(force=len(tokens) >= _BUILD_THRESHOLD)
+    if lib is None:
+        from ..utils.hashing import hash_to_bucket
+
+        for t, i in zip(tokens, row_ids):
+            j = hash_to_bucket(t, width, seed)
+            if binary:
+                out[i, j] = 1.0
+            else:
+                out[i, j] += 1.0
+        return out
+    buf, offsets = _pack(tokens)
+    rows = np.asarray(row_ids, np.int32)
+    lib.hash_count_block(
+        buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(tokens), width, seed, 1 if binary else 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out

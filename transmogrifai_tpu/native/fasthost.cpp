@@ -1,0 +1,90 @@
+// Native host-path kernels for the string-heavy ingest/vectorize loops.
+//
+// Reference capability: the reference's native code enters through XGBoost4J's
+// JNI (SURVEY §2.9 native-code inventory) and Spark's JVM runtime; its hashing
+// trick (MurMur3, Transmogrifier.scala:52-90) runs on the JVM.  Here the
+// host-side hot loops — batch murmur3 and the HashingTF token->bucket count
+// fill — are C++, called via ctypes; strings stay on host (SURVEY §7.9), the
+// produced dense float32 blocks move to HBM.
+//
+// Build: g++ -O3 -shared -fPIC -o _fasthost.so fasthost.cpp   (done on demand by
+// native/__init__.py, with a pure-Python fallback when no toolchain exists).
+
+#include <cstdint>
+#include <cstring>
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+// MurmurHash3 x86 32-bit over one UTF-8 string; bit-exact with
+// transmogrifai_tpu/utils/hashing.py::murmur3_32.
+static uint32_t murmur3_32(const char* data, int64_t len, uint32_t seed) {
+  const uint8_t* d = reinterpret_cast<const uint8_t*>(data);
+  const int64_t nblocks = len / 4;
+  uint32_t h1 = seed;
+  const uint32_t c1 = 0xcc9e2d51u;
+  const uint32_t c2 = 0x1b873593u;
+
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k1;
+    std::memcpy(&k1, d + i * 4, 4);  // little-endian load
+    k1 *= c1;
+    k1 = rotl32(k1, 15);
+    k1 *= c2;
+    h1 ^= k1;
+    h1 = rotl32(h1, 13);
+    h1 = h1 * 5 + 0xe6546b64u;
+  }
+
+  const uint8_t* tail = d + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= static_cast<uint32_t>(tail[2]) << 16; [[fallthrough]];
+    case 2: k1 ^= static_cast<uint32_t>(tail[1]) << 8; [[fallthrough]];
+    case 1:
+      k1 ^= tail[0];
+      k1 *= c1;
+      k1 = rotl32(k1, 15);
+      k1 *= c2;
+      h1 ^= k1;
+  }
+
+  h1 ^= static_cast<uint32_t>(len);
+  h1 ^= h1 >> 16;
+  h1 *= 0x85ebca6bu;
+  h1 ^= h1 >> 13;
+  h1 *= 0xc2b2ae35u;
+  h1 ^= h1 >> 16;
+  return h1;
+}
+
+extern "C" {
+
+// Hash n packed UTF-8 strings.  offsets has n+1 entries into buf.
+void murmur3_batch(const char* buf, const int64_t* offsets, int64_t n,
+                   uint32_t seed, uint32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i], seed);
+  }
+}
+
+// HashingTF hot loop: bucket-count packed tokens into a dense (n_rows, width)
+// float32 block.  row_ids maps each token to its row; binary=1 sets presence
+// instead of counts.  out must be zero-initialised by the caller.
+void hash_count_block(const char* buf, const int64_t* offsets,
+                      const int32_t* row_ids, int64_t n_tokens, int32_t width,
+                      uint32_t seed, int32_t binary, float* out) {
+  for (int64_t i = 0; i < n_tokens; i++) {
+    uint32_t h = murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i], seed);
+    int64_t col = h % static_cast<uint32_t>(width);
+    float* cell = out + static_cast<int64_t>(row_ids[i]) * width + col;
+    if (binary) {
+      *cell = 1.0f;
+    } else {
+      *cell += 1.0f;
+    }
+  }
+}
+
+}  // extern "C"

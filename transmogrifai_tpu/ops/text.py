@@ -23,7 +23,7 @@ from ..stages.base import (
     UnaryTransformer,
 )
 from ..types import Integral, MultiPickList, OPVector, RealNN, Text, TextList
-from ..utils.hashing import hash_to_bucket
+from ..native import hash_count_block
 from ..utils.text import (
     char_ngrams,
     detect_language,
@@ -106,15 +106,8 @@ class TextLenTransformer(UnaryTransformer):
 
 
 def _hash_block(col: Column, width: int, binary: bool) -> np.ndarray:
-    block = np.zeros((len(col), width), dtype=np.float32)
-    for i, toks in enumerate(col.data):
-        for tok in toks or ():
-            j = hash_to_bucket(tok, width)
-            if binary:
-                block[i, j] = 1.0
-            else:
-                block[i, j] += 1.0
-    return block
+    # native C++ single-pass kernel when the toolchain is available (native/)
+    return hash_count_block(col.data, width, binary=binary)
 
 
 class HashingTF(UnaryTransformer):

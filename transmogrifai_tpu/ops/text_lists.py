@@ -13,7 +13,7 @@ import numpy as np
 from ..data.dataset import Column
 from ..stages.base import Param, SequenceTransformer
 from ..types import OPVector, TextList
-from ..utils.hashing import hash_to_bucket
+from ..native import hash_count_block
 from ..utils.vector_metadata import NULL_INDICATOR, VectorColumnMetadata, VectorMetadata
 
 NUM_HASHES_DEFAULT = 512
@@ -35,9 +35,7 @@ class TextListHashingVectorizer(SequenceTransformer):
         if self.shared_hash_space:
             block = np.zeros((n, width), dtype=np.float32)
             for col in cols:
-                for i, toks in enumerate(col.data):
-                    for tok in toks or ():
-                        block[i, hash_to_bucket(tok, width)] += 1.0
+                block += hash_count_block(col.data, width)
             blocks.append(block)
             f0 = self.inputs[0]
             for b in range(width):
@@ -46,11 +44,7 @@ class TextListHashingVectorizer(SequenceTransformer):
                     descriptor_value=f"hash_{b}"))
         else:
             for f, col in zip(self.inputs, cols):
-                block = np.zeros((n, width), dtype=np.float32)
-                for i, toks in enumerate(col.data):
-                    for tok in toks or ():
-                        block[i, hash_to_bucket(tok, width)] += 1.0
-                blocks.append(block)
+                blocks.append(hash_count_block(col.data, width))
                 for b in range(width):
                     meta_cols.append(VectorColumnMetadata(
                         f.name, f.ftype.__name__, grouping=f.name,

@@ -17,7 +17,7 @@ import numpy as np
 from ..data.dataset import Column
 from ..stages.base import Param, SequenceEstimator, Transformer
 from ..types import OPVector, Text
-from ..utils.hashing import hash_to_bucket
+from ..native import hash_count_block
 from ..utils.text import tokenize
 from ..utils.vector_metadata import (
     NULL_INDICATOR,
@@ -134,10 +134,7 @@ class SmartTextVectorizerModel(Transformer):
                                                           indicator_value=NULL_INDICATOR))
             else:
                 width = self.num_hashes
-                block = np.zeros((n, width), dtype=np.float32)
-                for i, v in enumerate(col.data):
-                    for tok in tokenize(v):
-                        block[i, hash_to_bucket(tok, width)] += 1.0
+                block = hash_count_block([tokenize(v) for v in col.data], width)
                 for b in range(width):
                     meta_cols.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
                                                           descriptor_value=f"hash_{b}"))

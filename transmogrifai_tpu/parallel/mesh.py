@@ -53,14 +53,21 @@ def model_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(MODEL_AXIS))
 
 
-def pad_rows(arr: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:
-    """Pad rows to a multiple (sharding requires even splits); returns (padded, n_valid)."""
-    n = arr.shape[0]
+def pad_axis(arr: np.ndarray, axis: int, multiple: int) -> Tuple[np.ndarray, int]:
+    """Zero-pad one axis to a multiple (sharding needs even splits);
+    returns (padded, n_valid along that axis)."""
+    n = arr.shape[axis]
     rem = (-n) % multiple
     if rem == 0:
         return arr, n
-    pad_width = [(0, rem)] + [(0, 0)] * (arr.ndim - 1)
+    pad_width = [(0, 0)] * arr.ndim
+    pad_width[axis] = (0, rem)
     return np.pad(arr, pad_width), n
+
+
+def pad_rows(arr: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:
+    """Pad rows to a multiple; returns (padded, n_valid)."""
+    return pad_axis(arr, 0, multiple)
 
 
 def shard_rows(arr: np.ndarray, mesh: Optional[Mesh] = None):
