@@ -1,0 +1,119 @@
+"""Map/list plumbing: FilterMap, collection lift, DateMapToUnitCircle (SURVEY §2.7)."""
+
+import numpy as np
+
+from transmogrifai_tpu.ops.collections_lift import (
+    DateMapToUnitCircleVectorizer,
+    FilterMap,
+    LiftToList,
+    LiftToMap,
+)
+from transmogrifai_tpu.ops.misc import ReplaceTransformer
+from transmogrifai_tpu.testkit import (
+    TestFeatureBuilder,
+    assert_estimator_spec,
+    assert_transformer_spec,
+)
+from transmogrifai_tpu.types import DateMap, Text, TextList, TextMap
+
+MAPS = [
+    {"a": "x", "b": "y", "c": ""},
+    {"a": "z"},
+    {},
+    None,
+]
+
+
+class TestFilterMap:
+    def test_white_list(self):
+        f, ds = TestFeatureBuilder.of("m", TextMap, MAPS)
+        stage = FilterMap(white_list_keys=("a",)).set_input(f)
+        out = assert_transformer_spec(stage, ds)
+        assert out.to_values()[0] == {"a": "x"}
+        assert out.to_values()[1] == {"a": "z"}
+
+    def test_black_list_and_empty_filter(self):
+        f, ds = TestFeatureBuilder.of("m", TextMap, MAPS)
+        stage = FilterMap(black_list_keys=("b",)).set_input(f)
+        rows = stage.transform(ds)[stage.output_name].to_values()
+        assert rows[0] == {"a": "x"}  # b black-listed, c empty-filtered
+
+    def test_keep_empty_values(self):
+        f, ds = TestFeatureBuilder.of("m", TextMap, MAPS)
+        stage = FilterMap(filter_empty=False).set_input(f)
+        rows = stage.transform(ds)[stage.output_name].to_values()
+        assert rows[0] == {"a": "x", "b": "y", "c": ""}
+
+    def test_output_type_matches_input(self):
+        f, _ = TestFeatureBuilder.of("m", TextMap, MAPS)
+        assert FilterMap().set_input(f).get_output().ftype is TextMap
+
+
+class TestLift:
+    def test_lift_to_map(self):
+        f, ds = TestFeatureBuilder.of("m", TextMap, MAPS)
+        inner = ReplaceTransformer(input_type=Text, old_value="x", new_value="XX")
+        stage = LiftToMap(inner=inner).set_input(f)
+        rows = stage.transform(ds)[stage.output_name].to_values()
+        assert rows[0]["a"] == "XX"
+        assert rows[0]["b"] == "y"
+        assert rows[2] == {}
+
+    def test_lift_to_list(self):
+        f, ds = TestFeatureBuilder.of("l", TextList, [["x", "y"], [], None])
+        inner = ReplaceTransformer(input_type=Text, old_value="y", new_value="Z")
+        stage = LiftToList(inner=inner).set_input(f)
+        rows = stage.transform(ds)[stage.output_name].to_values()
+        assert rows[0] == ["x", "Z"]
+        assert rows[1] == []
+
+    def test_lift_serde_round_trip(self):
+        from transmogrifai_tpu.testkit.specs import _roundtrip
+
+        f, ds = TestFeatureBuilder.of("m", TextMap, MAPS)
+        inner = ReplaceTransformer(input_type=Text, old_value="x", new_value="XX")
+        stage = LiftToMap(inner=inner).set_input(f)
+        expected = stage.transform(ds)[stage.output_name].to_values()
+        restored = _roundtrip(stage)
+        assert restored.transform(ds)[restored.output_name].to_values() == expected
+
+
+HOUR_MS = 3_600_000
+
+
+class TestDateMapToUnitCircle:
+    def test_fit_learns_keys_and_encodes(self):
+        maps = [
+            {"signup": 0, "last": 6 * HOUR_MS},     # hour 0 and hour 6
+            {"signup": 12 * HOUR_MS},
+            None,
+        ]
+        f, ds = TestFeatureBuilder.of("d", DateMap, maps)
+        est = DateMapToUnitCircleVectorizer(time_periods=("HourOfDay",)).set_input(f)
+        model = assert_estimator_spec(est, ds, check_row_parity=False)
+        assert model.key_sets == [["last", "signup"]]
+        block = np.asarray(model.transform(ds)[model.output_name].data)
+        assert block.shape == (3, 4)  # 2 keys x 1 period x (cos, sin)
+        # signup hour 0 -> (1, 0); hour 12 -> (-1, 0); missing -> origin
+        np.testing.assert_allclose(block[0, 2:], [1.0, 0.0], atol=1e-6)
+        np.testing.assert_allclose(block[1, 2:], [-1.0, 0.0], atol=1e-6)
+        np.testing.assert_allclose(block[2], 0.0)
+        # last @ hour 6 -> (0, 1)
+        np.testing.assert_allclose(block[0, :2], [0.0, 1.0], atol=1e-6)
+
+    def test_metadata_grouping_per_key(self):
+        f, ds = TestFeatureBuilder.of("d", DateMap, [{"k1": 0, "k2": 0}])
+        model = DateMapToUnitCircleVectorizer(
+            time_periods=("HourOfDay",)).set_input(f).fit(ds)
+        out = model.transform(ds)[model.output_name]
+        groups = [c.grouping for c in out.meta.columns]
+        assert groups == ["d_k1", "d_k1", "d_k2", "d_k2"]
+
+    def test_unknown_period_rejected(self):
+        import pytest
+
+        f, ds = TestFeatureBuilder.of("d", DateMap, [{"k": 0}])
+        model = DateMapToUnitCircleVectorizer(
+            time_periods=("NotAPeriod",)).set_input(f).fit(ds)
+        with pytest.raises(ValueError, match="NotAPeriod"):
+            model.transform(ds)

@@ -76,6 +76,13 @@ class TestTagger:
         assert ORGANIZATION in tags["Acme"]
         assert ORGANIZATION in tags["Widgets"]
 
+    def test_acronym_org_and_mixed_case_surname(self):
+        r1 = self.tagger.tag("IBM Corp. reported earnings")
+        assert ORGANIZATION in r1["IBM"]
+        r2 = self.tagger.tag("Mr. McDonald visited Paris")
+        assert PERSON in r2["McDonald"]
+        assert LOCATION in r2["Paris"]
+
     def test_lowercase_words_untagged(self):
         tags = self.tagger.tag("the quick brown fox jumps")
         assert tags == {}

@@ -1,0 +1,49 @@
+"""SmartTextMapVectorizer: per-key categorical-vs-text decision (SURVEY §2.7)."""
+
+import numpy as np
+
+from transmogrifai_tpu.ops.text_smart import SmartTextMapVectorizer
+from transmogrifai_tpu.testkit import TestFeatureBuilder, assert_estimator_spec
+from transmogrifai_tpu.types import TextMap
+
+
+def _maps(n_unique_desc=40):
+    rows = []
+    for i in range(n_unique_desc):
+        rows.append({"color": ["red", "blue"][i % 2],
+                     "desc": f"unique free text number {i} with words"})
+    rows.append({"color": "red"})
+    rows.append({})
+    return rows
+
+
+class TestSmartTextMapVectorizer:
+    def test_per_key_decision(self):
+        f, ds = TestFeatureBuilder.of("m", TextMap, _maps())
+        est = SmartTextMapVectorizer(max_cardinality=10, min_support=1,
+                                     num_hashes=32).set_input(f)
+        model = assert_estimator_spec(est, ds, check_row_parity=False)
+        plan = model.key_plans[0]
+        assert plan["color"]["categorical"] is True
+        assert set(plan["color"]["vocab"]) == {"red", "blue"}
+        assert plan["desc"]["categorical"] is False  # 40 distinct > 10
+
+    def test_block_layout_and_nulls(self):
+        f, ds = TestFeatureBuilder.of("m", TextMap, _maps())
+        model = SmartTextMapVectorizer(max_cardinality=10, min_support=1,
+                                       num_hashes=32).set_input(f).fit(ds)
+        out = model.transform(ds)[model.output_name]
+        block = np.asarray(out.data)
+        # color: 2 levels + OTHER + null = 4; desc: 32 hashes + null = 33
+        assert block.shape == (42, 37)
+        groups = {c.grouping for c in out.meta.columns}
+        assert groups == {"m_color", "m_desc"}
+        # last row {} -> null indicators set for both keys, nothing else
+        last = block[-1]
+        assert last.sum() == 2.0
+
+    def test_empty_maps_only(self):
+        f, ds = TestFeatureBuilder.of("m", TextMap, [{}, None])
+        model = SmartTextMapVectorizer().set_input(f).fit(ds)
+        out = model.transform(ds)[model.output_name]
+        assert np.asarray(out.data).shape == (2, 0)

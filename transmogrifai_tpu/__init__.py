@@ -32,6 +32,7 @@ from .ops import bucketizers  # noqa: F401 — registers decision-tree bucketize
 from .ops import misc  # noqa: F401 — registers misc value transformers + scalers
 from .ops import embeddings as _embeddings  # noqa: F401 — registers Word2Vec/LDA
 from .ops import ner as _ner  # noqa: F401 — registers NameEntityRecognizer
+from .ops import collections_lift as _lift  # noqa: F401 — registers map/list plumbing
 from .models import combiner as _combiner  # noqa: F401 — registers SelectedModelCombiner
 from . import dsl  # noqa: F401 — attaches the rich-feature DSL methods
 

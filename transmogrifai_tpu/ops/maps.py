@@ -218,12 +218,26 @@ class TextMapPivotVectorizerModel(Transformer):
 
 
 def transmogrify_maps(features: Sequence[Feature]) -> List[Feature]:
-    """Default vectorization for map features, grouped by value family."""
+    """Default vectorization for map features, grouped by value family.
+
+    Mirrors the reference's per-type defaults: date maps take the unit-circle
+    encoding (DateMapToUnitCircleVectorizer), free-text maps the per-key smart
+    categorical-vs-text decision (SmartTextMapVectorizer), categorical-string
+    and set maps the per-key pivot, numeric/boolean maps mean-fill + null track.
+    """
+    from ..types.maps import DateMap, TextAreaMap, TextMap
+
     numeric: List[Feature] = []
     stringy: List[Feature] = []
+    dateish: List[Feature] = []
+    smart_text: List[Feature] = []
     for f in features:
-        if issubclass(f.ftype, (_DoubleMap, _LongMap, _BooleanMap)):
+        if issubclass(f.ftype, DateMap):  # DateTimeMap subclasses DateMap
+            dateish.append(f)
+        elif issubclass(f.ftype, (_DoubleMap, _LongMap, _BooleanMap)):
             numeric.append(f)
+        elif issubclass(f.ftype, (TextMap, TextAreaMap)):
+            smart_text.append(f)
         elif issubclass(f.ftype, (_StringMap, _SetMap)):
             stringy.append(f)
         else:
@@ -246,6 +260,16 @@ def transmogrify_maps(features: Sequence[Feature]) -> List[Feature]:
             out.append(geo[0].transform_with(GeolocationMapVectorizer(), *geo[1:]))
     if stringy:
         out.append(stringy[0].transform_with(TextMapPivotVectorizer(), *stringy[1:]))
+    if smart_text:
+        from .text_smart import SmartTextMapVectorizer
+
+        out.append(smart_text[0].transform_with(
+            SmartTextMapVectorizer(), *smart_text[1:]))
+    if dateish:
+        from .collections_lift import DateMapToUnitCircleVectorizer
+
+        out.append(dateish[0].transform_with(
+            DateMapToUnitCircleVectorizer(), *dateish[1:]))
     return out
 
 

@@ -17,6 +17,7 @@ import numpy as np
 from ..data.dataset import Column
 from ..stages.base import Param, SequenceEstimator, Transformer
 from ..types import OPVector, Text
+from ..types.maps import _StringMap
 from ..native import hash_count_block
 from ..utils.text import tokenize
 from ..utils.vector_metadata import (
@@ -52,6 +53,47 @@ class TextStats:
         return len(self.value_counts)
 
 
+def _decide_plan(stats: TextStats, max_cardinality: int, min_support: int,
+                 top_k: int):
+    """(is_categorical, vocab): the SmartText decision rule, shared by the
+    scalar and map variants (decision SmartTextVectorizer.scala:92-106)."""
+    if 0 < stats.cardinality <= max_cardinality:
+        kept = [v for v, c in stats.value_counts.items() if c >= min_support]
+        kept = sorted(kept, key=lambda v: (-stats.value_counts[v], v))[:top_k]
+        return True, kept
+    return False, []
+
+
+def _categorical_block(values, vocab, clean_text: bool, track_nulls: bool):
+    """One-hot top-K + OTHER (+ null) block for a value list, shared layout."""
+    n = len(values)
+    k = len(vocab)
+    width = k + 1 + (1 if track_nulls else 0)
+    block = np.zeros((n, width), dtype=np.float32)
+    index: Dict[str, int] = {v: i for i, v in enumerate(vocab)}
+    for i, v in enumerate(values):
+        if not v:
+            if track_nulls:
+                block[i, k + 1] = 1.0
+            continue
+        key = clean_text_value(v) if clean_text else v
+        j = index.get(key)
+        block[i, j if j is not None else k] = 1.0
+    return block
+
+
+def _categorical_meta(f, vocab, grouping: str, track_nulls: bool):
+    tname = f.ftype.__name__
+    cols = [VectorColumnMetadata(f.name, tname, grouping=grouping,
+                                 indicator_value=level) for level in vocab]
+    cols.append(VectorColumnMetadata(f.name, tname, grouping=grouping,
+                                     indicator_value=OTHER_INDICATOR))
+    if track_nulls:
+        cols.append(VectorColumnMetadata(f.name, tname, grouping=grouping,
+                                         indicator_value=NULL_INDICATOR))
+    return cols
+
+
 class SmartTextVectorizer(SequenceEstimator):
     sequence_input_type = Text
     output_type = OPVector
@@ -72,14 +114,10 @@ class SmartTextVectorizer(SequenceEstimator):
             for v in col.data:
                 if v:
                     stats.update(clean_text_value(v) if self.clean_text else v)
-            if 0 < stats.cardinality <= self.max_cardinality:
-                is_categorical.append(True)
-                kept = [v for v, c in stats.value_counts.items() if c >= self.min_support]
-                kept = sorted(kept, key=lambda v: (-stats.value_counts[v], v))[: self.top_k]
-                vocabs.append(kept)
-            else:
-                is_categorical.append(False)
-                vocabs.append([])
+            cat, vocab = _decide_plan(stats, self.max_cardinality,
+                                      self.min_support, self.top_k)
+            is_categorical.append(cat)
+            vocabs.append(vocab)
         return SmartTextVectorizerModel(
             is_categorical=is_categorical,
             vocabs=vocabs,
@@ -112,26 +150,10 @@ class SmartTextVectorizerModel(Transformer):
         for f, col, cat, vocab in zip(self.inputs, cols, self.is_categorical, self.vocabs):
             tname = f.ftype.__name__
             if cat:
-                k = len(vocab)
-                width = k + 1 + (1 if self.track_nulls else 0)
-                block = np.zeros((n, width), dtype=np.float32)
-                index: Dict[str, int] = {v: i for i, v in enumerate(vocab)}
-                for i, v in enumerate(col.data):
-                    if not v:
-                        if self.track_nulls:
-                            block[i, k + 1] = 1.0
-                        continue
-                    key = clean_text_value(v) if self.clean_text else v
-                    j = index.get(key)
-                    block[i, j if j is not None else k] = 1.0
-                for level in vocab:
-                    meta_cols.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
-                                                          indicator_value=level))
-                meta_cols.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
-                                                      indicator_value=OTHER_INDICATOR))
-                if self.track_nulls:
-                    meta_cols.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
-                                                          indicator_value=NULL_INDICATOR))
+                block = _categorical_block(list(col.data), vocab,
+                                           self.clean_text, self.track_nulls)
+                meta_cols.extend(_categorical_meta(f, vocab, f.name,
+                                                   self.track_nulls))
             else:
                 width = self.num_hashes
                 block = hash_count_block([tokenize(v) for v in col.data], width)
@@ -153,6 +175,97 @@ class SmartTextVectorizerModel(Transformer):
                 if extras:
                     block = np.hstack([block] + extras)
             blocks.append(block)
+        meta = VectorMetadata(
+            self.output_name, meta_cols,
+            {f.name: f.history().to_dict() for f in self.inputs},
+        ).reindexed()
+        return Column.vector(np.hstack(blocks), meta)
+
+
+class SmartTextMapVectorizer(SequenceEstimator):
+    """Per-map-key categorical-vs-free-text decision (SmartTextMapVectorizer.scala:1-296).
+
+    One fit pass computes TextStats per (feature, key); each key independently
+    pivots as a categorical (<= max_cardinality distinct values -> top-K one-hot
+    + OTHER + null) or hashes as free text, exactly like the scalar
+    SmartTextVectorizer but with the map's key as the grouping.  Accepts any
+    string-valued map (TextMap, TextAreaMap, ...).
+    """
+
+    sequence_input_type = _StringMap
+    output_type = OPVector
+
+    max_cardinality = Param(default=MAX_CARDINALITY_DEFAULT)
+    num_hashes = Param(default=NUM_HASHES_DEFAULT)
+    top_k = Param(default=TOP_K_DEFAULT)
+    min_support = Param(default=MIN_SUPPORT_DEFAULT)
+    clean_text = Param(default=True)
+    track_nulls = Param(default=True)
+
+    def fit_columns(self, cols, dataset):
+        key_plans: List[Dict[str, dict]] = []
+        for col in cols:
+            stats: Dict[str, TextStats] = {}
+            for m in col.data:
+                for k, v in (m or {}).items():
+                    if v:
+                        st = stats.setdefault(k, TextStats())
+                        st.update(clean_text_value(v) if self.clean_text else v)
+            plan: Dict[str, dict] = {}
+            for k in sorted(stats):
+                cat, vocab = _decide_plan(stats[k], self.max_cardinality,
+                                          self.min_support, self.top_k)
+                plan[k] = {"categorical": cat, "vocab": vocab}
+            key_plans.append(plan)
+        return SmartTextMapVectorizerModel(
+            key_plans=key_plans, num_hashes=self.num_hashes,
+            clean_text=self.clean_text, track_nulls=self.track_nulls)
+
+
+class SmartTextMapVectorizerModel(Transformer):
+    sequence_input_type = _StringMap
+    output_type = OPVector
+
+    def __init__(self, key_plans: List[Dict[str, dict]],
+                 num_hashes: int = NUM_HASHES_DEFAULT, clean_text: bool = True,
+                 track_nulls: bool = True, **kw):
+        super().__init__(**kw)
+        self.key_plans = key_plans
+        self.num_hashes = num_hashes
+        self.clean_text = clean_text
+        self.track_nulls = track_nulls
+
+    def transform_columns(self, cols, dataset):
+        n = len(cols[0])
+        blocks: List[np.ndarray] = []
+        meta_cols: List[VectorColumnMetadata] = []
+        for f, col, plan in zip(self.inputs, cols, self.key_plans):
+            tname = f.ftype.__name__
+            for key, spec in plan.items():
+                grouping = f"{f.name}_{key}"
+                values = [(m or {}).get(key) for m in col.data]
+                if spec["categorical"]:
+                    block = _categorical_block(values, spec["vocab"],
+                                               self.clean_text, self.track_nulls)
+                    meta_cols.extend(_categorical_meta(f, spec["vocab"], grouping,
+                                                       self.track_nulls))
+                else:
+                    block = hash_count_block(
+                        [tokenize(v) for v in values], self.num_hashes)
+                    for b in range(self.num_hashes):
+                        meta_cols.append(VectorColumnMetadata(
+                            f.name, tname, grouping=grouping,
+                            descriptor_value=f"hash_{b}"))
+                    if self.track_nulls:
+                        nulls = np.array([0.0 if v else 1.0 for v in values],
+                                         dtype=np.float32)
+                        block = np.hstack([block, nulls[:, None]])
+                        meta_cols.append(VectorColumnMetadata(
+                            f.name, tname, grouping=grouping,
+                            indicator_value=NULL_INDICATOR))
+                blocks.append(block)
+        if not blocks:
+            blocks = [np.zeros((n, 0), np.float32)]
         meta = VectorMetadata(
             self.output_name, meta_cols,
             {f.name: f.history().to_dict() for f in self.inputs},

@@ -18,7 +18,7 @@ TPU-native design: shard the FEATURE dimension over the mesh's data axis with
 
 from __future__ import annotations
 
-from functools import partial
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -63,14 +63,9 @@ def shard_cols(arr: np.ndarray, mesh: Mesh):
     return jax.device_put(padded, col_sharding(mesh)), d_valid
 
 
-def wide_col_stats(x, y, mesh: Mesh, d_valid: Optional[int] = None):
-    """(mean, var, min, max, corr-with-label) per column, column-sharded.
-
-    Collective-free: every device owns complete columns of its shard and the
-    replicated label, so each statistic is a local reduction over rows.
-    Pass ``d_valid`` (from ``shard_cols``) to trim the zero-padded phantom
-    columns from every returned vector.
-    """
+@functools.lru_cache(maxsize=None)
+def _col_stats_fn(mesh: Mesh):
+    """Jitted column-stats program, cached per mesh so calls hit the jit cache."""
 
     def local_stats(xs, ys):
         n = xs.shape[0]
@@ -86,20 +81,29 @@ def wide_col_stats(x, y, mesh: Mesh, d_valid: Optional[int] = None):
         corr = cov / jnp.maximum(sx * sy, 1e-12)
         return mean, var, xmin, xmax, corr
 
-    fn = shard_map(
+    return jax.jit(shard_map(
         local_stats, mesh=mesh,
         in_specs=(P(None, DATA_AXIS), P()),
-        out_specs=(P(DATA_AXIS),) * 5)
-    out = jax.jit(fn)(x, y)
+        out_specs=(P(DATA_AXIS),) * 5))
+
+
+def wide_col_stats(x, y, mesh: Mesh, d_valid: Optional[int] = None):
+    """(mean, var, min, max, corr-with-label) per column, column-sharded.
+
+    Collective-free: every device owns complete columns of its shard and the
+    replicated label, so each statistic is a local reduction over rows.
+    Pass ``d_valid`` (from ``shard_cols``) to trim the zero-padded phantom
+    columns from every returned vector.
+    """
+    out = _col_stats_fn(mesh)(x, y)
     if d_valid is not None:
         out = tuple(v[:d_valid] for v in out)
     return out
 
 
-def wide_gram_ring(x, mesh: Mesh):
-    """X^T X / n for column-sharded X via a ppermute ring; returns (d, d) sharded
-    over rows of the gram matrix (each device owns its shard's block-row)."""
-
+@functools.lru_cache(maxsize=None)
+def _gram_ring_fn(mesh: Mesh):
+    """Jitted ring-gram program, cached per mesh."""
     k = mesh.shape[DATA_AXIS]
 
     def local_gram(xs):
@@ -126,10 +130,15 @@ def wide_gram_ring(x, mesh: Mesh):
         # blocks[j] = X_local^T X_j / n -> concat into the (d_local, d) block-row
         return jnp.concatenate([blocks[j] for j in range(k)], axis=1)
 
-    fn = shard_map(local_gram, mesh=mesh,
-                   in_specs=(P(None, DATA_AXIS),),
-                   out_specs=P(DATA_AXIS, None))
-    return jax.jit(fn)(x)
+    return jax.jit(shard_map(local_gram, mesh=mesh,
+                             in_specs=(P(None, DATA_AXIS),),
+                             out_specs=P(DATA_AXIS, None)))
+
+
+def wide_gram_ring(x, mesh: Mesh):
+    """X^T X / n for column-sharded X via a ppermute ring; returns (d, d) sharded
+    over rows of the gram matrix (each device owns its shard's block-row)."""
+    return _gram_ring_fn(mesh)(x)
 
 
 def wide_full_corr(x, mesh: Mesh, d_valid: Optional[int] = None):

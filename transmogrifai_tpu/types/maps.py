@@ -195,7 +195,7 @@ class DateMap(_LongMap):
 
 
 @register
-class DateTimeMap(_LongMap):
+class DateTimeMap(DateMap):  # DateTimeMap extends DateMap (reference Maps.scala)
     __slots__ = ()
 
 
