@@ -1,0 +1,40 @@
+"""DSL additions: tokenize/indexed/NER/embeddings/map-filter shortcuts."""
+
+import numpy as np
+
+from transmogrifai_tpu import FeatureBuilder, Workflow
+from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.types import PickList, Text, TextMap
+
+
+def test_text_dsl_chain():
+    ds = Dataset.from_features(
+        {"bio": ["Anna visited Paris today", "Stock prices rose sharply", None]},
+        {"bio": Text})
+    bio = FeatureBuilder.of("bio", Text).extract_field().as_predictor()
+    toks = bio.tokenize()
+    w2v = toks.word2vec(embedding_dim=4, epochs=1)
+    lda = toks.lda_topics(k=2, max_iter=3)
+    ner = bio.name_entity_tags()
+    model = Workflow().set_input_dataset(ds).set_result_features(w2v, lda, ner).train()
+    scored = model.score(ds)
+    assert np.asarray(scored[w2v.name].data).shape == (3, 4)
+    assert np.asarray(scored[lda.name].data).shape == (3, 2)
+    assert "Location" in scored[ner.name].to_values()[0]["Paris"]
+
+
+def test_indexed_dsl():
+    ds = Dataset.from_features({"species": ["a", "b", "a"]}, {"species": PickList})
+    label = FeatureBuilder.of("species", PickList).extract_field().as_response()
+    idx = label.indexed()
+    assert idx.is_response
+    model = Workflow().set_input_dataset(ds).set_result_features(idx).train()
+    assert model.score(ds)[idx.name].to_values() == [0.0, 1.0, 0.0]
+
+
+def test_filter_keys_dsl():
+    ds = Dataset.from_features({"m": [{"a": "x", "b": "y"}]}, {"m": TextMap})
+    m = FeatureBuilder.of("m", TextMap).extract_field().as_predictor()
+    kept = m.filter_keys(white_list=["a"])
+    model = Workflow().set_input_dataset(ds).set_result_features(kept).train()
+    assert model.score(ds)[kept.name].to_values() == [{"a": "x"}]

@@ -1,0 +1,79 @@
+"""use_mesh: row-sharded workflow fits on the 8-device mesh (SURVEY §5.8)."""
+
+import jax
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    Dataset,
+    FeatureBuilder,
+    Workflow,
+    transmogrify,
+)
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.parallel.mesh import (
+    current_mesh,
+    make_mesh,
+    pad_rows_for_mesh,
+    place_rows,
+    use_mesh,
+)
+from transmogrifai_tpu.types import Real, RealNN
+
+
+def _pipeline(n=203, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    cols = {f"x{i}": rng.normal(size=n).tolist() for i in range(d)}
+    beta = rng.normal(size=d)
+    z = sum(beta[i] * np.asarray(cols[f"x{i}"]) for i in range(d))
+    cols["label"] = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(float).tolist()
+    ds = Dataset.from_features(
+        cols, {**{f"x{i}": Real for i in range(d)}, "label": RealNN})
+    label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+    feats = [FeatureBuilder.of(f"x{i}", Real).extract_field().as_predictor()
+             for i in range(d)]
+    checked = label.sanity_check(transmogrify(feats))
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        models=[(LogisticRegression(), [{"reg_param": r} for r in (0.01, 0.1)])])
+    pred = label.transform_with(sel, checked)
+    return ds, label, pred
+
+
+class TestUseMesh:
+    def test_context_sets_and_resets(self):
+        assert current_mesh() is None
+        with use_mesh(make_mesh()) as m:
+            assert current_mesh() is m
+        assert current_mesh() is None
+
+    def test_meshed_train_matches_unmeshed(self):
+        """Row counts not divisible by 8: padding + masking must keep results exact."""
+        ds, label, pred = _pipeline()
+        m1 = Workflow().set_input_dataset(ds).set_result_features(label, pred).train()
+        s1 = np.asarray(m1.score(ds)[pred.name].score)
+        with use_mesh(make_mesh()):
+            m2 = (Workflow().set_input_dataset(ds)
+                  .set_result_features(label, pred).train())
+        s2 = np.asarray(m2.score(ds)[pred.name].score)
+        np.testing.assert_allclose(s1, s2, atol=1e-5)
+        assert m1.summary().best_model_name == m2.summary().best_model_name
+
+    def test_place_rows_shards_over_data_axis(self):
+        mesh = make_mesh()
+        x = np.zeros((24, 3), np.float32)
+        with use_mesh(mesh):
+            xd = place_rows(x)
+        shapes = {s.data.shape for s in xd.addressable_shards}
+        assert shapes == {(3, 3)}  # 24 rows / 8 devices
+
+    def test_pad_rows_for_mesh(self):
+        with use_mesh(make_mesh()):
+            a, b, n_valid = pad_rows_for_mesh(np.ones((10, 2)), np.ones(10))
+        assert n_valid == 10
+        assert a.shape == (16, 2) and b.shape == (16,)
+        assert (a[10:] == 0).all()
+
+    def test_no_mesh_is_noop(self):
+        a, n_valid = pad_rows_for_mesh(np.ones((10, 2)))
+        assert n_valid == 10 and a.shape == (10, 2)

@@ -66,22 +66,32 @@ class SanityCheckerSummary:
 
 
 @partial(jax.jit, static_argnames=("compute_full_corr",))
-def _device_stats(x: jnp.ndarray, y: jnp.ndarray, compute_full_corr: bool = False):
-    """Moments + label correlation in one XLA program (row reductions -> psum over mesh)."""
-    n = x.shape[0]
-    mean = x.mean(axis=0)
-    var = x.var(axis=0)
-    xmin = x.min(axis=0)
-    xmax = x.max(axis=0)
-    xc = x - mean
-    yc = y - y.mean()
-    cov = xc.T @ yc / n
-    sx = jnp.sqrt((xc ** 2).mean(axis=0))
-    sy = jnp.sqrt((yc ** 2).mean())
+def _device_stats(x: jnp.ndarray, y: jnp.ndarray, m: jnp.ndarray,
+                  n_valid: jnp.ndarray, compute_full_corr: bool = False):
+    """Masked moments + label correlation in one XLA program.
+
+    ``m`` is a 0/1 row mask: padded rows (mesh sharding needs even splits)
+    contribute nothing.  ``n_valid`` is the exact host-side row count — used as
+    the divisor instead of ``m.sum()`` so counts beyond float32's exact-integer
+    range don't accumulate reduction error.  Row reductions become psums over
+    ICI when the inputs are row-sharded (use_mesh).
+    """
+    tot = jnp.asarray(n_valid, x.dtype)
+    mw = m[:, None]
+    mean = (x * mw).sum(axis=0) / tot
+    xc = (x - mean) * mw
+    var = (xc ** 2).sum(axis=0) / tot
+    xmin = jnp.where(mw > 0, x, jnp.inf).min(axis=0)
+    xmax = jnp.where(mw > 0, x, -jnp.inf).max(axis=0)
+    ymean = (y * m).sum() / tot
+    yc = (y - ymean) * m
+    cov = xc.T @ yc / tot
+    sx = jnp.sqrt((xc ** 2).sum(axis=0) / tot)
+    sy = jnp.sqrt((yc ** 2).sum() / tot)
     corr = cov / (sx * sy)
     full = None
     if compute_full_corr:
-        c = (xc.T @ xc) / n
+        c = (xc.T @ xc) / tot
         denom = sx[:, None] * sx[None, :]
         full = c / denom
     return mean, var, xmin, xmax, corr, full
@@ -135,14 +145,25 @@ class SanityChecker(BinaryEstimator):
         names = meta.column_names()
 
         compute_full = d <= self.max_features_for_full_corr
+        # Under an ambient mesh the row blocks shard over the data axis and the
+        # row reductions below become psums over ICI (use_mesh, SURVEY §5.8).
+        # Rows zero-pad to the mesh multiple; the mask keeps statistics exact.
+        from ..parallel.mesh import pad_rows_for_mesh, place_rows
+
+        mask = np.ones(n, np.float32)
+        x_p, y_p, mask_p, _ = pad_rows_for_mesh(x, y, mask)
+        x_dev, y_lab_dev = place_rows(x_p), place_rows(y_p)
+        mask_dev = place_rows(mask_p)
         if self.correlation_type == "spearman":
             corr = npstats.spearman_with_label(x, y)
             mean_, var_, min_, max_, _, full = map(
-                _to_np, _device_stats(jnp.asarray(x), jnp.asarray(y), compute_full)
+                _to_np, _device_stats(x_dev, y_lab_dev, mask_dev, float(n),
+                                      compute_full)
             )
         else:
             mean_, var_, min_, max_, corr, full = map(
-                _to_np, _device_stats(jnp.asarray(x), jnp.asarray(y), compute_full)
+                _to_np, _device_stats(x_dev, y_lab_dev, mask_dev, float(n),
+                                      compute_full)
             )
 
         # --- categorical label? (reference heuristic SanityChecker.scala:447) ----
@@ -159,9 +180,10 @@ class SanityChecker(BinaryEstimator):
         groups = meta.grouping_keys()
         if label_is_cat and groups:
             y_onehot = (y[:, None] == label_levels[None, :]).astype(np.float32)
-            y_dev = jnp.asarray(y_onehot)
+            # zero-padded rows contribute nothing to g.T @ y_onehot — no mask needed
+            y_dev = place_rows(pad_rows_for_mesh(y_onehot)[0])
             for gkey, indices in groups.items():
-                g = jnp.asarray(x[:, indices])
+                g = place_rows(pad_rows_for_mesh(x[:, indices])[0])
                 cont = np.asarray(_device_contingency(g, y_dev))
                 group_v[gkey] = npstats.cramers_v(cont)
                 conf, support = npstats.max_rule_confidences(cont)

@@ -87,6 +87,51 @@ def _vectorize_seq(features: Sequence[Feature], **kw) -> Feature:
     return transmogrify(features, **kw)
 
 
+def _tokenize(self: Feature, **kw) -> Feature:
+    """Text -> TextList (RichTextFeature.tokenize)."""
+    from .ops.text import TextTokenizer
+
+    return self.transform_with(TextTokenizer(**kw))
+
+
+def _indexed(self: Feature, **kw) -> Feature:
+    """Text-like -> RealNN label index (RichTextFeature.indexed)."""
+    from .ops.onehot import StringIndexer
+
+    return self.transform_with(StringIndexer(**kw))
+
+
+def _name_entity_tags(self: Feature) -> Feature:
+    """Text -> MultiPickListMap of token -> entity types (RichTextFeature NER)."""
+    from .ops.ner import NameEntityRecognizer
+
+    return self.transform_with(NameEntityRecognizer())
+
+
+def _word2vec(self: Feature, **kw) -> Feature:
+    """TextList -> averaged skip-gram embedding vector (RichTextFeature.word2vec)."""
+    from .ops.embeddings import Word2Vec
+
+    return self.transform_with(Word2Vec(**kw))
+
+
+def _lda_topics(self: Feature, **kw) -> Feature:
+    """TextList -> LDA topic-proportion vector (RichTextFeature.lda)."""
+    from .ops.embeddings import LDA
+
+    return self.transform_with(LDA(**kw))
+
+
+def _filter_keys(self: Feature, white_list=(), black_list=(),
+                 filter_empty: bool = True) -> Feature:
+    """Map -> map with key white/black-listing (RichMapFeature.filter)."""
+    from .ops.collections_lift import FilterMap
+
+    return self.transform_with(FilterMap(
+        white_list_keys=tuple(white_list), black_list_keys=tuple(black_list),
+        filter_empty=filter_empty))
+
+
 Feature.__add__ = _binary_op("plus")
 Feature.__sub__ = _binary_op("minus")
 Feature.__mul__ = _binary_op("multiply")
@@ -99,5 +144,11 @@ Feature.auto_bucketize = _auto_bucketize
 Feature.map_to = _map_to
 Feature.alias = _alias
 Feature.sanity_check = _sanity_check
+Feature.tokenize = _tokenize
+Feature.indexed = _indexed
+Feature.name_entity_tags = _name_entity_tags
+Feature.word2vec = _word2vec
+Feature.lda_topics = _lda_topics
+Feature.filter_keys = _filter_keys
 
 __all__ = ["transmogrify"]

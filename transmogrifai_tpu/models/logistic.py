@@ -126,8 +126,19 @@ class LogisticRegression(PredictionEstimatorBase):
                                                g.get("elastic_net", self.elastic_net))
              for g in grids], dtype=jnp.float32)
         xs, _, _ = self._prepare(x, np.ones(x.shape[0], dtype=np.float32))
-        xd, yd = jnp.asarray(xs), jnp.asarray(y)
-        betas = _irls_sweep(xd, yd, jnp.asarray(train_w), regs, self.max_iter)  # (g,k,d+1)
+        # Under an ambient mesh: rows zero-pad to the data-axis multiple (safe —
+        # fold weights pad to zero, so padded rows never enter the weighted
+        # IRLS or the validation metric) and shard over the data axis.
+        from ..parallel.mesh import DATA_AXIS, pad_rows_for_mesh, place, place_rows
+
+        xs_p, y_p, n_valid = pad_rows_for_mesh(xs, np.asarray(y))
+        pad = xs_p.shape[0] - n_valid
+        train_w_p = np.pad(np.asarray(train_w), [(0, 0), (0, pad)])
+        val_w_p = np.pad(np.asarray(val_w), [(0, 0), (0, pad)])
+        xd, yd = place_rows(xs_p), place_rows(y_p)
+        train_w = place(train_w_p, (None, DATA_AXIS))
+        val_w = place(val_w_p, (None, DATA_AXIS))
+        betas = _irls_sweep(xd, yd, train_w, regs, self.max_iter)  # (g,k,d+1)
 
         @jax.jit
         def eval_gk(betas, vw):
@@ -135,7 +146,7 @@ class LogisticRegression(PredictionEstimatorBase):
             per_fold = jax.vmap(lambda s, w_: metric_fn(s, yd, w_), in_axes=(0, 0))
             return jax.vmap(lambda ps: per_fold(ps, vw), in_axes=0)(probs)
 
-        return np.asarray(eval_gk(betas, jnp.asarray(val_w)))
+        return np.asarray(eval_gk(betas, val_w))
 
 
 class LogisticRegressionModel(PredictionModelBase):

@@ -208,8 +208,11 @@ class SmartTextMapVectorizer(SequenceEstimator):
             stats: Dict[str, TextStats] = {}
             for m in col.data:
                 for k, v in (m or {}).items():
+                    # register every seen key — keys with only empty values still
+                    # need a plan (hashed block + null indicator), like the
+                    # scalar vectorizer's all-empty column handling
+                    st = stats.setdefault(k, TextStats())
                     if v:
-                        st = stats.setdefault(k, TextStats())
                         st.update(clean_text_value(v) if self.clean_text else v)
             plan: Dict[str, dict] = {}
             for k in sorted(stats):

@@ -88,3 +88,80 @@ def row_mask(n_padded: int, n_valid: int):
     import jax.numpy as jnp
 
     return (jnp.arange(n_padded) < n_valid).astype(jnp.float32)
+
+
+# --- ambient mesh context ---------------------------------------------------
+# Stages consult current_mesh() at fit time: when set, they place their row
+# blocks with row_sharding(mesh) so XLA turns the row reductions into psums
+# over ICI (the Spark treeAggregate / Rabit role, SURVEY §5.8).
+import contextvars as _contextvars
+
+_CURRENT_MESH: "_contextvars.ContextVar[Optional[Mesh]]" = _contextvars.ContextVar(
+    "transmogrifai_tpu_mesh", default=None)
+
+
+class use_mesh:
+    """Context manager: run workflow fits with row blocks sharded over `mesh`.
+
+    >>> with use_mesh(make_mesh()):
+    ...     model = workflow.train()
+    """
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self._token = None
+
+    def __enter__(self) -> Mesh:
+        self._token = _CURRENT_MESH.set(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc) -> None:
+        _CURRENT_MESH.reset(self._token)
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT_MESH.get()
+
+
+def place_rows(arr, mesh: Optional[Mesh] = None):
+    """Device-put with rows sharded over the ambient (or given) mesh; no-op
+    placement when no mesh is active.  Uneven row counts are fine — GSPMD
+    handles non-divisible shardings."""
+    import jax.numpy as jnp
+
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None:
+        return jnp.asarray(arr)
+    return jax.device_put(np.asarray(arr), row_sharding(mesh))
+
+
+def place(arr, axes: Tuple[Optional[str], ...], mesh: Optional[Mesh] = None):
+    """Device-put with an explicit PartitionSpec over the ambient (or given)
+    mesh; plain jnp.asarray when no mesh is active."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec
+
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None:
+        return jnp.asarray(arr)
+    return jax.device_put(np.asarray(arr), NamedSharding(mesh, PartitionSpec(*axes)))
+
+
+def pad_rows_for_mesh(*arrays, mesh: Optional[Mesh] = None):
+    """Zero-pad the leading axis of each array to the mesh's data-axis multiple.
+
+    Returns (padded_arrays..., n_valid).  No-op (n_valid = original rows) when
+    no mesh is active.  Zero padding is safe wherever rows enter weighted sums
+    (weights pad to zero) or masked statistics.
+    """
+    mesh = mesh if mesh is not None else current_mesh()
+    first = np.asarray(arrays[0])
+    if mesh is None:
+        return (*arrays, first.shape[0])
+    mult = mesh.shape[DATA_AXIS]
+    out = []
+    n_valid = first.shape[0]
+    for a in arrays:
+        padded, _ = pad_axis(np.asarray(a), 0, mult)
+        out.append(padded)
+    return (*out, n_valid)

@@ -256,6 +256,14 @@ class WorkflowModel:
 
         return extract_model_insights(self)
 
+    def score_function(self):
+        """Engine-free serving closure (reference model.scoreFunction,
+        OpWorkflowModelLocal.scala:93): ``scorer(record) -> {result: value}``,
+        plus ``scorer.batch(records)`` for columnar multi-record scoring."""
+        from ..local.scoring import score_function
+
+        return score_function(self)
+
     # -- persistence ---------------------------------------------------------
     def save(self, path: str) -> None:
         from .serde import save_model
