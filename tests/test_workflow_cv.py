@@ -1,0 +1,168 @@
+"""Workflow-level CV: cut_dag + in-fold feature engineering (SURVEY §2.6 cutDAG)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    Dataset,
+    FeatureBuilder,
+    Workflow,
+    transmogrify,
+)
+from transmogrifai_tpu.checkers.sanity import SanityChecker
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.types import Real, RealNN
+from transmogrifai_tpu.utils.listener import (
+    OpMetricsListener,
+    add_listener,
+    remove_listener,
+)
+from transmogrifai_tpu.workflow.dag import cut_dag
+
+
+def _pipeline(n=240, d=5, seed=0):
+    rng = np.random.default_rng(seed)
+    cols = {f"x{i}": rng.normal(size=n).tolist() for i in range(d)}
+    beta = rng.normal(size=d)
+    z = sum(beta[i] * np.asarray(cols[f"x{i}"]) for i in range(d))
+    cols["label"] = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(float).tolist()
+    ds = Dataset.from_features(
+        cols, {**{f"x{i}": Real for i in range(d)}, "label": RealNN})
+    label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+    feats = [FeatureBuilder.of(f"x{i}", Real).extract_field().as_predictor()
+             for i in range(d)]
+    vec = transmogrify(feats)
+    checked = label.sanity_check(vec)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3,
+        models=[(LogisticRegression(), [{"reg_param": r} for r in (0.01, 0.1)])])
+    pred = label.transform_with(sel, checked)
+    return ds, label, vec, checked, pred
+
+
+class TestCutDag:
+    def test_splits_before_and_during(self):
+        ds, label, vec, checked, pred = _pipeline()
+        before, during, selector = cut_dag([label, pred])
+        before_cls = {type(s).__name__ for s in before}
+        during_cls = {type(s).__name__ for s in during}
+        # vectorizers/combiner are label-independent -> before
+        assert "SanityChecker" in during_cls  # label-dependent estimator
+        assert "SanityChecker" not in before_cls
+        assert selector is pred.origin_stage
+
+    def test_no_selector_returns_none(self):
+        ds, label, vec, checked, pred = _pipeline()
+        assert cut_dag([label, vec]) is None
+
+
+class TestWorkflowCV:
+    def test_trains_and_scores(self):
+        ds, label, vec, checked, pred = _pipeline()
+        wf = (Workflow().set_input_dataset(ds)
+              .set_result_features(label, pred).with_workflow_cv())
+        model = wf.train()
+        s = model.summary()
+        assert s.best_model_name == "LogisticRegression"
+        # 2 grid points, metrics from 3 folds each
+        assert len(s.validation_results) == 2
+        assert all(len(ev.metric_values) == 3 for ev in s.validation_results)
+        scored = model.score(ds)
+        assert len(scored[pred.name]) == ds.n_rows
+
+    def test_sanity_checker_refits_per_fold(self):
+        """The leakage-safety property: the label-dependent stage fits k+1 times
+        (once per fold + once on the full train set), not once."""
+        ds, label, vec, checked, pred = _pipeline()
+        listener = add_listener(OpMetricsListener())
+        try:
+            (Workflow().set_input_dataset(ds)
+             .set_result_features(label, pred).with_workflow_cv().train())
+        finally:
+            remove_listener(listener)
+        sc_fits = [m for m in listener.metrics.stage_metrics
+                   if m.stage_class == "SanityChecker" and m.phase == "fit"]
+        assert len(sc_fits) == 4  # 3 folds + final full fit
+
+    def test_matches_plain_cv_selection(self):
+        """Same data, both CV modes: selection lands on the same model family
+        (values differ because in-fold refits shift the metrics slightly)."""
+        ds, label, vec, checked, pred = _pipeline()
+        plain = (Workflow().set_input_dataset(ds)
+                 .set_result_features(label, pred).train())
+        ds2, label2, _, _, pred2 = _pipeline()
+        wcv = (Workflow().set_input_dataset(ds2)
+               .set_result_features(label2, pred2).with_workflow_cv().train())
+        assert plain.summary().best_model_name == wcv.summary().best_model_name
+
+    def test_requires_selector(self):
+        ds, label, vec, checked, pred = _pipeline()
+        wf = (Workflow().set_input_dataset(ds)
+              .set_result_features(label, vec).with_workflow_cv())
+        with pytest.raises(ValueError, match="ModelSelector"):
+            wf.train()
+
+    def test_selector_preseed_cleared_after_train(self):
+        ds, label, vec, checked, pred = _pipeline()
+        wf = (Workflow().set_input_dataset(ds)
+              .set_result_features(label, pred).with_workflow_cv())
+        wf.train()
+        assert not hasattr(pred.origin_stage, "_preselected")
+
+
+class TestIndexedLabelWorkflowCV:
+    def test_string_label_via_indexed(self):
+        """Label-producing estimators (StringIndexer on the response) belong to
+        the 'before' pass — the standard string-label pattern must work."""
+        from transmogrifai_tpu.types import PickList
+
+        rng = np.random.default_rng(3)
+        n = 150
+        x = rng.normal(size=n)
+        y = np.where(x + rng.normal(0, 0.5, n) > 0, "yes", "no")
+        ds = Dataset.from_features({"x": x.tolist(), "outcome": y.tolist()},
+                                   {"x": Real, "outcome": PickList})
+        outcome = FeatureBuilder.of("outcome", PickList).extract_field().as_response()
+        xf = FeatureBuilder.of("x", Real).extract_field().as_predictor()
+        label = outcome.indexed()
+        vec = transmogrify([xf])
+        checked = label.sanity_check(vec)
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=3,
+            models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+        pred = label.transform_with(sel, checked)
+        before, during, _ = cut_dag([label, pred])
+        assert "StringIndexer" in {type(s).__name__ for s in before}
+        model = (Workflow().set_input_dataset(ds)
+                 .set_result_features(label, pred).with_workflow_cv().train())
+        assert model.summary().best_model_name == "LogisticRegression"
+
+    def test_splitter_weights_flow_into_workflow_cv(self):
+        """DataBalancer weights must shape the workflow-CV metrics like they do
+        selector-level CV (imbalanced data)."""
+        from transmogrifai_tpu.models.tuning import DataBalancer
+        from transmogrifai_tpu.models.selector import ModelSelector
+        from transmogrifai_tpu.models.tuning import CrossValidator
+        from transmogrifai_tpu.evaluators.base import BinaryClassificationEvaluator
+
+        rng = np.random.default_rng(4)
+        n = 400
+        x = rng.normal(size=n)
+        yv = (rng.random(n) < np.clip(0.05 + 0.2 * (x > 1.0), 0, 1)).astype(float)
+        ds = Dataset.from_features({"x": x.tolist(), "label": yv.tolist()},
+                                   {"x": Real, "label": RealNN})
+        label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+        xf = FeatureBuilder.of("x", Real).extract_field().as_predictor()
+        vec = transmogrify([xf])
+        checked = label.sanity_check(vec)
+        sel = ModelSelector(
+            models=[(LogisticRegression(), [{"reg_param": 0.01}])],
+            validator=CrossValidator(BinaryClassificationEvaluator(), num_folds=3),
+            splitter=DataBalancer(sample_fraction=0.4))
+        pred = label.transform_with(sel, checked)
+        model = (Workflow().set_input_dataset(ds)
+                 .set_result_features(label, pred).with_workflow_cv().train())
+        s = model.summary()
+        assert s.best_model_name == "LogisticRegression"
+        assert all(np.isfinite(v) for v in s.validation_results[0].metric_values)

@@ -132,7 +132,11 @@ class ModelSelector(PredictionEstimatorBase):
         if "__sample_weight__" in dataset:
             base_w = base_w * dataset["__sample_weight__"].data.astype(np.float32)
 
-        result: ValidationResult = self.validator.validate(self.models, x, y, base_w)
+        # workflow-level CV pre-seeds the validation result (in-fold feature
+        # engineering done by Workflow.train; reference ModelSelector receives
+        # the BestEstimator from OpWorkflow.fitStages the same way)
+        result: ValidationResult = getattr(self, "_preselected", None) \
+            or self.validator.validate(self.models, x, y, base_w)
         best_eval = result.best
         best_est = next(e for e, _ in self.models if e.uid == best_eval.model_uid)
         final_est = best_est.copy().set_params(**best_eval.grid)

@@ -56,3 +56,86 @@ def raw_feature_generators(result_features: Sequence[Feature]) -> List[FeatureGe
 def all_stages(result_features: Sequence[Feature]) -> List["PipelineStage"]:
     """Every non-generator stage in execution order (flattened layers)."""
     return [s for layer in compute_dag(result_features) for s in layer]
+
+
+def cut_dag(result_features: Sequence[Feature]):
+    """Split the DAG around the (single) ModelSelector for workflow-level CV.
+
+    Reference: FitStagesUtil.cutDAG (FitStagesUtil.scala:305-358) — the stages
+    between raw features and the selector that see the label must be re-fit
+    inside every CV fold, or their fit leaks validation labels into the CV
+    estimate.
+
+    Returns (before, during, selector):
+    - ``before``: label-independent upstream stages (fit once, outside CV)
+    - ``during``: label-dependent upstream estimators + everything downstream
+      of them up to the selector (re-fit per fold)
+    - ``selector``: the ModelSelector stage
+
+    Returns None when the DAG has no ModelSelector; raises on more than one.
+    """
+    from ..models.selector import ModelSelector
+    from ..stages.base import Estimator
+
+    stages_topo = [s for layer in compute_dag(result_features) for s in layer]
+    selectors = [s for s in stages_topo if isinstance(s, ModelSelector)]
+    if not selectors:
+        return None
+    if len(selectors) > 1:
+        raise ValueError(
+            "workflow-level CV requires exactly one ModelSelector in the DAG; "
+            f"found {len(selectors)}")
+    sel = selectors[0]
+
+    upstream: set = set()
+
+    def collect(f: Feature) -> None:
+        st = f.origin_stage
+        if st is None or isinstance(st, FeatureGeneratorStage):
+            return
+        if st.uid in upstream:
+            return
+        upstream.add(st.uid)
+        for p in st.inputs:
+            collect(p)
+
+    for f in sel.inputs:
+        collect(f)
+
+    # Stages that PRODUCE the label (e.g. a StringIndexer on a text response)
+    # are not leakage — they are the label.  They fit once, in `before`.
+    label_path: set = set()
+
+    def collect_label(f: Feature) -> None:
+        st = f.origin_stage
+        if st is None or isinstance(st, FeatureGeneratorStage):
+            return
+        if st.uid in label_path:
+            return
+        label_path.add(st.uid)
+        for p in st.inputs:
+            collect_label(p)
+
+    collect_label(sel.inputs[0])
+
+    during: set = {
+        s.uid for s in stages_topo
+        if s.uid in upstream and s.uid not in label_path
+        and isinstance(s, Estimator)
+        and any(f.is_response for f in s.inputs)
+    }
+    changed = True
+    while changed:
+        changed = False
+        for s in stages_topo:
+            if s.uid in upstream and s.uid not in during and any(
+                    p.origin_stage is not None
+                    and not isinstance(p.origin_stage, FeatureGeneratorStage)
+                    and p.origin_stage.uid in during
+                    for p in s.inputs):
+                during.add(s.uid)
+                changed = True
+
+    before = [s for s in stages_topo if s.uid in upstream and s.uid not in during]
+    during_stages = [s for s in stages_topo if s.uid in during]
+    return before, during_stages, sel

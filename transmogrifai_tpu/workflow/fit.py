@@ -32,17 +32,7 @@ def fit_dag(
     """
     fitted = dict(fitted or {})
     for layer in compute_dag(result_features):
-        for stage in layer:
-            runner = _resolve(stage, fitted)
-            if runner is None:
-                with stage_timer(stage, "fit", dataset) as finish:
-                    model = stage.fit(dataset)
-                    finish(None)
-                fitted[stage.uid] = model
-                runner = model
-            with stage_timer(runner, "transform", dataset) as finish:
-                dataset = runner.transform(dataset)
-                finish(dataset)
+        dataset = fit_stage_list(dataset, layer, fitted)
     return dataset, fitted
 
 
@@ -73,3 +63,90 @@ def _resolve(stage: PipelineStage, fitted: Dict[str, Transformer]) -> Transforme
         return None
     assert isinstance(stage, Transformer)
     return stage
+
+
+def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer]
+                   ) -> Dataset:
+    """Fit/transform an explicit stage list (topological order) in place of the
+    full-DAG walk — used by the workflow-CV before/during passes."""
+    for stage in stages:
+        runner = _resolve(stage, fitted)
+        if runner is None:
+            with stage_timer(stage, "fit", dataset) as finish:
+                model = stage.fit(dataset)
+                finish(None)
+            fitted[stage.uid] = model
+            runner = model
+        with stage_timer(runner, "transform", dataset) as finish:
+            dataset = runner.transform(dataset)
+            finish(dataset)
+    return dataset
+
+
+def workflow_cv_validate(ds_before: Dataset, during, selector) -> "object":
+    """In-fold feature engineering CV (reference OpWorkflow.fitStages withWorkflowCV,
+    FitStagesUtil.scala:305-358 + OpWorkflow.scala:403-438).
+
+    For every fold: re-fit copies of the label-dependent ``during`` stages on the
+    fold's training rows only, transform ALL rows with those fold-fitted stages,
+    then sweep every (estimator, grid) on the fold.  Metrics aggregate fold-robustly
+    exactly like the selector-level CV.  Returns a ValidationResult to pre-seed the
+    selector.
+    """
+    import numpy as np
+
+    from ..models.tuning import ModelEvaluation, ValidationResult
+
+    label_f, vec_f = selector.inputs[0], selector.inputs[1]
+    y = ds_before[label_f.name].data.astype(np.float32) \
+        if label_f.name in ds_before else None
+    if y is None:
+        raise ValueError("workflow CV: label column not materialized before selector")
+    # same base weights as selector-level CV (selector.py fit_columns):
+    # splitter rebalancing/cutting + dataset sample weights
+    base_w, _ = (selector.splitter.prepare(y) if selector.splitter is not None
+                 else (np.ones_like(y, dtype=np.float32), None))
+    if "__sample_weight__" in ds_before:
+        base_w = base_w * ds_before["__sample_weight__"].data.astype(np.float32)
+    validator = selector.validator
+    train_w, val_w = validator.fold_weights(y, base_w)
+    k = train_w.shape[0]
+    metric_fn = validator.evaluator.metric_fn()
+
+    # metric matrix per (model, grid) across folds
+    per_key: Dict[tuple, list] = {}
+    for f in range(k):
+        train_rows = np.flatnonzero(train_w[f] > 0)
+        ds_fold_train = ds_before.take(train_rows)
+        fold_fitted: Dict[str, Transformer] = {}
+        # fit during-stage copies on the fold's training rows only
+        fit_stage_list(ds_fold_train, [s.copy() for s in during], fold_fitted)
+        # apply fold-fitted stages to ALL rows (train + validation)
+        ds_fold_full = ds_before
+        for s in during:
+            ds_fold_full = fold_fitted[s.uid].transform(ds_fold_full)
+        x_f = ds_fold_full[vec_f.name].data.astype(np.float32)
+        for est, grids in selector.models:
+            grids = grids or [{}]
+            try:
+                scores = est.cv_sweep(x_f, y, train_w[f:f + 1], val_w[f:f + 1],
+                                      grids, metric_fn)
+            except Exception as e:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "model %s failed in workflow CV fold %d (%s)",
+                    type(est).__name__, f, e)
+                scores = np.full((len(grids), 1), np.nan)
+            for gi, grid in enumerate(grids):
+                per_key.setdefault(
+                    (est.uid, type(est).__name__, gi, tuple(sorted(grid.items()))),
+                    []).append(float(scores[gi, 0]))
+
+    evaluations = []
+    for (uid, name, gi, grid_items), vals in per_key.items():
+        evaluations.append(ModelEvaluation(
+            model_name=name, model_uid=uid, grid=dict(grid_items),
+            metric_name=validator.evaluator.default_metric, metric_values=vals))
+    best = validator._best_index(evaluations)
+    return ValidationResult(evaluations, best)

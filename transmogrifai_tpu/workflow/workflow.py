@@ -45,6 +45,14 @@ class Workflow:
         self._blacklist: List[str] = []
         self._warm_models: Dict[str, Transformer] = {}
         self._op_params = None
+        self._workflow_cv = False
+
+    def with_workflow_cv(self) -> "Workflow":
+        """Move the CV loop outside the ModelSelector (OpWorkflowCore.withWorkflowCV
+        :104): label-dependent feature-engineering stages re-fit inside every fold,
+        so the CV estimate carries no label leakage from those fits."""
+        self._workflow_cv = True
+        return self
 
     # -- configuration -------------------------------------------------------
     def set_result_features(self, *features: Feature) -> "Workflow":
@@ -119,7 +127,27 @@ class Workflow:
         if test_fraction > 0.0:
             train_ds, test_ds = raw.split(test_fraction, seed=seed)
 
-        _, fitted = fit_dag(train_ds, self.result_features, fitted=self._warm_models)
+        preseeded_selector = None
+        warm = self._warm_models
+        if self._workflow_cv:
+            from .dag import cut_dag
+            from .fit import fit_stage_list, workflow_cv_validate
+
+            cut = cut_dag(self.result_features)
+            if cut is None:
+                raise ValueError("with_workflow_cv requires a ModelSelector in the DAG")
+            before, during, selector = cut
+            warm = dict(self._warm_models)
+            ds_before = fit_stage_list(train_ds, before, warm)
+            selector._preselected = workflow_cv_validate(ds_before, during, selector)
+            preseeded_selector = selector
+
+        try:
+            _, fitted = fit_dag(train_ds, self.result_features, fitted=warm)
+        finally:
+            if preseeded_selector is not None and hasattr(
+                    preseeded_selector, "_preselected"):
+                del preseeded_selector._preselected
 
         model = WorkflowModel(
             result_features=self.result_features,
