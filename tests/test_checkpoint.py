@@ -1,0 +1,111 @@
+"""Stage-granular checkpoint/resume (SURVEY §5.4 sweep-level resume)."""
+
+import numpy as np
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    Dataset,
+    FeatureBuilder,
+    Workflow,
+    transmogrify,
+)
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.types import Real, RealNN
+from transmogrifai_tpu.utils.listener import (
+    OpMetricsListener,
+    add_listener,
+    remove_listener,
+)
+from transmogrifai_tpu.workflow.checkpoint import StageCheckpointer
+
+
+def _pipeline(seed=0):
+    rng = np.random.default_rng(seed)
+    n = 160
+    cols = {f"x{i}": rng.normal(size=n).tolist() for i in range(3)}
+    cols["label"] = (rng.random(n) > 0.5).astype(float).tolist()
+    ds = Dataset.from_features(
+        cols, {**{f"x{i}": Real for i in range(3)}, "label": RealNN})
+    label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+    feats = [FeatureBuilder.of(f"x{i}", Real).extract_field().as_predictor()
+             for i in range(3)]
+    checked = label.sanity_check(transmogrify(feats))
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+    pred = label.transform_with(sel, checked)
+    return ds, label, pred
+
+
+class TestStageCheckpointer:
+    def test_first_run_writes_stages(self, tmp_path):
+        ds, label, pred = _pipeline()
+        ckpt = StageCheckpointer(str(tmp_path))
+        wf = Workflow().set_input_dataset(ds).set_result_features(label, pred)
+        wf.train(checkpointer=ckpt)
+        loaded = ckpt.load_all()
+        assert len(loaded) >= 3  # vectorizer, sanity checker, selector at least
+        assert any(type(m).__name__ == "SelectedModel" for m in loaded.values())
+
+    def test_resume_skips_fitting(self, tmp_path):
+        ds, label, pred = _pipeline()
+        ckpt = StageCheckpointer(str(tmp_path))
+        wf = Workflow().set_input_dataset(ds).set_result_features(label, pred)
+        m1 = wf.train(checkpointer=ckpt)
+        s1 = np.asarray(m1.score(ds)[pred.name].score)
+
+        listener = add_listener(OpMetricsListener())
+        try:
+            m2 = wf.train(checkpointer=ckpt)
+        finally:
+            remove_listener(listener)
+        fits = [m for m in listener.metrics.stage_metrics if m.phase == "fit"]
+        assert fits == []  # everything resumed from disk
+        s2 = np.asarray(m2.score(ds)[pred.name].score)
+        np.testing.assert_allclose(s1, s2, atol=1e-9)
+
+    def test_partial_resume_fits_missing_only(self, tmp_path):
+        ds, label, pred = _pipeline()
+        ckpt = StageCheckpointer(str(tmp_path))
+        wf = Workflow().set_input_dataset(ds).set_result_features(label, pred)
+        wf.train(checkpointer=ckpt)
+        # drop the selector checkpoint -> only it refits
+        import os
+
+        sel_uid = pred.origin_stage.uid
+        for name in os.listdir(tmp_path):
+            if name.startswith(sel_uid):
+                os.remove(tmp_path / name)
+        listener = add_listener(OpMetricsListener())
+        try:
+            wf.train(checkpointer=ckpt)
+        finally:
+            remove_listener(listener)
+        fit_classes = [m.stage_class for m in listener.metrics.stage_metrics
+                       if m.phase == "fit"]
+        assert fit_classes == ["ModelSelector"]
+
+    def test_clear(self, tmp_path):
+        ds, label, pred = _pipeline()
+        ckpt = StageCheckpointer(str(tmp_path))
+        Workflow().set_input_dataset(ds).set_result_features(label, pred).train(
+            checkpointer=ckpt)
+        ckpt.clear()
+        assert ckpt.load_all() == {}
+
+
+class TestWorkflowCVResume:
+    def test_resume_skips_cv_sweep(self, tmp_path):
+        """With the selector checkpointed, re-running a with_workflow_cv train
+        must not redo the fold sweep (no SanityChecker fold fits)."""
+        ds, label, pred = _pipeline()
+        ckpt = StageCheckpointer(str(tmp_path))
+        wf = (Workflow().set_input_dataset(ds)
+              .set_result_features(label, pred).with_workflow_cv())
+        wf.train(checkpointer=ckpt)
+        listener = add_listener(OpMetricsListener())
+        try:
+            wf.train(checkpointer=ckpt)
+        finally:
+            remove_listener(listener)
+        fits = [m for m in listener.metrics.stage_metrics if m.phase == "fit"]
+        assert fits == []

@@ -1,0 +1,44 @@
+"""Multi-host bootstrap helpers (SURVEY §5.8) — single-host semantics."""
+
+import jax
+import numpy as np
+
+from transmogrifai_tpu.parallel import distributed
+from transmogrifai_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+class TestDistributed:
+    def test_initialize_single_host_noop(self):
+        distributed.initialize()  # must not raise on one process
+
+    def test_process_info(self):
+        info = distributed.process_info()
+        assert info["processCount"] == 1
+        assert info["globalDevices"] == 8
+        assert info["localDevices"] == 8
+
+    def test_global_mesh_axes(self):
+        mesh = distributed.global_mesh(n_model=2)
+        assert mesh.shape[DATA_AXIS] == 4
+        assert mesh.shape[MODEL_AXIS] == 2
+
+    def test_host_local_rows_partition(self):
+        s = distributed.host_local_rows(100)
+        assert (s.start, s.stop) == (0, 100)  # single process owns all rows
+
+    def test_host_local_rows_multiprocess_math(self):
+        # simulate the partition arithmetic for 3 processes over 10 rows
+        import transmogrifai_tpu.parallel.distributed as d
+
+        orig_idx, orig_cnt = jax.process_index, jax.process_count
+        try:
+            jax.process_count = lambda: 3
+            spans = []
+            for pid in range(3):
+                jax.process_index = lambda p=pid: p
+                s = d.host_local_rows(10)
+                spans.append((s.start, s.stop))
+        finally:
+            jax.process_index, jax.process_count = orig_idx, orig_cnt
+        assert spans == [(0, 4), (4, 8), (8, 10)]
+        assert sum(b - a for a, b in spans) == 10

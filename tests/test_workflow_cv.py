@@ -86,15 +86,37 @@ class TestWorkflowCV:
         assert len(sc_fits) == 4  # 3 folds + final full fit
 
     def test_matches_plain_cv_selection(self):
-        """Same data, both CV modes: selection lands on the same model family
-        (values differ because in-fold refits shift the metrics slightly)."""
-        ds, label, vec, checked, pred = _pipeline()
+        """Both CV modes must reject the clearly-crippling grid point: reg=100
+        zeroes the coefficients, so any working metric aggregation picks 0.001."""
+
+        def build(seed):
+            rng = np.random.default_rng(seed)
+            n = 240
+            cols = {f"x{i}": rng.normal(size=n).tolist() for i in range(5)}
+            beta = rng.normal(size=5)
+            z = sum(beta[i] * np.asarray(cols[f"x{i}"]) for i in range(5))
+            cols["label"] = (rng.random(n) < 1 / (1 + np.exp(-3 * z))
+                             ).astype(float).tolist()
+            ds = Dataset.from_features(
+                cols, {**{f"x{i}": Real for i in range(5)}, "label": RealNN})
+            label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+            feats = [FeatureBuilder.of(f"x{i}", Real).extract_field().as_predictor()
+                     for i in range(5)]
+            checked = label.sanity_check(transmogrify(feats))
+            sel = BinaryClassificationModelSelector.with_cross_validation(
+                num_folds=3,
+                models=[(LogisticRegression(),
+                         [{"reg_param": 0.001}, {"reg_param": 100.0}])])
+            return ds, label, label.transform_with(sel, checked)
+
+        ds, label, pred = build(0)
         plain = (Workflow().set_input_dataset(ds)
                  .set_result_features(label, pred).train())
-        ds2, label2, _, _, pred2 = _pipeline()
+        ds2, label2, pred2 = build(0)
         wcv = (Workflow().set_input_dataset(ds2)
                .set_result_features(label2, pred2).with_workflow_cv().train())
-        assert plain.summary().best_model_name == wcv.summary().best_model_name
+        assert plain.summary().best_grid == {"reg_param": 0.001}
+        assert wcv.summary().best_grid == {"reg_param": 0.001}
 
     def test_requires_selector(self):
         ds, label, vec, checked, pred = _pipeline()
@@ -166,3 +188,23 @@ class TestIndexedLabelWorkflowCV:
         s = model.summary()
         assert s.best_model_name == "LogisticRegression"
         assert all(np.isfinite(v) for v in s.validation_results[0].metric_values)
+
+
+class TestTransformerInDuringCut:
+    def test_plain_transformer_between_checker_and_selector(self):
+        """A Transformer downstream of a label-dependent estimator lands in the
+        'during' cut and must replay per fold without a fitted entry."""
+        from transmogrifai_tpu.ops.misc import DropIndicesByTransformer
+
+        ds, label, vec, checked, pred0 = _pipeline()
+        thinned = checked.transform_with(
+            DropIndicesByTransformer(match_fn=lambda c: False))
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2,
+            models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+        pred = label.transform_with(sel, thinned)
+        before, during, _ = cut_dag([label, pred])
+        assert "DropIndicesByTransformer" in {type(s).__name__ for s in during}
+        model = (Workflow().set_input_dataset(ds)
+                 .set_result_features(label, pred).with_workflow_cv().train())
+        assert model.summary().best_model_name == "LogisticRegression"

@@ -1,0 +1,112 @@
+"""Multi-host (pod / pod-slice) initialization and mesh construction.
+
+Reference: Spark's driver/executor RPC + Rabit tracker launch is the reference's
+multi-machine substrate (SURVEY §5.8).  TPU-native equivalent: ``jax.distributed``
+for process-group bootstrap, one process per host, with XLA collectives riding
+ICI inside a slice and DCN across slices.  The framework's stages stay unchanged
+— the same ``use_mesh`` context works on a multi-host mesh because every
+collective is inserted by XLA from sharding annotations, never hand-written.
+
+Usage (one process per host, e.g. under a pod launcher):
+
+    from transmogrifai_tpu.parallel import distributed
+    distributed.initialize()                # env-driven (TPU pods auto-detect)
+    mesh = distributed.global_mesh(n_model=2)
+    with use_mesh(mesh):
+        model = workflow.train()
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from .mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bootstrap the jax.distributed process group (idempotent).
+
+    On TPU pods all three arguments auto-detect from the environment; on
+    CPU/GPU fleets pass them explicitly or via JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID.  Single-process runs are a no-op.
+    """
+    if is_initialized():
+        return
+    kwargs = {}
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if coordinator_address:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if not kwargs and not _pod_environment():
+        # single host, nothing to bootstrap.  Decided WITHOUT touching
+        # jax.process_count(): that would initialize the local backend and
+        # jax.distributed.initialize must run before any JAX computation.
+        return
+    try:
+        jax.distributed.initialize(**kwargs)
+    except (ValueError, RuntimeError):
+        if kwargs:
+            raise  # explicit config must fail loudly
+        # pod-like env markers but no resolvable coordinator (e.g. a
+        # single-worker slice): single-host run, nothing to bootstrap
+
+
+def _pod_environment() -> bool:
+    """Multi-host launcher markers that jax.distributed auto-detects from."""
+    return any(v in os.environ for v in (
+        "TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID", "MEGASCALE_COORDINATOR_ADDRESS",
+        "TPU_WORKER_ID", "SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE"))
+
+
+def is_initialized() -> bool:
+    try:
+        state = jax.distributed.global_state
+        return state.client is not None
+    except Exception:
+        return False
+
+
+def process_info() -> dict:
+    """Topology summary for logs/metrics (OpSparkListener's appInfo role)."""
+    return {
+        "processId": jax.process_index(),
+        "processCount": jax.process_count(),
+        "localDevices": len(jax.local_devices()),
+        "globalDevices": jax.device_count(),
+        "platform": jax.default_backend(),
+    }
+
+
+def global_mesh(n_model: int = 1, devices: Optional[Sequence] = None):
+    """A (data, model) mesh over ALL processes' devices.
+
+    Device order follows ``jax.devices()`` (hosts-major), so the data axis
+    splits contiguously across hosts: row shards ride ICI within a host's
+    slice and DCN only at host boundaries — the layout the scaling playbook
+    prescribes for data parallelism.
+    """
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return make_mesh(n_data=devs.size // n_model, n_model=n_model, devices=devs)
+
+
+def host_local_rows(n_global_rows: int) -> slice:
+    """This process's contiguous row range for host-sharded ingest: each host
+    reads only its slice of the input (the readers' multi-host contract)."""
+    pid, pc = jax.process_index(), jax.process_count()
+    per = -(-n_global_rows // pc)
+    start = min(pid * per, n_global_rows)
+    return slice(start, min(start + per, n_global_rows))

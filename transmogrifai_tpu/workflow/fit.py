@@ -23,16 +23,18 @@ def fit_dag(
     dataset: Dataset,
     result_features: Sequence[Feature],
     fitted: Dict[str, Transformer] | None = None,
+    on_fit=None,
 ) -> Tuple[Dataset, Dict[str, Transformer]]:
     """Fit every estimator and apply every transformer, layer by layer.
 
     Returns (transformed dataset, {stage uid -> fitted transformer}).  Already-fitted
     stages (uid present in ``fitted``) are reused, enabling warm-start stacking
-    (OpWorkflow.withModelStages :457-461).
+    (OpWorkflow.withModelStages :457-461).  ``on_fit(model)`` fires after each
+    estimator fit (checkpoint hook).
     """
     fitted = dict(fitted or {})
     for layer in compute_dag(result_features):
-        dataset = fit_stage_list(dataset, layer, fitted)
+        dataset = fit_stage_list(dataset, layer, fitted, on_fit=on_fit)
     return dataset, fitted
 
 
@@ -65,10 +67,10 @@ def _resolve(stage: PipelineStage, fitted: Dict[str, Transformer]) -> Transforme
     return stage
 
 
-def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer]
-                   ) -> Dataset:
-    """Fit/transform an explicit stage list (topological order) in place of the
-    full-DAG walk — used by the workflow-CV before/during passes."""
+def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer],
+                   on_fit=None) -> Dataset:
+    """Fit/transform an explicit stage list (topological order) — the single
+    fit/transform loop shared by fit_dag and the workflow-CV passes."""
     for stage in stages:
         runner = _resolve(stage, fitted)
         if runner is None:
@@ -77,6 +79,8 @@ def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer]
                 finish(None)
             fitted[stage.uid] = model
             runner = model
+            if on_fit is not None:
+                on_fit(model)
         with stage_timer(runner, "transform", dataset) as finish:
             dataset = runner.transform(dataset)
             finish(dataset)
@@ -120,11 +124,14 @@ def workflow_cv_validate(ds_before: Dataset, during, selector) -> "object":
         ds_fold_train = ds_before.take(train_rows)
         fold_fitted: Dict[str, Transformer] = {}
         # fit during-stage copies on the fold's training rows only
-        fit_stage_list(ds_fold_train, [s.copy() for s in during], fold_fitted)
-        # apply fold-fitted stages to ALL rows (train + validation)
+        copies = [s.copy() for s in during]
+        fit_stage_list(ds_fold_train, copies, fold_fitted)
+        # apply fold-fitted stages to ALL rows (train + validation); plain
+        # transformers in the cut have no fitted entry — the copy itself runs
+        runners = {c.uid: fold_fitted.get(c.uid, c) for c in copies}
         ds_fold_full = ds_before
         for s in during:
-            ds_fold_full = fold_fitted[s.uid].transform(ds_fold_full)
+            ds_fold_full = runners[s.uid].transform(ds_fold_full)
         x_f = ds_fold_full[vec_f.name].data.astype(np.float32)
         for est, grids in selector.models:
             grids = grids or [{}]

@@ -112,7 +112,11 @@ class Workflow:
         raise ValueError("No input data: call set_input_dataset or set_reader first")
 
     # -- training ------------------------------------------------------------
-    def train(self, test_fraction: float = 0.0, seed: int = 42) -> "WorkflowModel":
+    def train(self, test_fraction: float = 0.0, seed: int = 42,
+              checkpointer=None) -> "WorkflowModel":
+        """Fit the DAG.  ``checkpointer`` (a StageCheckpointer) persists each
+        fitted stage as it completes and resumes from disk on re-run —
+        sweep-level resume for preemptible hardware (SURVEY §5.4)."""
         if not self.result_features:
             raise ValueError("set_result_features before train()")
         raw = self.generate_raw_data()
@@ -129,6 +133,21 @@ class Workflow:
 
         preseeded_selector = None
         warm = self._warm_models
+        on_fit = None
+        if checkpointer is not None:
+            loaded = checkpointer.load_all()
+            if loaded:
+                # bind DAG input/output features onto the resurrected models
+                by_uid = {s.uid: s for s in all_stages(self.result_features)}
+                warm = dict(warm)
+                for uid, model in loaded.items():
+                    dag_stage = by_uid.get(uid)
+                    if dag_stage is None:
+                        continue
+                    model._input_features = tuple(dag_stage.inputs)
+                    model._output_feature = dag_stage.get_output()
+                    warm[uid] = model
+            on_fit = checkpointer.save_stage
         if self._workflow_cv:
             from .dag import cut_dag
             from .fit import fit_stage_list, workflow_cv_validate
@@ -137,13 +156,16 @@ class Workflow:
             if cut is None:
                 raise ValueError("with_workflow_cv requires a ModelSelector in the DAG")
             before, during, selector = cut
-            warm = dict(self._warm_models)
-            ds_before = fit_stage_list(train_ds, before, warm)
-            selector._preselected = workflow_cv_validate(ds_before, during, selector)
-            preseeded_selector = selector
+            if selector.uid not in warm:  # checkpoint resume: sweep already done
+                warm = dict(warm)
+                ds_before = fit_stage_list(train_ds, before, warm, on_fit=on_fit)
+                selector._preselected = workflow_cv_validate(
+                    ds_before, during, selector)
+                preseeded_selector = selector
 
         try:
-            _, fitted = fit_dag(train_ds, self.result_features, fitted=warm)
+            _, fitted = fit_dag(train_ds, self.result_features, fitted=warm,
+                                on_fit=on_fit)
         finally:
             if preseeded_selector is not None and hasattr(
                     preseeded_selector, "_preselected"):
