@@ -1,0 +1,77 @@
+"""BASELINE config: wide-sparse 10K-feature table — hashed text features at the
+Transmogrifier's MaxNumOfFeatures scale, SanityChecker column statistics, and a
+GBT grid (the XGBoost-parity surface).
+
+Prints one JSON line: feature-columns × rows processed per second through the
+statistics + model-fit path.  Override with BENCH_ROWS / BENCH_WIDTH.
+
+Run:  python benchmarks/wide_sparse_10k.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.checkers.sanity import _device_stats
+    from transmogrifai_tpu.models.trees import GradientBoostedTreesClassifier
+
+    platform = jax.default_backend()
+    n = int(os.environ.get("BENCH_ROWS",
+                           100_000 if platform in ("tpu", "gpu") else 20_000))
+    d = int(os.environ.get("BENCH_WIDTH", 10_000))
+    rng = np.random.default_rng(0)
+
+    # sparse hashed block: ~1% density, like hashed text at width 10k
+    x = np.zeros((n, d), np.float32)
+    nnz_per_row = max(1, d // 100)
+    cols = rng.integers(0, d, size=(n, nnz_per_row))
+    x[np.arange(n)[:, None], cols] = 1.0
+    beta = rng.normal(size=d).astype(np.float32) / np.sqrt(nnz_per_row)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(x @ beta)))).astype(np.float32)
+
+    # 1. SanityChecker statistics over the full width (the (d+1)-wide moment pass)
+    xd, yd = jnp.asarray(x), jnp.asarray(y)
+    mask = jnp.ones(n, jnp.float32)
+    np.asarray(_device_stats(xd, yd, mask, float(n), False)[0])  # compile
+    t0 = time.perf_counter()
+    reps = 5
+    outs = [_device_stats(xd, yd, mask, float(n), False) for _ in range(reps)]
+    np.asarray(outs[-1][0])
+    stats_dt = (time.perf_counter() - t0) / reps
+
+    # 2. GBT fit on a (row/column-subsampled) slice — the tree/histogram path.
+    # Trees train on the densest columns: the (node, feature, bin) histogram is
+    # a dense object, so the tree path uses a 1k-wide projection of the table.
+    n_fit = min(n, 20_000)
+    d_fit = min(d, 1_000)
+    gbt = GradientBoostedTreesClassifier(num_rounds=10, max_depth=4)
+    t0 = time.perf_counter()
+    gbt._fit_arrays(x[:n_fit, :d_fit], y[:n_fit], np.ones(n_fit, np.float32))
+    gbt_dt = time.perf_counter() - t0
+
+    cells_per_sec = n * d / stats_dt
+    print(json.dumps({
+        "metric": "wide_stats_cells_per_sec",
+        "value": round(cells_per_sec / 1e6, 1),
+        "unit": f"M feature-cells/sec (d={d}, n={n}, {platform})",
+        "stats_seconds": round(stats_dt, 3),
+        "gbt_fit_seconds": round(gbt_dt, 2),
+        "gbt_rows": n_fit,
+        "gbt_width": d_fit,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -109,3 +109,44 @@ class TestWorkflowCVResume:
             remove_listener(listener)
         fits = [m for m in listener.metrics.stage_metrics if m.phase == "fit"]
         assert fits == []
+
+
+class TestFingerprint:
+    def test_changed_params_refit(self, tmp_path):
+        """Re-running with a different grid must NOT reuse the stale selector."""
+        ds, label, pred = _pipeline()
+        ckpt = StageCheckpointer(str(tmp_path))
+        wf = Workflow().set_input_dataset(ds).set_result_features(label, pred)
+        wf.train(checkpointer=ckpt)
+        # mutate a selector-adjacent param on the DAG's SanityChecker
+        sc = [s for s in _all_dag_stages(pred)
+              if type(s).__name__ == "SanityChecker"][0]
+        sc.min_variance = 0.123
+        listener = add_listener(OpMetricsListener())
+        try:
+            wf.train(checkpointer=ckpt)
+        finally:
+            remove_listener(listener)
+        fit_classes = {m.stage_class for m in listener.metrics.stage_metrics
+                       if m.phase == "fit"}
+        assert "SanityChecker" in fit_classes  # stale checkpoint rejected
+        # cascade: the selector consumed the refit checker's output, so its
+        # checkpoint is stale too and must also refit
+        assert "ModelSelector" in fit_classes
+
+
+def _all_dag_stages(feature):
+    out = []
+    seen = set()
+
+    def walk(f):
+        st = f.origin_stage
+        if st is None or st.uid in seen:
+            return
+        seen.add(st.uid)
+        out.append(st)
+        for p in st.inputs:
+            walk(p)
+
+    walk(feature)
+    return out

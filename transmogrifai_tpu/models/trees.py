@@ -9,9 +9,11 @@ TPU-first design (not a port of either C++ codebase):
   device with static shapes.  A reserved bin (index ``n_bins``) holds missing values and
   gets a learned default direction per split (XGBoost's sparsity-aware algorithm).
 - Trees grow LEVEL-WISE over a dense complete binary tree of static size
-  ``2^(max_depth+1)-1``: per level, one ``segment_sum`` scatter builds the
-  (node, feature, bin) gradient/hessian histograms — when rows are sharded over the
-  ``data`` mesh axis this reduction IS the Rabit allreduce, inserted by XLA as a psum.
+  ``2^(max_depth+1)-1``: per level, the (node, feature, bin) gradient/hessian
+  histograms build as scatter-free MXU matmuls (one-hot node matrix against
+  per-bin indicator masks — TPU lowers scatters to slow sorts, matmuls fly).
+  When rows are sharded over the ``data`` mesh axis this contraction IS the
+  Rabit allreduce, inserted by XLA as a psum.
 - Split gain is the XGBoost second-order formula with L2 ``reg_lambda``, complexity
   ``gamma``, and ``min_child_weight``; leaves take ``-G/(H+lambda) * eta``.
 - GBT boosts under ``lax.scan`` (carry = margins), so the entire ensemble fit is ONE
@@ -100,21 +102,27 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     value = jnp.zeros(m, dtype=jnp.float32)
 
     node = jnp.zeros(n, dtype=jnp.int32)  # current node id per row
-    feat_idx = jnp.arange(d, dtype=jnp.int32)[None, :]  # (1, d)
 
     for depth in range(max_depth + 1):
         first = 2 ** depth - 1
         n_nodes = 2 ** depth
         local = node - first  # (n,) in [0, n_nodes) for active rows
 
-        # node totals + per-(node, feat, bin) histograms in one scatter each
-        seg = local[:, None] * (d * B) + feat_idx * B + binned  # (n, d)
-        hist_g = jax.ops.segment_sum(
-            jnp.broadcast_to(grad[:, None], (n, d)).ravel(), seg.ravel(),
-            num_segments=n_nodes * d * B).reshape(n_nodes, d, B)
-        hist_h = jax.ops.segment_sum(
-            jnp.broadcast_to(hess[:, None], (n, d)).ravel(), seg.ravel(),
-            num_segments=n_nodes * d * B).reshape(n_nodes, d, B)
+        # per-(node, feat, bin) gradient/hessian histograms as MXU matmuls:
+        # scatter-free — TPU lowers segment_sum to slow sorts, but a one-hot
+        # node matrix contracted against per-bin indicator masks is pure
+        # matmul work (one (2*nodes, n) @ (n, d) product per bin).
+        node_oh = jax.nn.one_hot(local, n_nodes, dtype=jnp.float32)   # (n, nodes)
+        acc = jnp.concatenate(
+            [node_oh * grad[:, None], node_oh * hess[:, None]], axis=1)  # (n, 2*nodes)
+
+        def per_bin(b):
+            mask = (binned == b).astype(jnp.float32)                  # (n, d)
+            return jax.lax.dot(acc.T, mask,
+                               precision=jax.lax.Precision.HIGHEST)   # (2*nodes, d)
+
+        hist = jnp.moveaxis(jax.lax.map(per_bin, jnp.arange(B)), 0, -1)
+        hist_g, hist_h = hist[:n_nodes], hist[n_nodes:]               # (nodes, d, B)
 
         G = hist_g[:, 0, :].sum(-1)  # (n_nodes,) totals (feature 0 covers all rows)
         H = hist_h[:, 0, :].sum(-1)

@@ -58,11 +58,18 @@ def initialize(coordinator_address: Optional[str] = None,
         return
     try:
         jax.distributed.initialize(**kwargs)
-    except (ValueError, RuntimeError):
+    except (ValueError, RuntimeError) as e:
         if kwargs:
             raise  # explicit config must fail loudly
         # pod-like env markers but no resolvable coordinator (e.g. a
-        # single-worker slice): single-host run, nothing to bootstrap
+        # single-worker slice behind a tunnel): proceed single-host, but say
+        # so — on a REAL multi-worker pod this degrades to N duplicate runs.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "jax.distributed auto-bootstrap failed (%s); continuing as a "
+            "single-host run. If this IS a multi-host pod, pass "
+            "coordinator_address/num_processes/process_id explicitly.", e)
 
 
 def _pod_environment() -> bool:
@@ -74,7 +81,11 @@ def _pod_environment() -> bool:
 
 def is_initialized() -> bool:
     try:
-        state = jax.distributed.global_state
+        state = getattr(jax.distributed, "global_state", None)
+        if state is None:  # jax >= 0.9 keeps the state in jax._src
+            from jax._src import distributed as _dist
+
+            state = _dist.global_state
         return state.client is not None
     except Exception:
         return False

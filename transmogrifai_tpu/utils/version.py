@@ -1,0 +1,37 @@
+"""Build/version metadata stamped into saved models.
+
+Reference: VersionInfo (utils/.../version/VersionInfo.scala) — every saved model
+records what built it, so production scoring can trace a model file back to the
+code that produced it (SURVEY §5.5).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from functools import lru_cache
+
+__version__ = "0.1.0"
+
+
+@lru_cache(maxsize=1)
+def version_info() -> dict:
+    """Framework + runtime + (best-effort) git provenance."""
+    info = {"version": __version__}
+    try:
+        import jax
+
+        info["jax"] = jax.__version__
+    except Exception:
+        pass
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo_root, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5)
+        if out.returncode == 0:
+            info["gitCommit"] = out.stdout.strip()
+    except Exception:
+        pass
+    return dict(info)

@@ -16,17 +16,36 @@ Files: ``<dir>/<uid>.json`` + ``<uid>.npz`` (same registry serde as model save).
 from __future__ import annotations
 
 import json
+import logging
 import os
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from ..stages.base import Transformer
+from ..stages.base import PipelineStage, Transformer
 from .serde import _Decoder, _Encoder, decode_stage, encode_stage
+
+log = logging.getLogger(__name__)
+
+
+def stage_fingerprint(stage: PipelineStage) -> str:
+    """Class + params identity of the UNFITTED stage — resume only reuses a
+    checkpoint whose producing stage still looks like this.  (Uids are a
+    process-global construction counter; params can change between runs
+    without changing the uid.)"""
+    return json.dumps({"class": type(stage).__name__,
+                       "params": stage.get_params()},
+                      sort_keys=True, default=repr)
 
 
 class StageCheckpointer:
-    """Persists fitted stages by uid; loads them back as warm-start models."""
+    """Persists fitted stages by uid; loads them back as warm-start models.
+
+    One directory == one logical run on one dataset: checkpoints carry the
+    producing stage's class+params fingerprint (mismatches refit), but data
+    identity is the caller's contract — point different datasets at different
+    directories.
+    """
 
     def __init__(self, directory: str):
         self.directory = directory
@@ -37,9 +56,12 @@ class StageCheckpointer:
         return (os.path.join(self.directory, f"{safe}.json"),
                 os.path.join(self.directory, f"{safe}.npz"))
 
-    def save_stage(self, model: Transformer) -> None:
+    def save_stage(self, model: Transformer,
+                   fingerprint: Optional[str] = None) -> None:
         enc = _Encoder()
         state = encode_stage(model, enc, full=True)
+        if fingerprint is not None:
+            state["stageFingerprint"] = fingerprint
         jpath, npath = self._paths(model.uid)
         tmp_j, tmp_n = jpath + ".tmp", npath + ".tmp"
         if enc.arrays:
@@ -50,10 +72,11 @@ class StageCheckpointer:
             json.dump(state, fh)
         os.replace(tmp_j, jpath)  # json last: its presence marks completeness
 
-    def load_all(self) -> Dict[str, Transformer]:
-        """All checkpointed fitted stages, keyed by uid (input binding happens
-        when the workflow wires them back into its DAG)."""
-        out: Dict[str, Transformer] = {}
+    def load_entries(self) -> Dict[str, Tuple[Transformer, Optional[str]]]:
+        """(fitted stage, saved fingerprint) by uid.  Unloadable checkpoints are
+        skipped with a logged warning — a systematically failing decode (e.g. a
+        stage class not imported) must be visible, not a silent full refit."""
+        out: Dict[str, Tuple[Transformer, Optional[str]]] = {}
         for name in sorted(os.listdir(self.directory)):
             if not name.endswith(".json"):
                 continue
@@ -67,10 +90,16 @@ class StageCheckpointer:
                     with np.load(npath, allow_pickle=False) as z:
                         arrays = {k: z[k] for k in z.files}
                 stage = decode_stage(state, _Decoder(arrays))
-                out[stage.uid] = stage
-            except Exception:
-                continue  # partial/corrupt checkpoint: refit that stage
+                out[stage.uid] = (stage, state.get("stageFingerprint"))
+            except Exception as e:
+                log.warning("checkpoint %s not loadable (%s); that stage will "
+                            "refit", name, e)
+                continue
         return out
+
+    def load_all(self) -> Dict[str, Transformer]:
+        """All checkpointed fitted stages, keyed by uid."""
+        return {uid: stage for uid, (stage, _) in self.load_entries().items()}
 
     def clear(self) -> None:
         for name in os.listdir(self.directory):

@@ -267,8 +267,11 @@ def save_model(model, path: str) -> None:
         full = not isinstance(st, Estimator)
         stages.append(encode_stage(st, enc, full=full))
 
+    from ..utils.version import version_info
+
     manifest = {
         "formatVersion": FORMAT_VERSION,
+        "versionInfo": version_info(),  # build provenance (VersionInfo.scala role)
         "resultFeatureUids": [f.uid for f in model.result_features],
         "blacklist": list(model.blacklist),
         "features": [

@@ -135,19 +135,53 @@ class Workflow:
         warm = self._warm_models
         on_fit = None
         if checkpointer is not None:
-            loaded = checkpointer.load_all()
-            if loaded:
+            from .checkpoint import stage_fingerprint
+
+            by_uid = {s.uid: s for s in all_stages(self.result_features)}
+            entries = checkpointer.load_entries()
+            if entries:
                 # bind DAG input/output features onto the resurrected models
-                by_uid = {s.uid: s for s in all_stages(self.result_features)}
                 warm = dict(warm)
-                for uid, model in loaded.items():
+                for uid, (model, saved_fp) in entries.items():
                     dag_stage = by_uid.get(uid)
                     if dag_stage is None:
+                        continue
+                    if saved_fp is not None and \
+                            saved_fp != stage_fingerprint(dag_stage):
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "checkpoint for %s has different stage params; "
+                            "refitting", uid)
                         continue
                     model._input_features = tuple(dag_stage.inputs)
                     model._output_feature = dag_stage.get_output()
                     warm[uid] = model
-            on_fit = checkpointer.save_stage
+
+                # cascade invalidation: a checkpoint downstream of any stage
+                # that will refit was fitted on stale inputs — drop it too
+                loaded_uids = set(entries) & set(warm)
+                changed = True
+                while changed:
+                    changed = False
+                    for uid in list(loaded_uids):
+                        dag_stage = by_uid[uid]
+                        stale = any(
+                            p.origin_stage is not None
+                            and not isinstance(p.origin_stage,
+                                               FeatureGeneratorStage)
+                            and p.origin_stage.uid in by_uid
+                            and p.origin_stage.uid not in warm
+                            for p in dag_stage.inputs)
+                        if stale:
+                            del warm[uid]
+                            loaded_uids.discard(uid)
+                            changed = True
+
+            def on_fit(model, _by_uid=by_uid):
+                dag_stage = _by_uid.get(model.uid)
+                fp = stage_fingerprint(dag_stage) if dag_stage is not None else None
+                checkpointer.save_stage(model, fingerprint=fp)
         if self._workflow_cv:
             from .dag import cut_dag
             from .fit import fit_stage_list, workflow_cv_validate
