@@ -15,8 +15,13 @@ Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 from __future__ import annotations
 
-import json
 import os
+
+# persistent XLA compile cache: repeated runs skip the ~60s of backend compiles
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/transmogrifai_tpu/xla"))
+
+import json
 import time
 
 import numpy as np

@@ -9,8 +9,13 @@ Run:  python benchmarks/full_pipeline_1m.py
 
 from __future__ import annotations
 
-import json
 import os
+
+# persistent XLA compile cache: repeated runs skip the ~60s of backend compiles
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/transmogrifai_tpu/xla"))
+
+import json
 import sys
 import time
 

@@ -10,8 +10,13 @@ Run:  python benchmarks/wide_sparse_10k.py
 
 from __future__ import annotations
 
-import json
 import os
+
+# persistent XLA compile cache: repeated runs skip the ~60s of backend compiles
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/transmogrifai_tpu/xla"))
+
+import json
 import sys
 import time
 

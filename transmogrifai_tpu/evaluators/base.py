@@ -63,11 +63,17 @@ class BinaryClassificationEvaluator(Evaluator):
 
     def evaluate_arrays(self, y, pred, w=None):
         w = np.ones_like(y) if w is None else w
-        s = jnp.asarray(pred.score)
+        # zero-weight pad to a power-of-two bucket: the sort-based AUC kernels
+        # then compile once per bucket instead of once per dataset size
+        from ..parallel.mesh import pad_rows_to_bucket
+
+        score_p, pred_p, y_p, w_p = pad_rows_to_bucket(
+            len(y), pred.score, pred.pred, y, w)
+        s = jnp.asarray(score_p)
         # threshold metrics use the model's OWN predictions (reference evaluates the
         # prediction column) — scores may be margins (LinearSVC), not probabilities
-        p = jnp.asarray(pred.pred)
-        yj, wj = jnp.asarray(y), jnp.asarray(w)
+        p = jnp.asarray(pred_p)
+        yj, wj = jnp.asarray(y_p), jnp.asarray(w_p)
         tp, fp, tn, fn = (float(v) for v in M.binary_counts(p, yj, wj))
         precision, recall, f1, error = (
             float(v) for v in M.precision_recall_f1(p, yj, wj)
@@ -82,7 +88,10 @@ class BinaryClassificationEvaluator(Evaluator):
             "tp": tp, "fp": fp, "tn": tn, "fn": fn,
         }
         if self.num_thresholds > 0:
-            th, pr, rc, fpr = M.threshold_curves(s, yj, wj, self.num_thresholds)
+            # rank-position sampling is not padding-safe: use the true rows
+            th, pr, rc, fpr = M.threshold_curves(
+                jnp.asarray(pred.score), jnp.asarray(y), jnp.asarray(w),
+                self.num_thresholds)
             out["thresholds"] = np.asarray(th).tolist()
             out["precisionByThreshold"] = np.asarray(pr).tolist()
             out["recallByThreshold"] = np.asarray(rc).tolist()
@@ -172,8 +181,11 @@ class RegressionEvaluator(Evaluator):
 
     def evaluate_arrays(self, y, pred, w=None):
         w = np.ones_like(y) if w is None else w
-        p = jnp.asarray(pred.pred)
-        yj, wj = jnp.asarray(y), jnp.asarray(w)
+        from ..parallel.mesh import pad_rows_to_bucket
+
+        pred_p, y_p, w_p = pad_rows_to_bucket(len(y), pred.pred, y, w)
+        p = jnp.asarray(pred_p)
+        yj, wj = jnp.asarray(y_p), jnp.asarray(w_p)
         return {
             "rmse": float(M.rmse(p, yj, wj)),
             "mse": float(M.mse(p, yj, wj)),

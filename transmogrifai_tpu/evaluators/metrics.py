@@ -149,3 +149,4 @@ METRICS_REGRESSION = {
 }
 # metrics where larger is better
 LARGER_IS_BETTER = {"auPR", "auROC", "r2", "f1", "precision", "recall"}
+

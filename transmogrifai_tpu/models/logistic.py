@@ -109,9 +109,12 @@ class LogisticRegression(PredictionEstimatorBase):
         return coef.astype(np.float64), intercept
 
     def _fit_arrays(self, x, y, w):
+        from ..parallel.mesh import pad_rows_to_bucket
+
         xs, mean, std = self._prepare(x, w)
+        xs_b, y_b, w_b = pad_rows_to_bucket(xs.shape[0], xs, y, w)
         beta = np.asarray(_irls_core(
-            jnp.asarray(xs), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(xs_b), jnp.asarray(y_b), jnp.asarray(w_b),
             jnp.float32(self._effective_reg()), self.max_iter,
         ))
         coef, intercept = self._finalize_beta(beta, mean, std)
@@ -126,13 +129,20 @@ class LogisticRegression(PredictionEstimatorBase):
                                                g.get("elastic_net", self.elastic_net))
              for g in grids], dtype=jnp.float32)
         xs, _, _ = self._prepare(x, np.ones(x.shape[0], dtype=np.float32))
-        # Under an ambient mesh: rows zero-pad to the data-axis multiple (safe —
-        # fold weights pad to zero, so padded rows never enter the weighted
-        # IRLS or the validation metric) and shard over the data axis.
-        from ..parallel.mesh import DATA_AXIS, pad_rows_for_mesh, place, place_rows
+        # Rows zero-pad twice over (safe — fold weights pad to zero, so padded
+        # rows never enter the weighted IRLS or the validation metric):
+        # 1. to a power-of-two bucket, so the sweep compiles per bucket rather
+        #    than per dataset size (XLA compile is seconds per shape);
+        # 2. to the ambient mesh's data-axis multiple for sharding.
+        from ..parallel.mesh import (
+            DATA_AXIS, bucket_size, pad_axis, pad_rows_for_mesh, place, place_rows)
 
-        xs_p, y_p, n_valid = pad_rows_for_mesh(xs, np.asarray(y))
-        pad = xs_p.shape[0] - n_valid
+        n0 = xs.shape[0]
+        nb = bucket_size(n0)
+        xs_b = pad_axis(xs, 0, nb)[0]
+        y_b = pad_axis(np.asarray(y), 0, nb)[0]
+        xs_p, y_p, _ = pad_rows_for_mesh(xs_b, y_b)
+        pad = xs_p.shape[0] - n0
         train_w_p = np.pad(np.asarray(train_w), [(0, 0), (0, pad)])
         val_w_p = np.pad(np.asarray(val_w), [(0, 0), (0, pad)])
         xd, yd = place_rows(xs_p), place_rows(y_p)

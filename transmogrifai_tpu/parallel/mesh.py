@@ -165,3 +165,22 @@ def pad_rows_for_mesh(*arrays, mesh: Optional[Mesh] = None):
         padded, _ = pad_axis(np.asarray(a), 0, mult)
         out.append(padded)
     return (*out, n_valid)
+
+
+def bucket_size(n: int, minimum: int = 1024) -> int:
+    """Next power-of-two row count.  Padding inputs to a bucket lets jitted
+    kernels (sort-based AUC especially — seconds of XLA compile each) reuse the
+    compile cache across nearby dataset sizes; zero-weight/zero-row padding is
+    exact for weighted reductions and masked statistics."""
+    n = int(n)
+    if n <= minimum:
+        return minimum
+    return 1 << (n - 1).bit_length()
+
+
+def pad_rows_to_bucket(n: int, *arrays):
+    """Zero-pad each array's leading axis from n to bucket_size(n)."""
+    m = bucket_size(n)
+    if m == n:
+        return arrays
+    return tuple(pad_axis(np.asarray(a), 0, m)[0] for a in arrays)
