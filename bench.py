@@ -15,13 +15,10 @@ Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 from __future__ import annotations
 
-import os
-
-# persistent XLA compile cache: repeated runs skip the ~60s of backend compiles
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.expanduser("~/.cache/transmogrifai_tpu/xla"))
+import bench_env  # noqa: F401 — persistent XLA cache, pre-jax
 
 import json
+import os
 import time
 
 import numpy as np

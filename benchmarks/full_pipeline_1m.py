@@ -10,10 +10,10 @@ Run:  python benchmarks/full_pipeline_1m.py
 from __future__ import annotations
 
 import os
+import sys
 
-# persistent XLA compile cache: repeated runs skip the ~60s of backend compiles
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.expanduser("~/.cache/transmogrifai_tpu/xla"))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench_env  # noqa: F401,E402 — persistent XLA cache, pre-jax
 
 import json
 import sys

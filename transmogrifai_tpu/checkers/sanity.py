@@ -148,13 +148,11 @@ class SanityChecker(BinaryEstimator):
         # Under an ambient mesh the row blocks shard over the data axis and the
         # row reductions below become psums over ICI (use_mesh, SURVEY §5.8).
         # Rows zero-pad to the mesh multiple; the mask keeps statistics exact.
-        from ..parallel.mesh import (
-            pad_rows_for_mesh, pad_rows_to_bucket, place_rows)
+        from ..parallel.mesh import pad_rows_bucketed_for_mesh, place_rows
 
         mask = np.ones(n, np.float32)
         # bucket pad (compile-cache reuse across dataset sizes), then mesh pad
-        x_b, y_b, mask_b = pad_rows_to_bucket(n, x, y, mask)
-        x_p, y_p, mask_p, _ = pad_rows_for_mesh(x_b, y_b, mask_b)
+        x_p, y_p, mask_p, _ = pad_rows_bucketed_for_mesh(x, y, mask, n=n)
         x_dev, y_lab_dev = place_rows(x_p), place_rows(y_p)
         mask_dev = place_rows(mask_p)
         if self.correlation_type == "spearman":
@@ -184,11 +182,10 @@ class SanityChecker(BinaryEstimator):
         if label_is_cat and groups:
             y_onehot = (y[:, None] == label_levels[None, :]).astype(np.float32)
             # zero-padded rows contribute nothing to g.T @ y_onehot — no mask needed
-            y_dev = place_rows(pad_rows_for_mesh(
-                pad_rows_to_bucket(n, y_onehot)[0])[0])
+            y_dev = place_rows(pad_rows_bucketed_for_mesh(y_onehot, n=n)[0])
             for gkey, indices in groups.items():
-                g = place_rows(pad_rows_for_mesh(
-                    pad_rows_to_bucket(n, x[:, indices])[0])[0])
+                g = place_rows(
+                    pad_rows_bucketed_for_mesh(x[:, indices], n=n)[0])
                 cont = np.asarray(_device_contingency(g, y_dev))
                 group_v[gkey] = npstats.cramers_v(cont)
                 conf, support = npstats.max_rule_confidences(cont)

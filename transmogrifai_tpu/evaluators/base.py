@@ -74,19 +74,10 @@ class BinaryClassificationEvaluator(Evaluator):
         # prediction column) — scores may be margins (LinearSVC), not probabilities
         p = jnp.asarray(pred_p)
         yj, wj = jnp.asarray(y_p), jnp.asarray(w_p)
-        tp, fp, tn, fn = (float(v) for v in M.binary_counts(p, yj, wj))
-        precision, recall, f1, error = (
-            float(v) for v in M.precision_recall_f1(p, yj, wj)
-        )
-        out = {
-            "auROC": float(M.au_roc(s, yj, wj)),
-            "auPR": float(M.au_pr(s, yj, wj)),
-            "precision": precision,
-            "recall": recall,
-            "f1": f1,
-            "error": error,
-            "tp": tp, "fp": fp, "tn": tn, "fn": fn,
-        }
+        # one jitted program + one host fetch for all ten point metrics
+        vals = np.asarray(M.binary_summary(s, p, yj, wj))
+        out = dict(zip(("auROC", "auPR", "precision", "recall", "f1", "error",
+                        "tp", "fp", "tn", "fn"), (float(v) for v in vals)))
         if self.num_thresholds > 0:
             # rank-position sampling is not padding-safe: use the true rows
             th, pr, rc, fpr = M.threshold_curves(
@@ -186,13 +177,9 @@ class RegressionEvaluator(Evaluator):
         pred_p, y_p, w_p = pad_rows_to_bucket(len(y), pred.pred, y, w)
         p = jnp.asarray(pred_p)
         yj, wj = jnp.asarray(y_p), jnp.asarray(w_p)
-        return {
-            "rmse": float(M.rmse(p, yj, wj)),
-            "mse": float(M.mse(p, yj, wj)),
-            "mae": float(M.mae(p, yj, wj)),
-            "r2": float(M.r2(p, yj, wj)),
-            "smape": float(M.smape(p, yj, wj)),
-        }
+        vals = np.asarray(M.regression_summary(p, yj, wj))
+        return dict(zip(("rmse", "mse", "mae", "r2", "smape"),
+                        (float(v) for v in vals)))
 
 
 class ForecastEvaluator(RegressionEvaluator):

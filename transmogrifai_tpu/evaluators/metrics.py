@@ -150,3 +150,23 @@ METRICS_REGRESSION = {
 # metrics where larger is better
 LARGER_IS_BETTER = {"auPR", "auROC", "r2", "f1", "precision", "recall"}
 
+
+@jax.jit
+def binary_summary(scores, preds, y, w):
+    """All binary point metrics in ONE program -> (10,) array, ONE host fetch.
+
+    Order: auROC, auPR, precision, recall, f1, error, tp, fp, tn, fn.
+    A single fetch matters when the device sits behind a high-latency tunnel
+    (each separate float() costs a full RPC roundtrip).
+    """
+    tp, fp, tn, fn = binary_counts(preds, y, w)
+    prec, rec, f1, err = precision_recall_f1(preds, y, w)
+    return jnp.stack([au_roc(scores, y, w), au_pr(scores, y, w),
+                      prec, rec, f1, err, tp, fp, tn, fn])
+
+
+@jax.jit
+def regression_summary(pred, y, w):
+    """rmse, mse, mae, r2, smape in one program / one fetch."""
+    return jnp.stack([rmse(pred, y, w), mse(pred, y, w), mae(pred, y, w),
+                      r2(pred, y, w), smape(pred, y, w)])

@@ -135,13 +135,10 @@ class LogisticRegression(PredictionEstimatorBase):
         #    than per dataset size (XLA compile is seconds per shape);
         # 2. to the ambient mesh's data-axis multiple for sharding.
         from ..parallel.mesh import (
-            DATA_AXIS, bucket_size, pad_axis, pad_rows_for_mesh, place, place_rows)
+            DATA_AXIS, pad_rows_bucketed_for_mesh, place, place_rows)
 
         n0 = xs.shape[0]
-        nb = bucket_size(n0)
-        xs_b = pad_axis(xs, 0, nb)[0]
-        y_b = pad_axis(np.asarray(y), 0, nb)[0]
-        xs_p, y_p, _ = pad_rows_for_mesh(xs_b, y_b)
+        xs_p, y_p, _ = pad_rows_bucketed_for_mesh(xs, np.asarray(y))
         pad = xs_p.shape[0] - n0
         train_w_p = np.pad(np.asarray(train_w), [(0, 0), (0, pad)])
         val_w_p = np.pad(np.asarray(val_w), [(0, 0), (0, pad)])

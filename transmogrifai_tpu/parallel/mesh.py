@@ -184,3 +184,12 @@ def pad_rows_to_bucket(n: int, *arrays):
     if m == n:
         return arrays
     return tuple(pad_axis(np.asarray(a), 0, m)[0] for a in arrays)
+
+
+def pad_rows_bucketed_for_mesh(*arrays, n: Optional[int] = None):
+    """Bucket-pad then mesh-pad leading axes (that order — bucket sizes are
+    powers of two, so the mesh multiple keeps dividing them); returns
+    (*padded, n_valid).  The one place encoding the composition rule."""
+    n_valid = int(arrays[0].shape[0] if n is None else n)
+    bucketed = pad_rows_to_bucket(n_valid, *arrays)
+    return pad_rows_for_mesh(*bucketed)[:-1] + (n_valid,)
