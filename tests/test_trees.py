@@ -183,16 +183,19 @@ class TestShardedParity:
         w = np.ones(n, dtype=np.float32)
 
         args = dict(n_rounds=5, max_depth=3, n_bins=16, objective="binary:logistic",
-                    eta=0.3, reg_lambda=1.0, gamma=0.0, min_child_weight=1.0,
-                    base_score=0.0)
+                    num_class=1, subsample=1.0, colsample_bytree=1.0,
+                    colsample_bylevel=1.0, eta=0.3, reg_lambda=1.0, alpha=0.0,
+                    gamma=0.0, min_child_weight=1.0, scale_pos_weight=1.0,
+                    max_delta_step=0.0, base_score=jnp.zeros(1))
+        key = jax.random.PRNGKey(0)
         _, t_single = _fit_gbt(jnp.asarray(binned), jnp.asarray(y), jnp.asarray(w),
-                               **args)
+                               key, **args)
 
         mesh = make_mesh()
         shard = NamedSharding(mesh, P("data"))
         _, t_shard = _fit_gbt(
             jax.device_put(binned, NamedSharding(mesh, P("data", None))),
-            jax.device_put(y, shard), jax.device_put(w, shard), **args)
+            jax.device_put(y, shard), jax.device_put(w, shard), key, **args)
         np.testing.assert_allclose(np.asarray(t_single.value),
                                    np.asarray(t_shard.value), atol=1e-4)
         np.testing.assert_array_equal(np.asarray(t_single.feat),
@@ -233,3 +236,228 @@ class TestWorkflowIntegration:
         m2 = WorkflowModel.load(str(tmp_path / "m"))
         s2 = m2.score(ds)[pred.name].score
         np.testing.assert_allclose(s, s2, atol=1e-6)
+
+
+class TestMulticlass:
+    """VERDICT r1 #1: K-class trees with (n, K) probabilities and finite CV."""
+
+    @pytest.fixture(scope="class")
+    def tri_data(self):
+        rng = np.random.default_rng(7)
+        n = 900
+        x = rng.normal(size=(n, 4)).astype(np.float32)
+        y = np.select([x[:, 0] + x[:, 1] > 0.7, x[:, 0] - x[:, 1] > 0.2],
+                      [2.0, 1.0], 0.0).astype(np.float32)
+        return x, y, np.ones(n, dtype=np.float32)
+
+    def test_rf_three_class_probs(self, tri_data):
+        x, y, w = tri_data
+        m = RandomForestClassifier(num_trees=30, max_depth=6)._fit_arrays(x, y, w)
+        p = m.predict_column(Column.vector(x))
+        assert p.prob.shape == (len(y), 3)
+        np.testing.assert_allclose(p.prob.sum(axis=1), 1.0, atol=1e-6)
+        assert (p.pred == y).mean() > 0.8
+
+    def test_decision_tree_three_class(self, tri_data):
+        x, y, w = tri_data
+        m = DecisionTreeClassifier(max_depth=6)._fit_arrays(x, y, w)
+        p = m.predict_column(Column.vector(x))
+        assert p.prob.shape == (len(y), 3)
+        assert (p.pred == y).mean() > 0.75
+
+    def test_gbt_softmax_three_class(self, tri_data):
+        x, y, w = tri_data
+        m = GradientBoostedTreesClassifier(
+            num_rounds=30, max_depth=3, eta=0.3)._fit_arrays(x, y, w)
+        p = m.predict_column(Column.vector(x))
+        assert p.prob.shape == (len(y), 3)
+        np.testing.assert_allclose(p.prob.sum(axis=1), 1.0, atol=1e-6)
+        assert (p.pred == y).mean() > 0.85
+
+    def test_multiclass_cv_finite_all_folds(self, tri_data):
+        """RF inside multiclass CV must evaluate finite on every fold (the r1 bug:
+        every fold NaN'd and RF was silently excluded)."""
+        from transmogrifai_tpu.evaluators.base import MultiClassificationEvaluator
+        from transmogrifai_tpu.models.tuning import CrossValidator
+
+        x, y, w = tri_data
+        cv = CrossValidator(MultiClassificationEvaluator("error"), num_folds=3, seed=0)
+        tw, vw = cv.fold_weights(y, w)
+        for est in (RandomForestClassifier(num_trees=20, max_depth=4),
+                    DecisionTreeClassifier(max_depth=4),
+                    GradientBoostedTreesClassifier(num_rounds=10, max_depth=3)):
+            scores = est.cv_sweep(x, y, tw, vw, [{}], cv.evaluator.metric_fn())
+            assert np.isfinite(scores).all(), type(est).__name__
+
+    def test_multiclass_selector_competes(self, tri_data):
+        """≥3 model families must produce finite CV metrics in the multiclass
+        selector (VERDICT r1 #1 done-criterion)."""
+        from transmogrifai_tpu.models.selector import MultiClassificationModelSelector
+        from transmogrifai_tpu.models.tuning import CrossValidator
+
+        x, y, w = tri_data
+        sel = MultiClassificationModelSelector.with_cross_validation(num_folds=3)
+        result = sel.validator.validate(sel.models, x, y, w)
+        finite_families = {
+            ev.model_name for ev in result.evaluations
+            if all(np.isfinite(v) for v in ev.metric_values)
+        }
+        assert len(finite_families) >= 3, finite_families
+
+
+class TestXGBoostParams:
+    """VERDICT r1 #3: full XGBoost4J param surface (XGBoostParams.scala:1-111)."""
+
+    def _stump_data(self):
+        x = np.array([[1.0], [2.0], [10.0], [11.0]], dtype=np.float32)
+        y = np.array([0.0, 0.0, 1.0, 1.0], dtype=np.float32)
+        return x, y, np.ones(4, dtype=np.float32)
+
+    def test_alpha_soft_thresholds_leaves(self):
+        """Exact XGBoost L1 math: leaf = -sign(G)max(|G|-alpha,0)/(H+lambda)."""
+        x, y, w = self._stump_data()
+        # depth-1 regression stump, base=0.5, G_left=1.0, G_right=-1.0, H=2
+        m = GradientBoostedTreesRegressor(
+            num_rounds=1, max_depth=1, eta=1.0, reg_lambda=0.0, alpha=0.5,
+            min_child_weight=0.0, n_bins=4)._fit_arrays(x, y, w)
+        pred = m.predict_column(Column.vector(x)).pred
+        # soft-thresholded G: ±0.5 -> leaf ∓0.25 -> predictions 0.25/0.75
+        np.testing.assert_allclose(pred, [0.25, 0.25, 0.75, 0.75], atol=1e-6)
+
+    def test_alpha_large_kills_all_leaves(self):
+        x, y, w = self._stump_data()
+        m = GradientBoostedTreesRegressor(
+            num_rounds=1, max_depth=1, eta=1.0, reg_lambda=0.0, alpha=10.0,
+            min_child_weight=0.0, n_bins=4)._fit_arrays(x, y, w)
+        np.testing.assert_allclose(m.predict_column(Column.vector(x)).pred, 0.5,
+                                   atol=1e-6)
+
+    def test_max_delta_step_clips_leaves(self):
+        x, y, w = self._stump_data()
+        m = GradientBoostedTreesRegressor(
+            num_rounds=1, max_depth=1, eta=1.0, reg_lambda=0.0,
+            max_delta_step=0.1, min_child_weight=0.0, n_bins=4)._fit_arrays(x, y, w)
+        pred = m.predict_column(Column.vector(x)).pred
+        np.testing.assert_allclose(pred, [0.4, 0.4, 0.6, 0.6], atol=1e-6)
+
+    def test_scale_pos_weight_equals_explicit_weights(self):
+        """scale_pos_weight=s must reproduce fitting with w*=s on positive rows."""
+        rng = np.random.default_rng(11)
+        n = 600
+        x = rng.normal(size=(n, 3)).astype(np.float32)
+        y = (x[:, 0] + 0.3 * rng.normal(size=n) > 1.0).astype(np.float32)
+        w = np.ones(n, dtype=np.float32)
+        s = 3.0
+        a = GradientBoostedTreesClassifier(
+            num_rounds=5, max_depth=3, scale_pos_weight=s)._fit_arrays(x, y, w)
+        b = GradientBoostedTreesClassifier(
+            num_rounds=5, max_depth=3)._fit_arrays(
+                x, y, np.where(y == 1.0, s, 1.0).astype(np.float32))
+        # same splits and leaves up to base-score difference in the margin
+        np.testing.assert_array_equal(a.trees["feat"], b.trees["feat"])
+        np.testing.assert_allclose(a.trees["value"], b.trees["value"], atol=2e-3)
+
+    def test_subsample_deterministic_and_regularizes(self):
+        rng = np.random.default_rng(12)
+        n = 800
+        x = rng.normal(size=(n, 5)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float32)
+        w = np.ones(n, dtype=np.float32)
+        kw = dict(num_rounds=10, max_depth=3, subsample=0.5, seed=7)
+        p1 = GradientBoostedTreesClassifier(**kw)._fit_arrays(x, y, w) \
+            .predict_column(Column.vector(x)).score
+        p2 = GradientBoostedTreesClassifier(**kw)._fit_arrays(x, y, w) \
+            .predict_column(Column.vector(x)).score
+        np.testing.assert_array_equal(p1, p2)  # same seed -> same rows sampled
+        assert ((p1 > 0.5) == y).mean() > 0.9  # still learns the signal
+
+    def test_colsample_bytree_restricts_features(self):
+        """With d=4 and colsample_bytree=0.25 each tree sees exactly one feature."""
+        rng = np.random.default_rng(13)
+        n = 500
+        x = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float32)
+        w = np.ones(n, dtype=np.float32)
+        m = GradientBoostedTreesClassifier(
+            num_rounds=8, max_depth=2, colsample_bytree=0.25, seed=3,
+        )._fit_arrays(x, y, w)
+        feats = np.asarray(m.trees["feat"])      # (rounds, m)
+        leaves = np.asarray(m.trees["is_leaf"])
+        for r in range(feats.shape[0]):
+            used = set(feats[r][~leaves[r]].tolist())
+            assert len(used) <= 1, f"round {r} split on {used}"
+
+    def test_colsample_bylevel_restricts_per_level(self):
+        rng = np.random.default_rng(14)
+        n = 500
+        x = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+        w = np.ones(n, dtype=np.float32)
+        m = GradientBoostedTreesClassifier(
+            num_rounds=4, max_depth=3, colsample_bylevel=0.25, seed=5,
+        )._fit_arrays(x, y, w)
+        feats = np.asarray(m.trees["feat"])
+        leaves = np.asarray(m.trees["is_leaf"])
+        # per round and per level at most one distinct split feature
+        for r in range(feats.shape[0]):
+            for depth in range(3):
+                first, cnt = 2 ** depth - 1, 2 ** depth
+                lvl = slice(first, first + cnt)
+                used = set(feats[r][lvl][~leaves[r][lvl]].tolist())
+                assert len(used) <= 1, (r, depth, used)
+
+    def test_num_class_param_respected(self):
+        x = np.array([[0.0], [1.0], [2.0]], dtype=np.float32)
+        y = np.array([0.0, 1.0, 2.0], dtype=np.float32)
+        m = GradientBoostedTreesClassifier(
+            num_rounds=2, max_depth=1, num_class=5, n_bins=4,
+        )._fit_arrays(x, y, np.ones(3, dtype=np.float32))
+        assert m.predict_column(Column.vector(x)).prob.shape == (3, 5)
+
+
+class TestFoldVmappedSweep:
+    """VERDICT r1 #2: tree CV runs folds in one vmapped program per grid."""
+
+    def test_gbt_sweep_matches_sequential(self):
+        from transmogrifai_tpu.evaluators import metrics as M
+
+        rng = np.random.default_rng(21)
+        n = 400
+        x = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float32)
+        w = np.ones(n, dtype=np.float32)
+        fold = rng.permutation(n) % 3
+        tw = np.stack([(fold != f) * w for f in range(3)]).astype(np.float32)
+        vw = np.stack([(fold == f) * w for f in range(3)]).astype(np.float32)
+        est = GradientBoostedTreesClassifier(num_rounds=5, max_depth=2, n_bins=16)
+        grids = [{"max_depth": 2}, {"max_depth": 3}]
+        swept = est.cv_sweep(x, y, tw, vw, grids, M.METRICS_BINARY["auPR"])
+        assert swept.shape == (2, 3)
+        # sequential reference path: per-(grid, fold) fit + host-side metric
+        for gi, grid in enumerate(grids):
+            for f in range(3):
+                m = est.copy().set_params(**grid)._fit_arrays(x, y, tw[f])
+                s = m.predict_column(Column.vector(x)).score
+                ref = float(M.METRICS_BINARY["auPR"](
+                    jnp.asarray(s, jnp.float32), jnp.asarray(y), jnp.asarray(vw[f])))
+                np.testing.assert_allclose(swept[gi, f], ref, atol=1e-4)
+
+    def test_forest_sweep_matches_sequential(self):
+        from transmogrifai_tpu.evaluators import metrics as M
+
+        rng = np.random.default_rng(22)
+        n = 300
+        x = rng.normal(size=(n, 3)).astype(np.float32)
+        y = (2.0 * x[:, 0] + rng.normal(size=n) * 0.1).astype(np.float32)
+        w = np.ones(n, dtype=np.float32)
+        fold = rng.permutation(n) % 2
+        tw = np.stack([(fold != f) * w for f in range(2)]).astype(np.float32)
+        vw = np.stack([(fold == f) * w for f in range(2)]).astype(np.float32)
+        est = RandomForestRegressor(num_trees=10, max_depth=4, n_bins=16)
+        swept = est.cv_sweep(x, y, tw, vw, [{}], M.METRICS_REGRESSION["rmse"])
+        for f in range(2):
+            m = est._fit_arrays(x, y, tw[f])
+            pred = m.predict_column(Column.vector(x)).pred
+            ref = float(M.METRICS_REGRESSION["rmse"](
+                jnp.asarray(pred, jnp.float32), jnp.asarray(y), jnp.asarray(vw[f])))
+            np.testing.assert_allclose(swept[0, f], ref, atol=1e-4)

@@ -55,6 +55,8 @@ class ModelSelectorSummary:
     data_prep: Optional[PrepSummary] = None
     train_evaluation: Dict[str, float] = field(default_factory=dict)
     holdout_evaluation: Dict[str, float] = field(default_factory=dict)
+    #: families that never produced a finite CV metric (excluded from selection)
+    failed_models: List[str] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -63,6 +65,7 @@ class ModelSelectorSummary:
             "bestModelUID": self.best_model_uid,
             "bestGrid": self.best_grid,
             "metricName": self.metric_name,
+            "failedModels": self.failed_models,
             "dataPrep": vars(self.data_prep) if self.data_prep else None,
             "trainEvaluation": self.train_evaluation,
             "holdoutEvaluation": self.holdout_evaluation,
@@ -94,6 +97,9 @@ class ModelSelectorSummary:
             t.render(),
             f"Train metrics: {self.train_evaluation}",
         ]
+        if self.failed_models:
+            lines.append(f"FAILED model families (no finite CV metric): "
+                         f"{', '.join(self.failed_models)}")
         if self.holdout_evaluation:
             lines.append(f"Holdout metrics: {self.holdout_evaluation}")
         return "\n".join(lines)
@@ -160,6 +166,7 @@ class ModelSelector(PredictionEstimatorBase):
             larger_is_better=self.validator.evaluator.larger_is_better,
             data_prep=prep_summary,
             train_evaluation=train_eval,
+            failed_models=list(getattr(result, "failed_models", [])),
         )
         return SelectedModel(model=best_model, summary=summary,
                              feature_meta=vec.meta)
@@ -263,12 +270,16 @@ class MultiClassificationModelSelector:
 
     @staticmethod
     def default_models():
+        """LR, RF, NB, DT — the reference's multiclass candidate set
+        (MultiClassificationModelSelector.scala:49-76)."""
         grid = [{"reg_param": r} for r in (0.001, 0.01, 0.1)]
         models = [(MultinomialLogisticRegression(), grid)]
         try:
-            from .trees import RandomForestClassifier
+            from .trees import DecisionTreeClassifier, RandomForestClassifier
 
             models.append((RandomForestClassifier(), [{"num_trees": 50, "max_depth": d}
+                                                      for d in (3, 6)]))
+            models.append((DecisionTreeClassifier(), [{"max_depth": d}
                                                       for d in (3, 6)]))
         except ImportError:
             pass

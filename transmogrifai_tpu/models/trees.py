@@ -1,24 +1,40 @@
 """Histogram-based tree ensembles on TPU — the XGBoost/RandomForest capability.
 
 Reference capabilities replaced (SURVEY §2.9): OpXGBoostClassifier/Regressor (XGBoost4J
-0.81 — C++ histogram GBT with Rabit allreduce), OpRandomForestClassifier/Regressor,
-OpGBTClassifier/Regressor, OpDecisionTreeClassifier/Regressor (Spark MLlib trees).
+0.81 — C++ histogram GBT with Rabit allreduce, param surface in
+core/src/main/scala/ml/dmlc/xgboost4j/scala/spark/XGBoostParams.scala:1-111),
+OpRandomForestClassifier/Regressor, OpGBTClassifier/Regressor,
+OpDecisionTreeClassifier/Regressor (Spark MLlib trees; multiclass handled natively,
+MultiClassificationModelSelector.scala:49-76).
 
 TPU-first design (not a port of either C++ codebase):
 - Features are quantile-binned ON HOST once into small ints; everything after lives on
   device with static shapes.  A reserved bin (index ``n_bins``) holds missing values and
   gets a learned default direction per split (XGBoost's sparsity-aware algorithm).
+- Trees are MULTI-OUTPUT: the grower takes per-class gradient/hessian columns
+  (n, K) and leaves carry a (K,) value vector, so ONE tree structure serves binary
+  (K=1), regression (K=1), and multiclass (K = num_class) problems.  This is the
+  `multi_strategy="multi_output_tree"` design of modern XGBoost rather than
+  K-trees-per-round: one growth pass per round regardless of K, which keeps the
+  round loop a single ``lax.scan`` and the histogram contraction one big matmul.
 - Trees grow LEVEL-WISE over a dense complete binary tree of static size
-  ``2^(max_depth+1)-1``: per level, the (node, feature, bin) gradient/hessian
+  ``2^(max_depth+1)-1``: per level, the (node, class, feature, bin) gradient/hessian
   histograms build as scatter-free MXU matmuls (one-hot node matrix against
   per-bin indicator masks — TPU lowers scatters to slow sorts, matmuls fly).
   When rows are sharded over the ``data`` mesh axis this contraction IS the
   Rabit allreduce, inserted by XLA as a psum.
-- Split gain is the XGBoost second-order formula with L2 ``reg_lambda``, complexity
-  ``gamma``, and ``min_child_weight``; leaves take ``-G/(H+lambda) * eta``.
+- Split gain is the XGBoost second-order formula with L2 ``reg_lambda``, L1 ``alpha``
+  (soft-threshold on G), complexity ``gamma``, and ``min_child_weight``; leaves take
+  ``-T_alpha(G)/(H+lambda) * eta`` clipped to ``max_delta_step``.  Multi-output gain
+  sums the per-class terms (min_child_weight applies to the mean hessian across
+  classes so K=1 reduces exactly to the scalar formula).
 - GBT boosts under ``lax.scan`` (carry = margins), so the entire ensemble fit is ONE
-  XLA program.  RandomForest vmaps the same grower over per-tree Poisson bootstrap
-  weights and per-tree feature masks.
+  XLA program; per-round ``subsample`` / ``colsample_bytree`` masks derive from a
+  folded-in PRNG key inside the scan.  RandomForest vmaps the same grower over
+  per-tree Poisson bootstrap weights and per-tree feature masks.
+- CV sweeps vmap the whole fit over the fold-weight axis and evaluate the metric on
+  device, so a (grids x folds) selector sweep is one XLA program per grid config
+  (the reference's per-fold Futures thread pool, OpCrossValidation.scala:114-134).
 """
 
 from __future__ import annotations
@@ -68,7 +84,7 @@ def quantile_bin(x: np.ndarray, n_bins: int = DEFAULT_BINS
 
 
 # ---------------------------------------------------------------------------
-# Device tree grower
+# Device tree grower (multi-output)
 # ---------------------------------------------------------------------------
 
 class Tree(NamedTuple):
@@ -78,20 +94,34 @@ class Tree(NamedTuple):
     thr_bin: jnp.ndarray       # (m,) int32 split bin: go left if bin <= thr_bin
     miss_left: jnp.ndarray     # (m,) bool missing-value default direction
     is_leaf: jnp.ndarray       # (m,) bool
-    value: jnp.ndarray         # (m,) float32 leaf value (eta-scaled)
+    value: jnp.ndarray         # (m, K) float32 leaf value vector (eta-scaled)
+
+
+def _soft_threshold(g, alpha):
+    """XGBoost L1 shrinkage on the gradient sum."""
+    return jnp.sign(g) * jnp.maximum(jnp.abs(g) - alpha, 0.0)
+
+
+def _leaf_value(G, H, reg_lambda, alpha, eta, max_delta_step):
+    raw = -_soft_threshold(G, alpha) / (H + reg_lambda + 1e-12)
+    clipped = jnp.where(max_delta_step > 0.0,
+                        jnp.clip(raw, -max_delta_step, max_delta_step), raw)
+    return clipped * eta
 
 
 def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
-               feat_mask: jnp.ndarray, max_depth: int, n_bins: int,
-               reg_lambda: float, gamma: float, min_child_weight: float,
-               eta: float) -> Tree:
-    """Level-wise histogram tree growth; fully static shapes, jit-safe.
+               feat_mask: jnp.ndarray, key, max_depth: int, n_bins: int,
+               reg_lambda, alpha, gamma, min_child_weight, eta, max_delta_step,
+               colsample_bylevel: float = 1.0) -> Tree:
+    """Level-wise histogram growth of ONE multi-output tree; static shapes, jit-safe.
 
     binned: (n, d) int32 in [0, n_bins] (n_bins = missing).
-    grad/hess: (n,) — zero-weight rows simply contribute nothing.
-    feat_mask: (d,) float 1/0 — colsample support.
+    grad/hess: (n, K) per-class — zero-weight rows contribute nothing.
+    feat_mask: (d,) float 1/0 — colsample_bytree support.
+    key: PRNG key for colsample_bylevel (ignored when colsample_bylevel >= 1).
     """
     n, d = binned.shape
+    K = grad.shape[1]
     m = 2 ** (max_depth + 1) - 1
     B = n_bins + 1  # + missing slot
 
@@ -99,7 +129,7 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     thr_bin = jnp.full(m, n_bins, dtype=jnp.int32)
     miss_left = jnp.zeros(m, dtype=bool)
     is_leaf = jnp.zeros(m, dtype=bool)
-    value = jnp.zeros(m, dtype=jnp.float32)
+    value = jnp.zeros((m, K), dtype=jnp.float32)
 
     node = jnp.zeros(n, dtype=jnp.int32)  # current node id per row
 
@@ -108,25 +138,26 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         n_nodes = 2 ** depth
         local = node - first  # (n,) in [0, n_nodes) for active rows
 
-        # per-(node, feat, bin) gradient/hessian histograms as MXU matmuls:
+        # per-(node, class, feat, bin) grad/hess histograms as MXU matmuls:
         # scatter-free — TPU lowers segment_sum to slow sorts, but a one-hot
         # node matrix contracted against per-bin indicator masks is pure
-        # matmul work (one (2*nodes, n) @ (n, d) product per bin).
-        node_oh = jax.nn.one_hot(local, n_nodes, dtype=jnp.float32)   # (n, nodes)
-        acc = jnp.concatenate(
-            [node_oh * grad[:, None], node_oh * hess[:, None]], axis=1)  # (n, 2*nodes)
+        # matmul work (one (nodes*2K, n) @ (n, d) product per bin).
+        node_oh = jax.nn.one_hot(local, n_nodes, dtype=jnp.float32)      # (n, nodes)
+        gh = jnp.concatenate([grad, hess], axis=1)                       # (n, 2K)
+        acc = (node_oh[:, :, None] * gh[:, None, :]).reshape(n, n_nodes * 2 * K)
 
         def per_bin(b):
-            mask = (binned == b).astype(jnp.float32)                  # (n, d)
+            mask = (binned == b).astype(jnp.float32)                     # (n, d)
             return jax.lax.dot(acc.T, mask,
-                               precision=jax.lax.Precision.HIGHEST)   # (2*nodes, d)
+                               precision=jax.lax.Precision.HIGHEST)      # (nodes*2K, d)
 
         hist = jnp.moveaxis(jax.lax.map(per_bin, jnp.arange(B)), 0, -1)
-        hist_g, hist_h = hist[:n_nodes], hist[n_nodes:]               # (nodes, d, B)
+        hist = hist.reshape(n_nodes, 2 * K, d, B)
+        hist_g, hist_h = hist[:, :K], hist[:, K:]                        # (nodes,K,d,B)
 
-        G = hist_g[:, 0, :].sum(-1)  # (n_nodes,) totals (feature 0 covers all rows)
-        H = hist_h[:, 0, :].sum(-1)
-        node_val = -G / (H + reg_lambda + 1e-12) * eta
+        G = hist_g[:, :, 0, :].sum(-1)  # (nodes, K) totals (feature 0 covers all rows)
+        H = hist_h[:, :, 0, :].sum(-1)
+        node_val = _leaf_value(G, H, reg_lambda, alpha, eta, max_delta_step)
 
         if depth == max_depth:
             value = value.at[first:first + n_nodes].set(node_val)
@@ -134,26 +165,36 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             break
 
         # split search: left = bins [0..b]; missing tried on both sides
-        gl = jnp.cumsum(hist_g[:, :, :n_bins], axis=-1)[:, :, :-1]  # (nodes,d,n_bins-1)
-        hl = jnp.cumsum(hist_h[:, :, :n_bins], axis=-1)[:, :, :-1]
-        g_miss = hist_g[:, :, n_bins][:, :, None]
-        h_miss = hist_h[:, :, n_bins][:, :, None]
-        Gt = G[:, None, None]
-        Ht = H[:, None, None]
+        gl = jnp.cumsum(hist_g[:, :, :, :n_bins], axis=-1)[..., :-1]  # (nodes,K,d,b-1)
+        hl = jnp.cumsum(hist_h[:, :, :, :n_bins], axis=-1)[..., :-1]
+        g_miss = hist_g[:, :, :, n_bins][..., None]
+        h_miss = hist_h[:, :, :, n_bins][..., None]
+        Gt = G[:, :, None, None]
+        Ht = H[:, :, None, None]
 
         def gain_of(gl_, hl_):
             gr_, hr_ = Gt - gl_, Ht - hl_
-            ok = (hl_ >= min_child_weight) & (hr_ >= min_child_weight)
+            # child-weight constraint on the mean hessian across classes so the
+            # K=1 case reduces exactly to the scalar XGBoost rule
+            ok = (hl_.mean(1) >= min_child_weight) & (hr_.mean(1) >= min_child_weight)
             eps = 1e-12  # empty-child guard: 0^2/0 counts as zero gain
-            raw = (gl_ ** 2 / (hl_ + reg_lambda + eps)
-                   + gr_ ** 2 / (hr_ + reg_lambda + eps)
-                   - Gt ** 2 / (Ht + reg_lambda + eps))
+            raw = (_soft_threshold(gl_, alpha) ** 2 / (hl_ + reg_lambda + eps)
+                   + _soft_threshold(gr_, alpha) ** 2 / (hr_ + reg_lambda + eps)
+                   - _soft_threshold(Gt, alpha) ** 2 / (Ht + reg_lambda + eps))
+            raw = raw.sum(axis=1)  # sum per-class contributions -> (nodes, d, bins)
             return jnp.where(ok, 0.5 * raw - gamma, -jnp.inf)
 
         gain_mr = gain_of(gl, hl)                    # missing goes right
         gain_ml = gain_of(gl + g_miss, hl + h_miss)  # missing goes left
         gain = jnp.maximum(gain_mr, gain_ml)
-        gain = jnp.where(feat_mask[None, :, None] > 0, gain, -jnp.inf)
+
+        level_mask = feat_mask
+        if colsample_bylevel < 1.0:
+            # salt 3 keeps level draws independent of the subsample (salt 1)
+            # and colsample_bytree (salt 2) draws made from the same round key
+            level_key = jax.random.fold_in(jax.random.fold_in(key, 3), depth)
+            level_mask = feat_mask * _colsample_mask(level_key, d, colsample_bylevel)
+        gain = jnp.where(level_mask[None, :, None] > 0, gain, -jnp.inf)
 
         flat = gain.reshape(n_nodes, -1)
         best = flat.argmax(axis=1)
@@ -165,7 +206,7 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             jnp.take_along_axis(gain_mr.reshape(n_nodes, -1), best[:, None], 1)[:, 0]
 
         # nodes with no positive gain (or no rows) become leaves now
-        leaf_now = (best_gain <= 0.0) | (H <= 0.0)
+        leaf_now = (best_gain <= 0.0) | (H.mean(1) <= 0.0)
         sl = slice(first, first + n_nodes)
         feat = feat.at[sl].set(jnp.where(leaf_now, 0, bf))
         thr_bin = thr_bin.at[sl].set(jnp.where(leaf_now, n_bins, bb))
@@ -185,7 +226,7 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
 def _predict_tree(tree: Tree, binned: jnp.ndarray, max_depth: int, n_bins: int
                   ) -> jnp.ndarray:
-    """Leaf value per row: fixed-depth traversal (vectorized gathers)."""
+    """Leaf value vector per row (n, K): fixed-depth traversal (vectorized gathers)."""
     n = binned.shape[0]
     node = jnp.zeros(n, dtype=jnp.int32)
 
@@ -201,56 +242,187 @@ def _predict_tree(tree: Tree, binned: jnp.ndarray, max_depth: int, n_bins: int
 
 
 # ---------------------------------------------------------------------------
-# Ensemble fitters (one XLA program each)
+# Ensemble fitters
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("n_rounds", "max_depth", "n_bins", "objective"))
-def _fit_gbt(binned, y, w, n_rounds, max_depth, n_bins, objective,
-             eta, reg_lambda, gamma, min_child_weight, base_score):
-    """Boosting under lax.scan; carry = margins.  Returns stacked Tree arrays."""
-    n, d = binned.shape
-    feat_mask = jnp.ones(d, dtype=jnp.float32)
+def _colsample_mask(key, d: int, frac: float) -> jnp.ndarray:
+    """Exact-k column subsampling mask via rank of uniforms (no dynamic shapes)."""
+    k_keep = max(1, int(round(frac * d)))
+    u = jax.random.uniform(key, (d,))
+    rank = jnp.argsort(jnp.argsort(u))
+    return (rank < k_keep).astype(jnp.float32)
 
-    def round_fn(margin, _):
+
+def _base_score_device(y, w, objective: str, num_class: int, scale_pos_weight):
+    """(K,) prior margin from the TRAINING weights, on device — the same formula
+    the host ``_resolved`` uses, so fold-swept models match ``_fit_arrays`` exactly
+    (fold weights zero out validation rows: no label leakage into the prior)."""
+    if objective == "binary:logistic":
+        we = w * jnp.where(y == 1.0, scale_pos_weight, 1.0)
+        p = jnp.clip((we * (y == 1.0)).sum() / jnp.maximum(we.sum(), 1e-12),
+                     1e-6, 1 - 1e-6)
+        return jnp.log(p / (1 - p))[None]
+    if objective == "multi:softmax":
+        counts = (w[:, None] * jax.nn.one_hot(y.astype(jnp.int32), num_class)).sum(0)
+        p = jnp.clip(counts / jnp.maximum(counts.sum(), 1e-12), 1e-6, 1.0)
+        return jnp.log(p)
+    return ((w * y).sum() / jnp.maximum(w.sum(), 1e-12))[None]
+
+
+def _fit_gbt_impl(binned, y, w, key, n_rounds: int, max_depth: int, n_bins: int,
+                  objective: str, num_class: int, subsample: float,
+                  colsample_bytree: float, colsample_bylevel: float,
+                  eta, reg_lambda, alpha, gamma, min_child_weight,
+                  scale_pos_weight, max_delta_step, base_score):
+    """Boosting under lax.scan; carry = (n, K) margins.  Returns stacked Tree arrays.
+
+    base_score: (K,) margin offset.  ``subsample`` draws per-round Bernoulli row
+    masks; ``colsample_bytree`` per-round exact-k feature masks (XGBoost semantics).
+    """
+    n, d = binned.shape
+    K = num_class
+
+    if objective == "multi:softmax":
+        y_onehot = jax.nn.one_hot(y.astype(jnp.int32), K, dtype=jnp.float32)
+
+    def round_fn(margin, r):
+        rkey = jax.random.fold_in(key, r)
+        wt = w
+        if subsample < 1.0:
+            wt = wt * jax.random.bernoulli(
+                jax.random.fold_in(rkey, 1), subsample, (n,)).astype(jnp.float32)
+        feat_mask = jnp.ones(d, dtype=jnp.float32)
+        if colsample_bytree < 1.0:
+            feat_mask = _colsample_mask(jax.random.fold_in(rkey, 2), d,
+                                        colsample_bytree)
+
         if objective == "binary:logistic":
-            p = jax.nn.sigmoid(margin)
-            grad, hess = w * (p - y), w * jnp.maximum(p * (1 - p), 1e-16)
+            wp = wt * jnp.where(y == 1.0, scale_pos_weight, 1.0)
+            p = jax.nn.sigmoid(margin[:, 0])
+            grad = (wp * (p - y))[:, None]
+            hess = (wp * jnp.maximum(p * (1 - p), 1e-16))[:, None]
+        elif objective == "multi:softmax":
+            p = jax.nn.softmax(margin, axis=-1)
+            grad = wt[:, None] * (p - y_onehot)
+            hess = wt[:, None] * jnp.maximum(p * (1 - p), 1e-16)
         else:  # reg:squarederror
-            grad, hess = w * (margin - y), w
-        tree = _grow_tree(binned, grad, hess, feat_mask, max_depth, n_bins,
-                          reg_lambda, gamma, min_child_weight, eta)
+            grad = (wt * (margin[:, 0] - y))[:, None]
+            hess = wt[:, None] * jnp.ones((1, 1), jnp.float32)
+        tree = _grow_tree(binned, grad, hess, feat_mask, rkey, max_depth, n_bins,
+                          reg_lambda, alpha, gamma, min_child_weight, eta,
+                          max_delta_step, colsample_bylevel)
         new_margin = margin + _predict_tree(tree, binned, max_depth, n_bins)
         return new_margin, tree
 
-    margin0 = jnp.full(n, base_score, dtype=jnp.float32)
-    final_margin, trees = jax.lax.scan(round_fn, margin0, None, length=n_rounds)
+    margin0 = jnp.broadcast_to(base_score.astype(jnp.float32), (n, K))
+    final_margin, trees = jax.lax.scan(round_fn, margin0, jnp.arange(n_rounds))
     return final_margin, trees
 
 
-@partial(jax.jit, static_argnames=("n_trees", "max_depth", "n_bins"))
-def _fit_forest(binned, y, w, n_trees, max_depth, n_bins,
-                reg_lambda, min_child_weight, feat_masks, boot_w):
+_GBT_STATICS = ("n_rounds", "max_depth", "n_bins", "objective", "num_class",
+                "subsample", "colsample_bytree", "colsample_bylevel")
+
+
+@partial(jax.jit, static_argnames=_GBT_STATICS)
+def _fit_gbt(binned, y, w, key, n_rounds, max_depth, n_bins, objective, num_class,
+             subsample, colsample_bytree, colsample_bylevel,
+             eta, reg_lambda, alpha, gamma, min_child_weight,
+             scale_pos_weight, max_delta_step, base_score):
+    return _fit_gbt_impl(binned, y, w, key, n_rounds, max_depth, n_bins, objective,
+                         num_class, subsample, colsample_bytree, colsample_bylevel,
+                         eta, reg_lambda, alpha, gamma, min_child_weight,
+                         scale_pos_weight, max_delta_step, base_score)
+
+
+def _fit_forest_impl(binned, y_cols, w, max_depth: int, n_bins: int,
+                     reg_lambda, min_child_weight, feat_masks, boot_w):
     """Random forest: vmap the grower over (bootstrap weights, feature masks).
 
-    Regression trees on the (possibly 0/1) label — variance-reduction splits, which for
-    binary labels equal Gini-gain splits up to a constant factor, so classification
-    probabilities match impurity-based forests.
+    y_cols: (n, K) regression targets — one-hot class indicators for classification,
+    so leaf values are per-class probability vectors; variance-reduction splits on
+    one-hot targets equal Gini-gain splits up to a constant factor.
     """
+    key = jax.random.PRNGKey(0)  # unused (no bylevel sampling in forests)
+
     def one_tree(fm, bw):
         wt = w * bw
-        grad, hess = wt * (0.0 - y), wt  # squared loss around 0 => leaf = weighted mean
-        return _grow_tree(binned, grad, hess, fm, max_depth, n_bins,
-                          reg_lambda, 0.0, min_child_weight, 1.0)
+        grad = -wt[:, None] * y_cols   # squared loss around 0 => leaf = weighted mean
+        hess = wt[:, None] * jnp.ones((1, y_cols.shape[1]), jnp.float32)
+        return _grow_tree(binned, grad, hess, fm, key, max_depth, n_bins,
+                          reg_lambda, 0.0, 0.0, min_child_weight, 1.0, 0.0)
 
-    trees = jax.vmap(one_tree)(feat_masks, boot_w)
-    return trees
+    return jax.vmap(one_tree)(feat_masks, boot_w)
+
+
+@partial(jax.jit, static_argnames=("max_depth", "n_bins"))
+def _fit_forest(binned, y_cols, w, max_depth, n_bins,
+                reg_lambda, min_child_weight, feat_masks, boot_w):
+    return _fit_forest_impl(binned, y_cols, w, max_depth, n_bins,
+                            reg_lambda, min_child_weight, feat_masks, boot_w)
 
 
 @partial(jax.jit, static_argnames=("max_depth", "n_bins"))
 def _predict_trees_sum(trees: Tree, binned, max_depth, n_bins):
-    """Sum of leaf values over a stacked batch of trees."""
+    """(n, K) sum of leaf value vectors over a stacked batch of trees."""
     vals = jax.vmap(lambda t: _predict_tree(t, binned, max_depth, n_bins))(trees)
     return vals.sum(axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Fold-vmapped CV sweep programs (one XLA program per grid config)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=_GBT_STATICS + ("metric_fn",))
+def _gbt_cv_program(binned, y, train_w, val_w, key, n_rounds, max_depth, n_bins,
+                    objective, num_class, subsample, colsample_bytree,
+                    colsample_bylevel, eta, reg_lambda, alpha, gamma,
+                    min_child_weight, scale_pos_weight, max_delta_step,
+                    metric_fn):
+    """All folds of one GBT grid point in one program: the boosted margins over the
+    full row block already contain the validation predictions (fold membership only
+    zeroes training weights), so fit + eval fuse with no second predict pass.
+    The prior margin is recomputed per fold from the fold's training weights —
+    exactly what ``_fit_arrays`` would produce on that fold."""
+
+    def one_fold(w_, vw_):
+        base_score = _base_score_device(y, w_, objective, num_class,
+                                        scale_pos_weight)
+        margin, _ = _fit_gbt_impl(
+            binned, y, w_, key, n_rounds, max_depth, n_bins, objective, num_class,
+            subsample, colsample_bytree, colsample_bylevel, eta, reg_lambda, alpha,
+            gamma, min_child_weight, scale_pos_weight, max_delta_step, base_score)
+        if objective == "binary:logistic":
+            payload = jax.nn.sigmoid(margin[:, 0])
+        elif objective == "multi:softmax":
+            payload = jax.nn.softmax(margin, axis=-1)
+        else:
+            payload = margin[:, 0]
+        return metric_fn(payload, y, vw_)
+
+    return jax.vmap(one_fold)(train_w, val_w)
+
+
+@partial(jax.jit, static_argnames=("max_depth", "n_bins", "classification",
+                                  "metric_fn"))
+def _forest_cv_program(binned, y, y_cols, train_w, val_w, feat_masks, boot_w,
+                       max_depth, n_bins, reg_lambda, min_child_weight,
+                       classification, metric_fn):
+    """All folds of one forest grid point (fit + predict + metric) in one program."""
+    n_trees = feat_masks.shape[0]
+
+    def one_fold(w_, vw_):
+        trees = _fit_forest_impl(binned, y_cols, w_, max_depth, n_bins,
+                                 reg_lambda, min_child_weight, feat_masks, boot_w)
+        mean = _predict_trees_sum(trees, binned, max_depth, n_bins) / n_trees
+        if classification:
+            payload = mean[:, 0] if mean.shape[1] == 1 else \
+                jnp.clip(mean, 0.0, 1.0) / jnp.maximum(
+                    jnp.clip(mean, 0.0, 1.0).sum(-1, keepdims=True), 1e-12)
+        else:
+            payload = mean[:, 0]
+        return metric_fn(payload, y, vw_)
+
+    return jax.vmap(one_fold)(train_w, val_w)
 
 
 # ---------------------------------------------------------------------------
@@ -259,7 +431,7 @@ def _predict_trees_sum(trees: Tree, binned, max_depth, n_bins):
 
 class _TreeEnsembleModelBase(PredictionModelBase):
     def __init__(self, trees: Tree, edges: np.ndarray, max_depth: int, n_bins: int,
-                 base_score: float = 0.0, **kw):
+                 base_score=0.0, **kw):
         super().__init__(**kw)
         # numpy dict storage so the model round-trips through the array-store serde
         self.trees = {k: np.asarray(v) for k, v in
@@ -267,7 +439,7 @@ class _TreeEnsembleModelBase(PredictionModelBase):
         self.edges = np.asarray(edges, dtype=np.float32)
         self.max_depth = int(max_depth)
         self.n_bins = int(n_bins)
-        self.base_score = float(base_score)
+        self.base_score = np.asarray(base_score, dtype=np.float64).reshape(-1)
 
     def _tree_batch(self) -> Tree:
         return Tree(**{k: jnp.asarray(v) for k, v in self.trees.items()})
@@ -282,13 +454,21 @@ class _TreeEnsembleModelBase(PredictionModelBase):
         return jnp.where(jnp.isfinite(xd), binned, self.n_bins).astype(jnp.int32)
 
     def _margin(self, x: np.ndarray) -> np.ndarray:
+        """(n, K) summed leaf values + base score."""
         binned = self._bin(x)
         s = _predict_trees_sum(self._tree_batch(), binned, self.max_depth, self.n_bins)
-        return np.asarray(s, dtype=np.float64) + self.base_score
+        # re-normalize here too: serde restores attrs via setattr, bypassing
+        # the __init__ reshape (a loaded model may hold a plain float)
+        base = np.asarray(self.base_score, dtype=np.float64).reshape(-1)
+        return np.asarray(s, dtype=np.float64) + base[None, :]
 
     @property
     def n_trees(self) -> int:
         return int(self.trees["feat"].shape[0])
+
+    @property
+    def n_outputs(self) -> int:
+        return int(self.trees["value"].shape[-1])
 
     def feature_importances(self, d: int) -> np.ndarray:
         """Split-count importances per feature (XGBoost 'weight' type)."""
@@ -301,28 +481,37 @@ class _TreeEnsembleModelBase(PredictionModelBase):
 
 class GBTClassifierModel(_TreeEnsembleModelBase):
     def predict_column(self, vec: Column) -> PredictionColumn:
-        z = self._margin(vec.data)
-        p1 = 1.0 / (1.0 + np.exp(-z))
-        return PredictionColumn.classification(
-            np.column_stack([-z, z]), np.column_stack([1 - p1, p1]))
+        m = self._margin(vec.data)
+        if m.shape[1] == 1:  # binary: single logistic margin
+            z = m[:, 0]
+            p1 = 1.0 / (1.0 + np.exp(-z))
+            return PredictionColumn.classification(
+                np.column_stack([-z, z]), np.column_stack([1 - p1, p1]))
+        from .base import softmax_probs
+
+        return PredictionColumn.classification(m, softmax_probs(m))
 
 
 class GBTRegressorModel(_TreeEnsembleModelBase):
     def predict_column(self, vec: Column) -> PredictionColumn:
-        return PredictionColumn.regression(self._margin(vec.data))
+        return PredictionColumn.regression(self._margin(vec.data)[:, 0])
 
 
 class ForestClassifierModel(_TreeEnsembleModelBase):
     def predict_column(self, vec: Column) -> PredictionColumn:
-        p1 = np.clip(self._margin(vec.data) / self.n_trees, 0.0, 1.0)
-        return PredictionColumn.classification(
-            np.column_stack([self.n_trees - self.n_trees * p1, self.n_trees * p1]),
-            np.column_stack([1 - p1, p1]))
+        mean = self._margin(vec.data) / self.n_trees
+        if mean.shape[1] == 1:  # binary: leaf mean of y IS P(class 1)
+            p1 = np.clip(mean[:, 0], 0.0, 1.0)
+            prob = np.column_stack([1 - p1, p1])
+        else:  # multiclass: leaf mean of one-hot labels IS the class distribution
+            prob = np.clip(mean, 0.0, 1.0)
+            prob = prob / np.maximum(prob.sum(axis=1, keepdims=True), 1e-12)
+        return PredictionColumn.classification(prob * self.n_trees, prob)
 
 
 class ForestRegressorModel(_TreeEnsembleModelBase):
     def predict_column(self, vec: Column) -> PredictionColumn:
-        return PredictionColumn.regression(self._margin(vec.data) / self.n_trees)
+        return PredictionColumn.regression(self._margin(vec.data)[:, 0] / self.n_trees)
 
 
 class _TreeEstimatorBase(PredictionEstimatorBase):
@@ -337,43 +526,122 @@ class _TreeEstimatorBase(PredictionEstimatorBase):
         binned, edges = quantile_bin(xf, self.n_bins)
         return jnp.asarray(binned), edges
 
+    def cv_sweep(self, x, y, train_w, val_w, grids: List[Dict[str, Any]], metric_fn):
+        """Fold-vmapped sweep: bins once, dispatches one async program per grid
+        point, fetches all metrics in a single gather at the end (VERDICT r1 #2)."""
+        binned, _ = self._binned(x)
+        tw = jnp.asarray(train_w)
+        vw = jnp.asarray(val_w)
+        pending = []
+        for grid in grids:
+            est = self.copy().set_params(**grid)
+            # a grid point that changes the binning resolution needs its own codes
+            b = binned if int(est.n_bins) == int(self.n_bins) else est._binned(x)[0]
+            pending.append(est._sweep_folds(b, x, y, tw, vw, metric_fn))
+        return np.stack(jax.device_get(pending))
+
+    def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn):
+        raise NotImplementedError
+
 
 class _GBTBase(_TreeEstimatorBase):
-    """Shared GBT/XGBoost fitting (objective set by subclass)."""
+    """Shared GBT/XGBoost fitting (objective set by subclass).
+
+    Full XGBoost4J param surface (XGBoostParams.scala:1-111): eta, gamma,
+    reg_lambda, alpha, min_child_weight, subsample, colsample_bytree,
+    colsample_bylevel, scale_pos_weight, max_delta_step, num_class.
+    """
 
     num_rounds = Param(default=100)
-    eta = Param(default=0.3)          # XGBoost learning_rate
-    gamma = Param(default=0.0)        # min split loss
+    eta = Param(default=0.3)            # XGBoost learning_rate
+    gamma = Param(default=0.0)          # min split loss
+    alpha = Param(default=0.0)          # L1 on leaf weights
+    subsample = Param(default=1.0)      # per-round row subsampling
+    colsample_bytree = Param(default=1.0)
+    colsample_bylevel = Param(default=1.0)
+    scale_pos_weight = Param(default=1.0)
+    max_delta_step = Param(default=0.0)
     objective: str = "binary:logistic"
 
-    def _base_score(self, y, w) -> float:
-        return 0.0
+    def _resolved(self, y, w):
+        """(objective, num_class, base_score (K,)) for this label column."""
+        return self.objective, 1, np.zeros(1)
+
+    def _fit_config(self):
+        return dict(
+            n_rounds=int(self.num_rounds), max_depth=int(self.max_depth),
+            n_bins=int(self.n_bins), subsample=float(self.subsample),
+            colsample_bytree=float(self.colsample_bytree),
+            colsample_bylevel=float(self.colsample_bylevel),
+        )
+
+    def _fit_dynamics(self):
+        return dict(
+            eta=jnp.float32(self.eta), reg_lambda=jnp.float32(self.reg_lambda),
+            alpha=jnp.float32(self.alpha), gamma=jnp.float32(self.gamma),
+            min_child_weight=jnp.float32(self.min_child_weight),
+            scale_pos_weight=jnp.float32(self.scale_pos_weight),
+            max_delta_step=jnp.float32(self.max_delta_step),
+        )
 
     def _fit_arrays(self, x, y, w):
         binned, edges = self._binned(x)
-        base = self._base_score(y, w)
+        objective, num_class, base = self._resolved(y, w)
         _, trees = _fit_gbt(
             binned, jnp.asarray(y, jnp.float32), jnp.asarray(w, jnp.float32),
-            int(self.num_rounds), int(self.max_depth), int(self.n_bins),
-            self.objective, float(self.eta), float(self.reg_lambda),
-            float(self.gamma), float(self.min_child_weight), float(base),
+            jax.random.PRNGKey(int(self.seed)), objective=objective,
+            num_class=num_class, base_score=jnp.asarray(base, jnp.float32),
+            **self._fit_config(), **self._fit_dynamics(),
         )
-        cls = GBTClassifierModel if self.objective == "binary:logistic" \
-            else GBTRegressorModel
+        cls = GBTRegressorModel if objective == "reg:squarederror" \
+            else GBTClassifierModel
         return cls(trees=trees, edges=edges, max_depth=self.max_depth,
                    n_bins=self.n_bins, base_score=base)
 
+    def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn):
+        objective, num_class, _ = self._resolved(y, np.ones_like(y))
+        return _gbt_cv_program(
+            binned, jnp.asarray(y, jnp.float32), train_w, val_w,
+            jax.random.PRNGKey(int(self.seed)), objective=objective,
+            num_class=num_class,
+            metric_fn=metric_fn, **self._fit_config(), **self._fit_dynamics(),
+        )
+
+
+def _class_count(y: np.ndarray, declared) -> int:
+    if declared:
+        return int(declared)
+    return max(2, int(y.max()) + 1) if len(y) else 2
+
+
+def _log_priors(y: np.ndarray, w: np.ndarray, k: int) -> np.ndarray:
+    counts = np.zeros(k)
+    for c in range(k):
+        counts[c] = float(w[y == c].sum())
+    p = np.clip(counts / max(counts.sum(), 1e-12), 1e-6, 1.0)
+    return np.log(p)
+
 
 class GradientBoostedTreesClassifier(_GBTBase):
-    """OpGBTClassifier / OpXGBoostClassifier capability (binary logistic boosting)."""
+    """OpGBTClassifier / OpXGBoostClassifier capability.
 
-    objective = "binary:logistic"
+    Binary labels boost a single logistic margin; K>2 labels switch to the
+    multi:softmax objective with (K,)-output trees
+    (OpXGBoostClassifier.scala:47-375 num_class handling).
+    """
 
-    def _base_score(self, y, w) -> float:
-        sw = max(float(w.sum()), 1e-12)
-        p = float((w * y).sum() / sw)
-        p = min(max(p, 1e-6), 1 - 1e-6)
-        return float(np.log(p / (1 - p)))
+    num_class = Param(default=None, doc="None = infer from labels")
+
+    def _resolved(self, y, w):
+        k = _class_count(y, self.num_class)
+        if k <= 2:
+            # prior log-odds under the EFFECTIVE weights (scale_pos_weight folded
+            # in), so spw=s on unit weights == unit spw on s-weighted positives
+            we = w * np.where(y == 1.0, float(self.scale_pos_weight), 1.0)
+            sw = max(float(we.sum()), 1e-12)
+            p = float(np.clip((we * (y == 1.0)).sum() / sw, 1e-6, 1 - 1e-6))
+            return "binary:logistic", 1, np.array([np.log(p / (1 - p))])
+        return "multi:softmax", k, _log_priors(y, w, k)
 
 
 class GradientBoostedTreesRegressor(_GBTBase):
@@ -381,9 +649,9 @@ class GradientBoostedTreesRegressor(_GBTBase):
 
     objective = "reg:squarederror"
 
-    def _base_score(self, y, w) -> float:
+    def _resolved(self, y, w):
         sw = max(float(w.sum()), 1e-12)
-        return float((w * y).sum() / sw)
+        return "reg:squarederror", 1, np.array([float((w * y).sum() / sw)])
 
 
 # XGBoost-named aliases (parity with OpXGBoostClassifier/Regressor param surface)
@@ -402,6 +670,7 @@ class _ForestBase(_TreeEstimatorBase):
     reg_lambda = Param(default=0.0)
     subsample = Param(default=1.0)          # Poisson bootstrap rate
     feature_subset = Param(default="sqrt")  # sqrt | all | float fraction
+    classification: bool = True
 
     def _masks(self, d: int):
         rng = np.random.default_rng(self.seed)
@@ -424,19 +693,42 @@ class _ForestBase(_TreeEstimatorBase):
         return jnp.asarray(
             rng.poisson(self.subsample, size=(self.num_trees, n)).astype(np.float32))
 
+    def _y_cols(self, y: np.ndarray) -> np.ndarray:
+        """Per-class regression targets: (n, 1) raw for regression/binary, one-hot
+        (n, K) for multiclass so leaves become class distributions."""
+        if not self.classification:
+            return y[:, None].astype(np.float32)
+        k = _class_count(y, getattr(self, "num_class", None))
+        if k <= 2:
+            return y[:, None].astype(np.float32)
+        return np.eye(k, dtype=np.float32)[y.astype(np.int32)]
+
     def _fit_forest_trees(self, x, y, w):
         binned, edges = self._binned(x)
         trees = _fit_forest(
-            binned, jnp.asarray(y, jnp.float32), jnp.asarray(w, jnp.float32),
-            int(self.num_trees), int(self.max_depth), int(self.n_bins),
-            float(self.reg_lambda), float(self.min_child_weight),
+            binned, jnp.asarray(self._y_cols(y)), jnp.asarray(w, jnp.float32),
+            int(self.max_depth), int(self.n_bins),
+            jnp.float32(self.reg_lambda), jnp.float32(self.min_child_weight),
             self._masks(x.shape[1]), self._boot(x.shape[0]),
         )
         return trees, edges
 
+    def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn):
+        return _forest_cv_program(
+            binned, jnp.asarray(y, jnp.float32), jnp.asarray(self._y_cols(y)),
+            train_w, val_w, self._masks(x.shape[1]), self._boot(x.shape[0]),
+            int(self.max_depth), int(self.n_bins), jnp.float32(self.reg_lambda),
+            jnp.float32(self.min_child_weight), classification=self.classification,
+            metric_fn=metric_fn,
+        )
+
 
 class RandomForestClassifier(_ForestBase):
-    """OpRandomForestClassifier capability."""
+    """OpRandomForestClassifier capability — K classes natively
+    (OpRandomForestClassifier.scala; leaves carry class distributions)."""
+
+    num_class = Param(default=None, doc="None = infer from labels")
+    classification = True
 
     def _fit_arrays(self, x, y, w):
         trees, edges = self._fit_forest_trees(x, y, w)
@@ -448,6 +740,7 @@ class RandomForestRegressor(_ForestBase):
     """OpRandomForestRegressor capability (Spark 'auto' = one-third feature subset)."""
 
     feature_subset = Param(default="onethird")
+    classification = False
 
     def _fit_arrays(self, x, y, w):
         trees, edges = self._fit_forest_trees(x, y, w)
