@@ -150,3 +150,88 @@ def _all_dag_stages(feature):
 
     walk(feature)
     return out
+
+
+class TestAdviceFixes:
+    """ADVICE r1: transformer parents must not invalidate downstream checkpoints;
+    stale npz removal; lineage fingerprints catch transformer param edits."""
+
+    def _text_pipeline(self, tokenizer_min_len=1):
+        from transmogrifai_tpu.ops.text import TextTokenizer
+        from transmogrifai_tpu.types import Text, TextList
+
+        rng = np.random.default_rng(3)
+        n = 120
+        words = ["alpha beta", "gamma delta epsilon", "zeta", "eta theta"]
+        cols = {
+            "txt": [words[i % 4] for i in range(n)],
+            "x0": rng.normal(size=n).tolist(),
+            "label": (rng.random(n) > 0.5).astype(float).tolist(),
+        }
+        ds = Dataset.from_features(
+            cols, {"txt": Text, "x0": Real, "label": RealNN})
+        label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+        txt = FeatureBuilder.of("txt", Text).extract_field().as_predictor()
+        x0 = FeatureBuilder.of("x0", Real).extract_field().as_predictor()
+        toks = txt.transform_with(TextTokenizer(min_token_length=tokenizer_min_len))
+        vec = transmogrify([toks, x0])
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+        pred = label.transform_with(sel, vec)
+        return ds, label, pred
+
+    def test_transformer_parent_does_not_invalidate_resume(self, tmp_path):
+        """Estimators downstream of a stateless Transformer (tokenize) must
+        resume from checkpoint, not refit (ADVICE r1 medium)."""
+        ds, label, pred = self._text_pipeline()
+        ckpt = StageCheckpointer(str(tmp_path))
+        wf = Workflow().set_input_dataset(ds).set_result_features(label, pred)
+        wf.train(checkpointer=ckpt)
+
+        listener = add_listener(OpMetricsListener())
+        try:
+            wf.train(checkpointer=ckpt)
+        finally:
+            remove_listener(listener)
+        fitted = [s.stage_class for s in listener.metrics.stage_metrics
+                  if s.phase == "fit"]
+        assert fitted == [], f"resume refitted: {fitted}"
+
+    def test_transformer_param_edit_refits_downstream(self, tmp_path):
+        """Editing a Transformer param between runs changes the lineage
+        fingerprint, so downstream estimator checkpoints refit."""
+        ds, label, pred = self._text_pipeline(tokenizer_min_len=1)
+        ckpt = StageCheckpointer(str(tmp_path))
+        wf = Workflow().set_input_dataset(ds).set_result_features(label, pred)
+        wf.train(checkpointer=ckpt)
+
+        # second run: same DAG object, tokenizer param changed in place
+        from transmogrifai_tpu.workflow.dag import all_stages
+
+        tok = next(s for s in all_stages([label, pred])
+                   if type(s).__name__ == "TextTokenizer")
+        tok.min_token_length = 3
+        listener = add_listener(OpMetricsListener())
+        try:
+            wf.train(checkpointer=ckpt)
+        finally:
+            remove_listener(listener)
+        fitted = [s.stage_class for s in listener.metrics.stage_metrics
+                  if s.phase == "fit"]
+        assert fitted != [], "param edit on transformer parent must trigger refits"
+
+    def test_save_stage_removes_stale_npz(self, tmp_path):
+        """A refit whose encoding has no arrays must delete a previous npz
+        (ADVICE r1 low: otherwise load pairs new json with old arrays)."""
+        from transmogrifai_tpu.ops.math import AliasTransformer
+
+        ckpt = StageCheckpointer(str(tmp_path))
+        stage = AliasTransformer(name="alias")
+        jpath, npath = ckpt._paths(stage.uid)
+        # simulate an earlier run that wrote arrays for this uid
+        with open(npath, "wb") as fh:
+            np.savez(fh, junk=np.zeros(3))
+        ckpt.save_stage(stage)
+        import os
+
+        assert not os.path.exists(npath)

@@ -42,3 +42,51 @@ class TestDistributed:
             jax.process_index, jax.process_count = orig_idx, orig_cnt
         assert spans == [(0, 4), (4, 8), (8, 10)]
         assert sum(b - a for a, b in spans) == 10
+
+
+class TestFailHardOnMultiWorkerMarkers:
+    """ADVICE r1: auto-bootstrap failure on a marked multi-worker pod must raise,
+    not degrade to N duplicate single-host runs."""
+
+    def test_implied_worker_count(self, monkeypatch):
+        from transmogrifai_tpu.parallel.distributed import _implied_worker_count
+
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        monkeypatch.delenv("SLURM_JOB_NUM_NODES", raising=False)
+        monkeypatch.delenv("OMPI_COMM_WORLD_SIZE", raising=False)
+        assert _implied_worker_count() == 1
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host1,host2,host3")
+        assert _implied_worker_count() == 3
+        monkeypatch.setenv("SLURM_JOB_NUM_NODES", "5")
+        assert _implied_worker_count() == 5
+
+    def test_bootstrap_failure_raises_when_multiworker(self, monkeypatch):
+        import jax
+
+        from transmogrifai_tpu.parallel import distributed as D
+
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "a,b")
+
+        def boom(**kw):
+            raise RuntimeError("no coordinator")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        import pytest
+
+        with pytest.raises(RuntimeError, match="imply 2 workers"):
+            D.initialize()
+
+    def test_bootstrap_failure_warns_when_single(self, monkeypatch, caplog):
+        import jax
+
+        from transmogrifai_tpu.parallel import distributed as D
+
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "onlyhost")
+        monkeypatch.delenv("SLURM_JOB_NUM_NODES", raising=False)
+        monkeypatch.delenv("OMPI_COMM_WORLD_SIZE", raising=False)
+
+        def boom(**kw):
+            raise RuntimeError("no coordinator")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        D.initialize()  # must not raise for a 1-host slice

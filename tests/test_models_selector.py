@@ -296,3 +296,89 @@ def test_no_intercept_elastic_net_penalizes_all_features():
         x, y, np.ones(n, np.float32))
     # strong pure-L1 on noise: every coefficient (incl. the last) shrinks to 0
     assert np.all(np.abs(m.coef) < 1e-6), m.coef
+
+
+class TestExactElasticNetSweep:
+    """ADVICE r1: elastic-net grid points must be ranked under the exact FISTA
+    objective the final fit solves, not the smooth L2 approximation."""
+
+    def test_sweep_matches_per_fold_exact_fits(self):
+        import jax.numpy as jnp
+
+        from transmogrifai_tpu.evaluators import metrics as M
+        from transmogrifai_tpu.models.logistic import LogisticRegression
+
+        rng = np.random.default_rng(31)
+        n = 300
+        x = rng.normal(size=(n, 5)).astype(np.float32)
+        y = (x[:, 0] - 0.5 * x[:, 1] + 0.3 * rng.normal(size=n) > 0) \
+            .astype(np.float32)
+        w = np.ones(n, dtype=np.float32)
+        fold = rng.permutation(n) % 2
+        tw = np.stack([(fold != f) * w for f in range(2)]).astype(np.float32)
+        vw = np.stack([(fold == f) * w for f in range(2)]).astype(np.float32)
+        grids = [{"reg_param": 0.05, "elastic_net": 0.5},
+                 {"reg_param": 0.05, "elastic_net": 0.0}]
+        est = LogisticRegression()
+        swept = est.cv_sweep(x, y, tw, vw, grids, M.METRICS_BINARY["auPR"])
+        assert swept.shape == (2, 2)
+        # the elastic grid row must match a sequential exact FISTA fit per fold
+        for f in range(2):
+            m = est.copy().set_params(**grids[0])._fit_arrays(x, y, tw[f])
+            from transmogrifai_tpu.data.dataset import Column
+
+            s = m.predict_column(Column.vector(x)).score
+            ref = float(M.METRICS_BINARY["auPR"](
+                jnp.asarray(s, jnp.float32), jnp.asarray(y), jnp.asarray(vw[f])))
+            np.testing.assert_allclose(swept[0, f], ref, atol=2e-3)
+
+
+class TestTwoClassUnderMulticlassSelector:
+    """A 2-class label run through the MULTICLASS selector must not NaN out the
+    tree families (binary fast paths emit 1-D payloads; multiclass_error accepts
+    them)."""
+
+    def test_all_families_finite(self):
+        from transmogrifai_tpu.models.selector import MultiClassificationModelSelector
+
+        rng = np.random.default_rng(41)
+        n = 400
+        x = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float32)  # only 2 observed classes
+        sel = MultiClassificationModelSelector.with_cross_validation(num_folds=2)
+        result = sel.validator.validate(sel.models, x, y,
+                                        np.ones(n, dtype=np.float32))
+        assert result.failed_models == [], result.failed_models
+        finite = {ev.model_name for ev in result.evaluations
+                  if all(np.isfinite(v) for v in ev.metric_values)}
+        assert len(finite) >= 3, finite
+
+
+class TestNoInterceptSweepParity:
+    """fit_intercept=False must flow into the device sweep (the last feature
+    would otherwise be treated as an unpenalized intercept slot)."""
+
+    def test_sweep_matches_exact_fit_without_intercept(self):
+        import jax.numpy as jnp
+
+        from transmogrifai_tpu.data.dataset import Column
+        from transmogrifai_tpu.evaluators import metrics as M
+        from transmogrifai_tpu.models.logistic import LogisticRegression
+
+        rng = np.random.default_rng(43)
+        n = 300
+        x = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (x[:, 0] + 0.5 * x[:, 3] > 0).astype(np.float32)
+        w = np.ones(n, dtype=np.float32)
+        fold = rng.permutation(n) % 2
+        tw = np.stack([(fold != f) * w for f in range(2)]).astype(np.float32)
+        vw = np.stack([(fold == f) * w for f in range(2)]).astype(np.float32)
+        est = LogisticRegression(fit_intercept=False)
+        grids = [{"reg_param": 0.1, "elastic_net": 1.0}]
+        swept = est.cv_sweep(x, y, tw, vw, grids, M.METRICS_BINARY["auPR"])
+        for f in range(2):
+            m = est.copy().set_params(**grids[0])._fit_arrays(x, y, tw[f])
+            s = m.predict_column(Column.vector(x)).score
+            ref = float(M.METRICS_BINARY["auPR"](
+                jnp.asarray(s, jnp.float32), jnp.asarray(y), jnp.asarray(vw[f])))
+            np.testing.assert_allclose(swept[0, f], ref, atol=2e-3)

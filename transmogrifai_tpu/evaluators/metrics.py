@@ -129,9 +129,14 @@ def smape(pred, y, w):
 # --- multiclass --------------------------------------------------------------
 
 def multiclass_error(prob, y, w):
-    """prob (n, C), y (n,) integer labels, w (n,)."""
-    pred = jnp.argmax(prob, axis=1).astype(y.dtype)
-    wrong = (pred != y).astype(prob.dtype)
+    """prob (n, C) — or a 1-D positive-class score when a binary-shaped payload
+    reaches a multiclass evaluation (labels with only 2 observed classes take
+    models' binary fast paths); y (n,) integer labels, w (n,)."""
+    if prob.ndim == 1:
+        pred = (prob > 0.5).astype(y.dtype)
+    else:
+        pred = jnp.argmax(prob, axis=1).astype(y.dtype)
+    wrong = (pred != y).astype(jnp.float32)
     return jnp.sum(w * wrong) / jnp.maximum(jnp.sum(w), EPS)
 
 

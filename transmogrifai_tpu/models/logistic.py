@@ -8,11 +8,10 @@ so the whole fit is one XLA program.  ``cv_sweep`` vmaps the fit over (fold-weig
 regularization grid): the reference's thread-pool of per-fold Spark jobs
 (OpCrossValidation.scala:114-134) becomes a single batched device program.
 
-Elastic-net (Spark parametrization: regParam λ, elasticNetParam α): the FINAL fit solves
-the exact composite objective with FISTA (accelerated proximal gradient, soft-threshold
-prox — exact-zero sparsity like Spark's OWL-QN); the CV sweep ranks grid points under the
-smooth L2-scaled approximation for speed (one vmapped IRLS program), which preserves
-ordering in practice.
+Elastic-net (Spark parametrization: regParam λ, elasticNetParam α): both the CV sweep
+and the final fit solve the exact composite objective with FISTA (accelerated proximal
+gradient, soft-threshold prox — exact-zero sparsity like Spark's OWL-QN); pure-L2 grid
+points take the faster vmapped IRLS path.
 """
 
 from __future__ import annotations
@@ -104,13 +103,29 @@ def _fista_elastic(x, y, w, l1, l2, max_iter, has_intercept: bool = True):
     return b
 
 
-@partial(jax.jit, static_argnames=("max_iter",))
-def _irls_sweep(x, y, train_w, regs, max_iter):
+@partial(jax.jit, static_argnames=("max_iter", "has_intercept"))
+def _irls_sweep(x, y, train_w, regs, max_iter, has_intercept: bool = True):
     """vmap the IRLS fit over fold weights (k, n) and reg grid (g,) -> betas (g, k, d+1)."""
-    fit_fold = jax.vmap(lambda w, reg: _irls_core(x, y, w, reg, max_iter),
-                        in_axes=(0, None))
+    fit_fold = jax.vmap(
+        lambda w, reg: _irls_core(x, y, w, reg, max_iter,
+                                  has_intercept=has_intercept),
+        in_axes=(0, None))
     fit_grid = jax.vmap(lambda reg: fit_fold(train_w, reg), in_axes=0)
     return fit_grid(regs)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "has_intercept"))
+def _fista_sweep(x, y, train_w, l1s, l2s, max_iter, has_intercept: bool = True):
+    """vmap the EXACT elastic-net FISTA fit over fold weights (k, n) and the
+    (l1, l2) grid (g,) -> betas (g, k, d+1).  Grid points with l1 > 0 are ranked
+    under the same composite objective the final fit solves (ADVICE r1: the
+    smooth approximation could re-order near-tied grids that vary elastic_net)."""
+    fit_fold = jax.vmap(
+        lambda w, l1, l2: _fista_elastic(x, y, w, l1, l2, max_iter,
+                                         has_intercept=has_intercept),
+        in_axes=(0, None, None))
+    fit_grid = jax.vmap(lambda l1, l2: fit_fold(train_w, l1, l2))
+    return fit_grid(l1s, l2s)
 
 
 def _standardize(x: np.ndarray, w: np.ndarray):
@@ -183,12 +198,15 @@ class LogisticRegression(PredictionEstimatorBase):
 
     # --- device CV sweep ------------------------------------------------------
     def cv_sweep(self, x, y, train_w, val_w, grids: List[Dict[str, Any]], metric_fn):
-        """One XLA program for the whole (grid x fold) sweep."""
-        # all grids share static config (max_iter, intercept); dynamic axis = reg
-        regs = jnp.asarray(
-            [LogisticRegression._effective_reg(self, g.get("reg_param", self.reg_param),
-                                               g.get("elastic_net", self.elastic_net))
-             for g in grids], dtype=jnp.float32)
+        """One XLA program per solver for the whole (grid x fold) sweep: pure-L2
+        grids fit via vmapped IRLS, elastic-net grids via vmapped exact FISTA."""
+        l1l2 = []
+        for g in grids:
+            rp = float(g.get("reg_param", self.reg_param))
+            en = float(g.get("elastic_net", self.elastic_net))
+            l1l2.append((rp * en, rp * (1.0 - en)))
+        l2_idx = [i for i, (l1, _) in enumerate(l1l2) if l1 == 0.0]
+        en_idx = [i for i, (l1, _) in enumerate(l1l2) if l1 > 0.0]
         xs, _, _ = self._prepare(x, np.ones(x.shape[0], dtype=np.float32))
         # Rows zero-pad twice over (safe — fold weights pad to zero, so padded
         # rows never enter the weighted IRLS or the validation metric):
@@ -206,7 +224,23 @@ class LogisticRegression(PredictionEstimatorBase):
         xd, yd = place_rows(xs_p), place_rows(y_p)
         train_w = place(train_w_p, (None, DATA_AXIS))
         val_w = place(val_w_p, (None, DATA_AXIS))
-        betas = _irls_sweep(xd, yd, train_w, regs, self.max_iter)  # (g,k,d+1)
+
+        k, d1 = train_w.shape[0], xs_p.shape[1]
+        has_icpt = bool(self.fit_intercept)
+        parts = []
+        if l2_idx:
+            regs = jnp.asarray([l1l2[i][1] for i in l2_idx], dtype=jnp.float32)
+            parts.append((l2_idx, _irls_sweep(xd, yd, train_w, regs, self.max_iter,
+                                              has_intercept=has_icpt)))
+        if en_idx:
+            l1s = jnp.asarray([l1l2[i][0] for i in en_idx], dtype=jnp.float32)
+            l2s = jnp.asarray([l1l2[i][1] for i in en_idx], dtype=jnp.float32)
+            parts.append((en_idx, _fista_sweep(
+                xd, yd, train_w, l1s, l2s, max(10 * self.max_iter, 300),
+                has_intercept=has_icpt)))
+        betas = jnp.zeros((len(grids), k, d1), dtype=jnp.float32)
+        for idx, b in parts:
+            betas = betas.at[jnp.asarray(idx)].set(b)
 
         @jax.jit
         def eval_gk(betas, vw):

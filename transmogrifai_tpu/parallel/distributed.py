@@ -61,15 +61,36 @@ def initialize(coordinator_address: Optional[str] = None,
     except (ValueError, RuntimeError) as e:
         if kwargs:
             raise  # explicit config must fail loudly
-        # pod-like env markers but no resolvable coordinator (e.g. a
-        # single-worker slice behind a tunnel): proceed single-host, but say
-        # so — on a REAL multi-worker pod this degrades to N duplicate runs.
+        n_implied = _implied_worker_count()
+        if n_implied > 1:
+            # markers say this process is one of N>1 workers: continuing
+            # single-host would run N duplicate full fits racing on the same
+            # model/metrics outputs — fail instead (ADVICE r1)
+            raise RuntimeError(
+                f"jax.distributed auto-bootstrap failed ({e}) but environment "
+                f"markers imply {n_implied} workers; refusing to continue as a "
+                "single-host run. Pass coordinator_address/num_processes/"
+                "process_id explicitly.") from e
+        # pod-like markers but genuinely single-worker (e.g. a 1-host slice
+        # behind a tunnel): proceed single-host, but say so.
         import logging
 
         logging.getLogger(__name__).warning(
             "jax.distributed auto-bootstrap failed (%s); continuing as a "
             "single-host run. If this IS a multi-host pod, pass "
             "coordinator_address/num_processes/process_id explicitly.", e)
+
+
+def _implied_worker_count() -> int:
+    """Worker count the launcher markers imply; 1 when ambiguous/absent."""
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    counts = [len([h for h in hosts.split(",") if h.strip()])]
+    for var in ("SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE"):
+        try:
+            counts.append(int(os.environ.get(var, "1")))
+        except ValueError:
+            pass
+    return max(counts + [1])
 
 
 def _pod_environment() -> bool:

@@ -28,14 +28,43 @@ from .serde import _Decoder, _Encoder, decode_stage, encode_stage
 log = logging.getLogger(__name__)
 
 
+def _stable_repr(v) -> str:
+    """Deterministic-across-processes repr: callables hash by qualname (a plain
+    repr embeds the memory address, which would invalidate every checkpoint on
+    every resume)."""
+    if callable(v):
+        return getattr(v, "__qualname__", type(v).__name__)
+    return repr(v)
+
+
 def stage_fingerprint(stage: PipelineStage) -> str:
-    """Class + params identity of the UNFITTED stage — resume only reuses a
-    checkpoint whose producing stage still looks like this.  (Uids are a
-    process-global construction counter; params can change between runs
-    without changing the uid.)"""
+    """Class + params identity of the UNFITTED stage AND its upstream lineage —
+    resume only reuses a checkpoint whose producing stage and every ancestor
+    stage still look the same.  (Uids are a process-global construction counter;
+    params can change between runs without changing the uid.)  Lineage coverage
+    is what lets the cascade-invalidation pass treat stateless Transformers as
+    non-refitting: a transformer param edit changes this fingerprint instead.
+    FeatureGeneratorStages are skipped (extract fns have no stable identity;
+    raw-feature names are already part of the input schema)."""
+    from ..features.generator import FeatureGeneratorStage
+
+    lineage = []
+    seen = set()
+
+    def walk(s):
+        for f in getattr(s, "inputs", None) or ():
+            o = f.origin_stage
+            if o is None or o.uid in seen or isinstance(o, FeatureGeneratorStage):
+                continue
+            seen.add(o.uid)
+            walk(o)
+            lineage.append({"class": type(o).__name__, "params": o.get_params()})
+
+    walk(stage)
     return json.dumps({"class": type(stage).__name__,
-                       "params": stage.get_params()},
-                      sort_keys=True, default=repr)
+                       "params": stage.get_params(),
+                       "lineage": lineage},
+                      sort_keys=True, default=_stable_repr)
 
 
 class StageCheckpointer:
@@ -68,6 +97,10 @@ class StageCheckpointer:
             with open(tmp_n, "wb") as fh:
                 np.savez(fh, **enc.arrays)
             os.replace(tmp_n, npath)
+        elif os.path.exists(npath):
+            # a refit whose new encoding has no arrays must not leave a previous
+            # run's npz behind — load would pair new json with stale arrays
+            os.remove(npath)
         with open(tmp_j, "w") as fh:
             json.dump(state, fh)
         os.replace(tmp_j, jpath)  # json last: its presence marks completeness

@@ -159,7 +159,13 @@ class Workflow:
                     warm[uid] = model
 
                 # cascade invalidation: a checkpoint downstream of any stage
-                # that will refit was fitted on stale inputs — drop it too
+                # that will REFIT was fitted on stale inputs — drop it too.
+                # Only Estimator parents count as refit sources: stateless
+                # Transformers are deterministic given params and never enter
+                # ``warm`` (param edits are caught by the lineage fingerprint
+                # instead — see stage_fingerprint), so treating their absence
+                # as staleness would refit every checkpointed estimator
+                # downstream of a tokenize/math stage on every resume.
                 loaded_uids = set(entries) & set(warm)
                 changed = True
                 while changed:
@@ -168,8 +174,7 @@ class Workflow:
                         dag_stage = by_uid[uid]
                         stale = any(
                             p.origin_stage is not None
-                            and not isinstance(p.origin_stage,
-                                               FeatureGeneratorStage)
+                            and isinstance(p.origin_stage, Estimator)
                             and p.origin_stage.uid in by_uid
                             and p.origin_stage.uid not in warm
                             for p in dag_stage.inputs)
