@@ -235,3 +235,54 @@ class TestAdviceFixes:
         import os
 
         assert not os.path.exists(npath)
+
+    def test_refit_cascades_through_intermediate_transformer(self, tmp_path):
+        """E1 (estimator) -> Transformer -> E2 (estimator): when E1's checkpoint
+        is gone (so E1 refits), E2 must refit too — staleness looks THROUGH the
+        transformer to the nearest estimator ancestors."""
+        import os
+
+        from transmogrifai_tpu.ops.misc import DropIndicesByTransformer
+        from transmogrifai_tpu.workflow.dag import all_stages
+
+        rng = np.random.default_rng(5)
+        n = 160
+        cols = {f"x{i}": rng.normal(size=n).tolist() for i in range(3)}
+        cols["label"] = (rng.random(n) > 0.5).astype(float).tolist()
+        ds = Dataset.from_features(
+            cols, {**{f"x{i}": Real for i in range(3)}, "label": RealNN})
+        label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+        feats = [FeatureBuilder.of(f"x{i}", Real).extract_field().as_predictor()
+                 for i in range(3)]
+        checked = label.sanity_check(transmogrify(feats))
+        passed = checked.transform_with(
+            DropIndicesByTransformer(match_fn=_keep_all_slots))
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+        pred = label.transform_with(sel, passed)
+
+        ckpt = StageCheckpointer(str(tmp_path))
+        wf = Workflow().set_input_dataset(ds).set_result_features(label, pred)
+        wf.train(checkpointer=ckpt)
+
+        sanity = next(s for s in all_stages([label, pred])
+                      if type(s).__name__ == "SanityChecker")
+        for path in ckpt._paths(sanity.uid):
+            if os.path.exists(path):
+                os.remove(path)
+
+        listener = add_listener(OpMetricsListener())
+        try:
+            wf.train(checkpointer=ckpt)
+        finally:
+            remove_listener(listener)
+        fitted = [s.stage_class for s in listener.metrics.stage_metrics
+                  if s.phase == "fit"]
+        assert "SanityChecker" in fitted
+        assert any("Selector" in c for c in fitted), (
+            f"selector must refit when its (transformer-intermediated) upstream "
+            f"estimator refits; fitted={fitted}")
+
+
+def _keep_all_slots(cm):
+    return False

@@ -51,14 +51,35 @@ class TestFailHardOnMultiWorkerMarkers:
     def test_implied_worker_count(self, monkeypatch):
         from transmogrifai_tpu.parallel.distributed import _implied_worker_count
 
-        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
-        monkeypatch.delenv("SLURM_JOB_NUM_NODES", raising=False)
-        monkeypatch.delenv("OMPI_COMM_WORLD_SIZE", raising=False)
+        for var in ("TPU_WORKER_HOSTNAMES", "SLURM_JOB_NUM_NODES",
+                    "OMPI_COMM_WORLD_SIZE", "TPU_WORKER_ID",
+                    "CLOUD_TPU_TASK_ID", "MEGASCALE_COORDINATOR_ADDRESS"):
+            monkeypatch.delenv(var, raising=False)
         assert _implied_worker_count() == 1
         monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host1,host2,host3")
         assert _implied_worker_count() == 3
         monkeypatch.setenv("SLURM_JOB_NUM_NODES", "5")
         assert _implied_worker_count() == 5
+
+    def test_implied_worker_count_index_and_megascale_markers(self, monkeypatch):
+        """Every marker _pod_environment recognizes must feed the count: a
+        worker index of k implies >= k+1 workers; megascale implies multislice."""
+        from transmogrifai_tpu.parallel.distributed import _implied_worker_count
+
+        for var in ("TPU_WORKER_HOSTNAMES", "SLURM_JOB_NUM_NODES",
+                    "OMPI_COMM_WORLD_SIZE", "TPU_WORKER_ID",
+                    "CLOUD_TPU_TASK_ID", "MEGASCALE_COORDINATOR_ADDRESS"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        assert _implied_worker_count() == 1  # worker 0 alone is ambiguous
+        monkeypatch.setenv("TPU_WORKER_ID", "3")
+        assert _implied_worker_count() == 4
+        monkeypatch.delenv("TPU_WORKER_ID")
+        monkeypatch.setenv("CLOUD_TPU_TASK_ID", "2")
+        assert _implied_worker_count() == 3
+        monkeypatch.delenv("CLOUD_TPU_TASK_ID")
+        monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+        assert _implied_worker_count() == 2
 
     def test_bootstrap_failure_raises_when_multiworker(self, monkeypatch):
         import jax

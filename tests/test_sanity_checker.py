@@ -148,3 +148,116 @@ class TestSanityChecker:
         model = stage.fit(ds)
         # monotonic transform of label -> spearman ~1 -> dropped as leaky
         assert 0 not in model.kept_indices
+
+
+class TestDeviceSpearman:
+    """Device-side tie-averaged ranks (sanity._rank_columns) vs scipy."""
+
+    def test_ranks_match_scipy_with_ties(self):
+        import jax.numpy as jnp
+        from scipy.stats import rankdata
+
+        from transmogrifai_tpu.checkers.sanity import _rank_columns
+
+        rng = np.random.default_rng(7)
+        x = rng.integers(0, 5, size=(97, 3)).astype(np.float32)  # heavy ties
+        got = np.asarray(_rank_columns(jnp.asarray(x)))
+        want = np.column_stack([rankdata(x[:, j]) for j in range(3)])
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_spearman_corr_matches_scipy(self):
+        from scipy.stats import spearmanr
+
+        rng = np.random.default_rng(8)
+        n = 257  # odd size exercises the row-padding mask
+        y = rng.integers(0, 4, size=n).astype(float)
+        x = np.column_stack([
+            y + rng.normal(scale=0.5, size=n),
+            rng.integers(0, 3, size=n).astype(float),
+        ])
+        ds = _vec_ds(x, y, [VectorColumnMetadata("a", "Real"),
+                            VectorColumnMetadata("b", "Real")])
+        stage = SanityChecker(correlation_type="spearman", min_variance=0.0,
+                              max_correlation=1.1)
+        _wire(stage)
+        model = stage.fit(ds)
+        for j in range(2):
+            want = spearmanr(x[:, j], y).statistic
+            assert model.summary.stats[j].corr_label == pytest.approx(want, abs=1e-4)
+
+
+class TestWideAndExclusion:
+    def test_full_corr_wide_path_matches_numpy(self):
+        """d above max_features_for_full_corr routes through the ppermute ring."""
+        rng = np.random.default_rng(9)
+        n, d = 300, 40
+        y = (rng.random(n) > 0.5).astype(float)
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        meta_cols = [VectorColumnMetadata(f"f{j}", "Real") for j in range(d)]
+        ds = _vec_ds(x, y, meta_cols)
+        stage = SanityChecker(max_features_for_full_corr=16, min_variance=0.0)
+        _wire(stage)
+        model = stage.fit(ds)
+        full = model.summary.correlations_feature
+        assert full is not None and full.shape == (d, d)
+        np.testing.assert_allclose(full, np.corrcoef(x.T), atol=2e-3)
+
+    def test_full_corr_small_path_matches_numpy(self):
+        rng = np.random.default_rng(10)
+        n, d = 200, 6
+        y = (rng.random(n) > 0.5).astype(float)
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        ds = _vec_ds(x, y, [VectorColumnMetadata(f"f{j}", "Real") for j in range(d)])
+        stage = SanityChecker(min_variance=0.0)
+        _wire(stage)
+        model = stage.fit(ds)
+        np.testing.assert_allclose(
+            model.summary.correlations_feature, np.corrcoef(x.T), atol=2e-3)
+
+    def test_feature_label_corr_only_skips_matrix(self):
+        rng = np.random.default_rng(11)
+        n = 100
+        y = (rng.random(n) > 0.5).astype(float)
+        x = rng.normal(size=(n, 3)).astype(np.float32)
+        ds = _vec_ds(x, y, [VectorColumnMetadata(f"f{j}", "Real") for j in range(3)])
+        stage = SanityChecker(feature_label_corr_only=True, min_variance=0.0)
+        _wire(stage)
+        model = stage.fit(ds)
+        assert model.summary.correlations_feature is None
+
+    def test_hashed_text_exclusion(self):
+        """Hashed Text slots get NaN label-corr, leave the matrix, aren't corr-dropped."""
+        rng = np.random.default_rng(12)
+        n = 400
+        y = (rng.random(n) > 0.5).astype(float)
+        hashed_leak = y * 2.0 - 1.0            # would be dropped as leaky if included
+        real_leak = y * 3.0 - 1.5              # stays included -> dropped
+        good = rng.normal(size=n) + 0.2 * y
+        x = np.column_stack([hashed_leak, real_leak, good])
+        meta_cols = [
+            VectorColumnMetadata("desc", "Text", grouping="desc",
+                                 descriptor_value="hash_0"),  # hashing-trick slot
+            VectorColumnMetadata("leak", "Real"),
+            VectorColumnMetadata("good", "Real"),
+        ]
+        ds = _vec_ds(x, y, meta_cols)
+        stage = SanityChecker(correlation_exclusion="hashed_text", min_variance=0.0)
+        _wire(stage)
+        model = stage.fit(ds)
+        s = model.summary
+        assert s.correlation_indices == [1, 2]
+        assert s.correlations_feature.shape == (2, 2)
+        assert np.isnan(s.stats[0].corr_label)
+        assert 0 in model.kept_indices          # hashed slot immune to corr drop
+        assert 1 not in model.kept_indices      # real leak still dropped
+        # pivoted text slots (indicator level set) are NOT treated as hashed
+        meta_cols2 = [
+            VectorColumnMetadata("desc", "Text", grouping="desc", indicator_value="A"),
+            VectorColumnMetadata("good", "Real"),
+        ]
+        ds2 = _vec_ds(np.column_stack([hashed_leak, good]), y, meta_cols2)
+        stage2 = SanityChecker(correlation_exclusion="hashed_text", min_variance=0.0,
+                               max_cramers_v=1.1)
+        _wire(stage2)
+        model2 = stage2.fit(ds2)
+        assert model2.summary.correlation_indices == [0, 1]

@@ -3,17 +3,19 @@
 Reference: core/.../preparators/SanityChecker.scala (fitFn :535-650, categoricalTests
 :420-516, getFeaturesToDrop :360-408), SanityCheckerMetadata.scala.
 
-(label RealNN, features OPVector) -> cleaned OPVector.  All statistics run as one jitted
-XLA program over the row-sharded feature block: moments via masked reductions (psum over
-the data axis when sharded), label correlations as a single matvec, and per-group
-contingency matrices as ``indicators^T @ onehot(label)`` — an MXU matmul (SURVEY §7.5).
-Drop decisions and metadata bookkeeping stay on host.
+(label RealNN, features OPVector) -> cleaned OPVector.  All statistics run as jitted
+XLA programs over the row-sharded feature block: moments via masked reductions (psum over
+the data axis when sharded), label correlations as a single matvec, ALL categorical
+groups' contingencies as one stacked ``indicators^T @ onehot(label)`` MXU matmul
+(SURVEY §7.5), and Spearman as Pearson over device-computed tie-averaged ranks.
+The full (d, d) correlation matrix is one gram matmul up to
+``max_features_for_full_corr`` and a column-sharded ppermute ring beyond it
+(parallel/wide.py, SURVEY §5.7).  Drop decisions and metadata bookkeeping stay on host.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from functools import partial
 from typing import Dict, List, Optional
 
 import jax
@@ -52,7 +54,8 @@ class SanityCheckerSummary:
     label_distinct: int = 0
     sample_size: int = 0
     correlation_type: str = "pearson"
-    correlations_feature: Optional[np.ndarray] = None  # (d,d) when small enough
+    correlations_feature: Optional[np.ndarray] = None  # (d_corr, d_corr) matrix
+    correlation_indices: Optional[List[int]] = None  # slots the matrix covers
 
     def to_dict(self) -> dict:
         return {
@@ -65,9 +68,9 @@ class SanityCheckerSummary:
         }
 
 
-@partial(jax.jit, static_argnames=("compute_full_corr",))
+@jax.jit
 def _device_stats(x: jnp.ndarray, y: jnp.ndarray, m: jnp.ndarray,
-                  n_valid: jnp.ndarray, compute_full_corr: bool = False):
+                  n_valid: jnp.ndarray):
     """Masked moments + label correlation in one XLA program.
 
     ``m`` is a 0/1 row mask: padded rows (mesh sharding needs even splits)
@@ -89,18 +92,76 @@ def _device_stats(x: jnp.ndarray, y: jnp.ndarray, m: jnp.ndarray,
     sx = jnp.sqrt((xc ** 2).sum(axis=0) / tot)
     sy = jnp.sqrt((yc ** 2).sum() / tot)
     corr = cov / (sx * sy)
-    full = None
-    if compute_full_corr:
-        c = (xc.T @ xc) / tot
-        denom = sx[:, None] * sx[None, :]
-        full = c / denom
-    return mean, var, xmin, xmax, corr, full
+    return mean, var, xmin, xmax, corr
+
+
+@jax.jit
+def _device_label_corr(x: jnp.ndarray, y: jnp.ndarray, m: jnp.ndarray,
+                       n_valid: jnp.ndarray) -> jnp.ndarray:
+    """Masked Pearson correlation of every column of x with y (one matvec)."""
+    tot = jnp.asarray(n_valid, x.dtype)
+    mw = m[:, None]
+    xc = (x - (x * mw).sum(axis=0) / tot) * mw
+    yc = (y - (y * m).sum() / tot) * m
+    cov = xc.T @ yc / tot
+    sx = jnp.sqrt((xc ** 2).sum(axis=0) / tot)
+    sy = jnp.sqrt((yc ** 2).sum() / tot)
+    return cov / (sx * sy)
+
+
+@jax.jit
+def _device_full_corr(x: jnp.ndarray, m: jnp.ndarray,
+                      n_valid: jnp.ndarray) -> jnp.ndarray:
+    """Masked (d, d) Pearson correlation — one MXU gram matmul."""
+    tot = jnp.asarray(n_valid, x.dtype)
+    mw = m[:, None]
+    xc = (x - (x * mw).sum(axis=0) / tot) * mw
+    c = xc.T @ xc / tot
+    sd = jnp.sqrt(jnp.diag(c))
+    return c / jnp.maximum(sd[:, None] * sd[None, :], 1e-12)
+
+
+@jax.jit
+def _rank_columns(x: jnp.ndarray) -> jnp.ndarray:
+    """Average-tie (fractional) ranks of each column, 1-based, on device.
+
+    Sort-based O(n log n) per column, vmapped over columns: group equal values
+    in sorted order (cumsum of change points), average the ordinal ranks of
+    each tie run via segment min/max, and scatter back through the inverse
+    permutation.  Pearson on these ranks == Spearman with tie correction,
+    matching Spark's Statistics.corr(..., "spearman") used by the reference
+    (SanityChecker.scala:635).
+    """
+
+    def rank1(col):
+        n = col.shape[0]
+        order = jnp.argsort(col)
+        s = col[order]
+        is_new = jnp.concatenate(
+            [jnp.ones((1,), bool), s[1:] != s[:-1]])
+        gid = jnp.cumsum(is_new) - 1
+        idx = jnp.arange(n, dtype=jnp.float32)
+        start = jax.ops.segment_min(idx, gid, num_segments=n)
+        end = jax.ops.segment_max(idx, gid, num_segments=n)
+        avg = (start[gid] + end[gid]) * 0.5 + 1.0
+        return jnp.zeros(n, jnp.float32).at[order].set(avg)
+
+    return jax.vmap(rank1, in_axes=1, out_axes=1)(x)
 
 
 @jax.jit
 def _device_contingency(g: jnp.ndarray, y_onehot: jnp.ndarray) -> jnp.ndarray:
     """(levels, n)^T-free contingency: g (n, L) indicators x y_onehot (n, C) -> (L, C)."""
     return g.T @ y_onehot
+
+
+#: FeatureType names whose hashing-trick slots (descriptor ``hash_<b>``, no
+#: indicator level) are excluded from correlation when requested (reference
+#: SanityChecker.scala:596-610, CorrelationExclusion.HashedText; the reference
+#: detects them as text-parented slots with no grouping/indicator — here hashed
+#: slots carry an explicit hash_<bucket> descriptor instead).
+_HASHED_TEXT_PARENT_TYPES = frozenset(
+    {"Text", "TextArea", "TextList", "TextMap", "TextAreaMap"})
 
 
 class SanityChecker(BinaryEstimator):
@@ -120,9 +181,21 @@ class SanityChecker(BinaryEstimator):
     min_required_rule_support = Param(default=1.0)
     correlation_type = Param(default="pearson",
                              validator=lambda v: v in ("pearson", "spearman"))
+    correlation_exclusion = Param(
+        default="none", validator=lambda v: v in ("none", "hashed_text"),
+        doc="exclude hashed-text slots from correlations "
+            "(reference CorrelationExclusion, SanityChecker.scala:891-905)")
+    feature_label_corr_only = Param(
+        default=False,
+        doc="skip the full (d, d) matrix; label correlations only "
+            "(reference featureLabelCorrOnly)")
     remove_bad_features = Param(default=True)
     categorical_label = Param(default=None, doc="None = auto-detect")
-    max_features_for_full_corr = Param(default=512)
+    max_features_for_full_corr = Param(
+        default=512,
+        doc="above this width the full matrix routes through the "
+            "column-sharded ppermute ring (parallel/wide.py) instead of one "
+            "replicated gram matmul")
 
     def _is_label_slot(self, feature, features) -> bool:
         return feature is features[0]
@@ -144,7 +217,6 @@ class SanityChecker(BinaryEstimator):
         meta = vec_col.meta
         names = meta.column_names()
 
-        compute_full = d <= self.max_features_for_full_corr
         # Under an ambient mesh the row blocks shard over the data axis and the
         # row reductions below become psums over ICI (use_mesh, SURVEY §5.8).
         # Rows zero-pad to the mesh multiple; the mask keeps statistics exact.
@@ -155,17 +227,68 @@ class SanityChecker(BinaryEstimator):
         x_p, y_p, mask_p, _ = pad_rows_bucketed_for_mesh(x, y, mask, n=n)
         x_dev, y_lab_dev = place_rows(x_p), place_rows(y_p)
         mask_dev = place_rows(mask_p)
-        if self.correlation_type == "spearman":
-            corr = npstats.spearman_with_label(x, y)
-            mean_, var_, min_, max_, _, full = map(
-                _to_np, _device_stats(x_dev, y_lab_dev, mask_dev, float(n),
-                                      compute_full)
-            )
+        mean_, var_, min_, max_, pearson_corr = map(
+            _to_np, _device_stats(x_dev, y_lab_dev, mask_dev, float(n))
+        )
+
+        # --- correlations (label vector + full matrix) ---------------------------
+        # Hashing-trick slots can dominate d; the reference optionally drops them
+        # from the correlation computation (SanityChecker.scala:596-620).
+        corr_idx = list(range(d))
+        if self.correlation_exclusion == "hashed_text":
+            hashed = {
+                c.index for c in meta.columns
+                if c.indicator_value is None
+                and c.parent_type in _HASHED_TEXT_PARENT_TYPES
+                and (c.descriptor_value or "").startswith("hash_")
+            }
+            corr_idx = [j for j in range(d) if j not in hashed]
+        excluded = len(corr_idx) < d
+        spearman = self.correlation_type == "spearman"
+
+        # the correlation block: rank-transformed and/or column-subset x, placed
+        # once and reused by both the label corr and the full matrix.  Bucketed
+        # row padding depends only on n, so the moments mask is reusable as-is.
+        if spearman:
+            # tie-averaged ranks on device; Pearson of ranks == Spearman.
+            # Ranks come from the unpadded rows (padding would pollute the
+            # order statistics), then run through the same masked kernels.
+            x_corr = np.asarray(_rank_columns(jnp.asarray(x)))
+            y_corr = np.asarray(
+                _rank_columns(jnp.asarray(y, np.float32)[:, None]))[:, 0]
         else:
-            mean_, var_, min_, max_, corr, full = map(
-                _to_np, _device_stats(x_dev, y_lab_dev, mask_dev, float(n),
-                                      compute_full)
-            )
+            x_corr, y_corr = x, y.astype(np.float32)
+        if excluded:
+            x_corr = np.ascontiguousarray(x_corr[:, corr_idx])
+        if spearman or excluded:
+            xc_dev = place_rows(pad_rows_bucketed_for_mesh(x_corr, n=n)[0])
+        else:
+            xc_dev = x_dev
+
+        if spearman:
+            yc_dev = place_rows(pad_rows_bucketed_for_mesh(y_corr, n=n)[0])
+            corr_sub = np.asarray(
+                _device_label_corr(xc_dev, yc_dev, mask_dev, float(n)))
+        else:
+            corr_sub = pearson_corr[corr_idx]
+        if excluded:
+            corr = np.full(d, np.nan)
+            corr[corr_idx] = corr_sub
+        else:
+            corr = corr_sub
+
+        full = None
+        if not self.feature_label_corr_only and corr_idx:
+            if len(corr_idx) <= self.max_features_for_full_corr:
+                full = np.asarray(_device_full_corr(xc_dev, mask_dev, float(n)))
+            else:
+                # wide path: column-shard the corr block over the mesh and build
+                # the gram matrix with a ppermute ring (parallel/wide.py §5.7)
+                from ..parallel.mesh import current_mesh, make_mesh
+                from ..parallel.wide import shard_cols, wide_full_corr
+                mesh = current_mesh() or make_mesh()
+                xs, d_valid = shard_cols(x_corr, mesh)
+                full = np.asarray(wide_full_corr(xs, mesh, d_valid))
 
         # --- categorical label? (reference heuristic SanityChecker.scala:447) ----
         label_levels = np.unique(y)
@@ -183,10 +306,18 @@ class SanityChecker(BinaryEstimator):
             y_onehot = (y[:, None] == label_levels[None, :]).astype(np.float32)
             # zero-padded rows contribute nothing to g.T @ y_onehot — no mask needed
             y_dev = place_rows(pad_rows_bucketed_for_mesh(y_onehot, n=n)[0])
+            # ALL groups' indicator columns in ONE (L_total, C) matmul; split
+            # the stacked contingency back per group on host (the reference
+            # loops a Spark job per group, SanityChecker.scala:420-516)
+            all_idx = [j for idxs in groups.values() for j in idxs]
+            g_all = place_rows(
+                pad_rows_bucketed_for_mesh(
+                    np.ascontiguousarray(x[:, all_idx]), n=n)[0])
+            cont_all = np.asarray(_device_contingency(g_all, y_dev))
+            off = 0
             for gkey, indices in groups.items():
-                g = place_rows(
-                    pad_rows_bucketed_for_mesh(x[:, indices], n=n)[0])
-                cont = np.asarray(_device_contingency(g, y_dev))
+                cont = cont_all[off:off + len(indices)]
+                off += len(indices)
                 group_v[gkey] = npstats.cramers_v(cont)
                 conf, support = npstats.max_rule_confidences(cont)
                 group_conf[gkey] = conf
@@ -256,6 +387,7 @@ class SanityChecker(BinaryEstimator):
             sample_size=n,
             correlation_type=self.correlation_type,
             correlations_feature=full,
+            correlation_indices=corr_idx,
         )
         return SanityCheckerModel(kept_indices=kept, summary=summary, meta=meta)
 

@@ -95,7 +95,14 @@ class FeatureBuilder(metaclass=_FeatureBuilderMeta):
     """``FeatureBuilder.<TypeName>(name)`` for any of the 45 registered types."""
 
     @staticmethod
-    def of(name: str, ftype: Type[FeatureType]) -> _TypedBuilder:
+    def of(name: str, ftype) -> _TypedBuilder:
+        """Builder for ``name`` typed as ``ftype`` (a FeatureType subclass or
+        registered type name)."""
+        if isinstance(ftype, str):
+            ftype = feature_type_by_name(ftype)  # raises on unknown names
+        elif not (isinstance(ftype, type) and issubclass(ftype, FeatureType)):
+            raise TypeError(
+                f"ftype must be a FeatureType subclass or type name, got {ftype!r}")
         return _TypedBuilder(name, ftype)
 
     # -- schema inference (fromDataFrame/fromSchema equivalents) --------------

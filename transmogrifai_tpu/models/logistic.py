@@ -205,7 +205,10 @@ class LogisticRegression(PredictionEstimatorBase):
             rp = float(g.get("reg_param", self.reg_param))
             en = float(g.get("elastic_net", self.elastic_net))
             l1l2.append((rp * en, rp * (1.0 - en)))
-        l2_idx = [i for i, (l1, _) in enumerate(l1l2) if l1 == 0.0]
+        # partition covers EVERY grid point: non-positive l1 (including a
+        # typo'd negative reg/elastic_net) routes to the smooth IRLS solver —
+        # a grid must never silently evaluate as all-zero coefficients
+        l2_idx = [i for i, (l1, _) in enumerate(l1l2) if l1 <= 0.0]
         en_idx = [i for i, (l1, _) in enumerate(l1l2) if l1 > 0.0]
         xs, _, _ = self._prepare(x, np.ones(x.shape[0], dtype=np.float32))
         # Rows zero-pad twice over (safe — fold weights pad to zero, so padded

@@ -82,7 +82,13 @@ def initialize(coordinator_address: Optional[str] = None,
 
 
 def _implied_worker_count() -> int:
-    """Worker count the launcher markers imply; 1 when ambiguous/absent."""
+    """Worker count the launcher markers imply; 1 when ambiguous/absent.
+
+    Covers every marker ``_pod_environment`` recognizes: explicit counts
+    (hostname lists, SLURM/OMPI sizes), worker indices (a task id of k implies
+    at least k+1 workers), and multislice coordination (megascale jobs span
+    multiple slices by construction).
+    """
     hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
     counts = [len([h for h in hosts.split(",") if h.strip()])]
     for var in ("SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE"):
@@ -90,6 +96,13 @@ def _implied_worker_count() -> int:
             counts.append(int(os.environ.get(var, "1")))
         except ValueError:
             pass
+    for var in ("TPU_WORKER_ID", "CLOUD_TPU_TASK_ID"):
+        try:
+            counts.append(int(os.environ.get(var, "-1")) + 1)
+        except ValueError:
+            pass
+    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        counts.append(2)
     return max(counts + [1])
 
 

@@ -160,12 +160,28 @@ class Workflow:
 
                 # cascade invalidation: a checkpoint downstream of any stage
                 # that will REFIT was fitted on stale inputs — drop it too.
-                # Only Estimator parents count as refit sources: stateless
+                # Only Estimator ancestors count as refit sources: stateless
                 # Transformers are deterministic given params and never enter
                 # ``warm`` (param edits are caught by the lineage fingerprint
                 # instead — see stage_fingerprint), so treating their absence
                 # as staleness would refit every checkpointed estimator
-                # downstream of a tokenize/math stage on every resume.
+                # downstream of a tokenize/math stage on every resume.  The
+                # walk looks THROUGH transformer parents to the nearest
+                # estimator ancestors, so E1 -> transform -> E2 still
+                # invalidates E2 when E1 refits.
+                def _estimator_ancestors(stage):
+                    seen, stack, found = set(), list(stage.inputs), []
+                    while stack:
+                        st = stack.pop().origin_stage
+                        if st is None or st.uid in seen:
+                            continue
+                        seen.add(st.uid)
+                        if isinstance(st, Estimator):
+                            found.append(st)
+                        else:
+                            stack.extend(st.inputs)
+                    return found
+
                 loaded_uids = set(entries) & set(warm)
                 changed = True
                 while changed:
@@ -173,11 +189,8 @@ class Workflow:
                     for uid in list(loaded_uids):
                         dag_stage = by_uid[uid]
                         stale = any(
-                            p.origin_stage is not None
-                            and isinstance(p.origin_stage, Estimator)
-                            and p.origin_stage.uid in by_uid
-                            and p.origin_stage.uid not in warm
-                            for p in dag_stage.inputs)
+                            est.uid in by_uid and est.uid not in warm
+                            for est in _estimator_ancestors(dag_stage))
                         if stale:
                             del warm[uid]
                             loaded_uids.discard(uid)
