@@ -84,6 +84,17 @@ def assert_transformer_spec(
                                            rtol=1e-6, err_msg=f"row {i}")
             elif isinstance(w, float) and isinstance(single, float):
                 np.testing.assert_allclose(single, w, rtol=1e-6, err_msg=f"row {i}")
+            elif isinstance(w, dict) and isinstance(single, dict):
+                # e.g. Prediction payloads: float values need tolerance (the
+                # column path reduces on device, the row path on host)
+                assert single.keys() == w.keys(), f"row {i}: {single!r} != {w!r}"
+                for k in w:
+                    if isinstance(w[k], float) and isinstance(single[k], float):
+                        np.testing.assert_allclose(
+                            single[k], w[k], rtol=1e-6, atol=1e-9,
+                            err_msg=f"row {i} key {k!r}")
+                    else:
+                        assert single[k] == w[k], f"row {i} key {k!r}"
             else:
                 assert single == w, f"row {i}: transform_values {single!r} != {w!r}"
 
