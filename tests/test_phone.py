@@ -1,0 +1,168 @@
+"""Region-aware phone parsing/validation (reference PhoneNumberParser.scala:1-566).
+
+Parity fixture spans 14 regions with valid and invalid numbers in both
+international (+cc) and national formats, plus the resolution ladder
+(region code -> fuzzy country name -> default) and strict/lenient modes.
+"""
+
+import pytest
+
+from transmogrifai_tpu.ops.phone import (
+    INTERNATIONAL_CODE,
+    IsValidPhoneDefaultCountry,
+    IsValidPhoneMapDefaultCountry,
+    IsValidPhoneNumber,
+    ParsePhoneDefaultCountry,
+    ParsePhoneNumber,
+    clean_number,
+    parse_phone,
+    resolve_region,
+    supported_regions,
+    validate_phone,
+)
+from transmogrifai_tpu.testkit.builder import TestFeatureBuilder
+from transmogrifai_tpu.testkit.specs import assert_transformer_spec
+from transmogrifai_tpu.types import Binary, Phone, PhoneMap, Text
+
+# (raw value, region, expected normalized) — None expected means invalid
+PARITY_FIXTURE = [
+    # NANPA: 10 digits, area code and exchange in [2-9]
+    ("+1 415 555 2671", "US", "+14155552671"),
+    ("(650) 555-1234", "US", "+16505551234"),
+    ("415-555-2671", "US", "+14155552671"),
+    ("1 415 555 2671", "US", "+14155552671"),      # trunk '1' stripped
+    ("+1 115 555 2671", "US", None),               # area code can't start 1
+    ("555-0199", "US", None),                      # too short
+    ("+1 415 555 2671", "GB", "+14155552671"),     # '+' overrides region
+    # United Kingdom: trunk 0, lengths {7,9,10}
+    ("+44 20 7183 8750", "GB", "+442071838750"),
+    ("020 7183 8750", "GB", "+442071838750"),
+    ("+44 20 71", "GB", None),
+    # France: 9 national digits, trunk 0
+    ("+33 1 42 68 53 00", "FR", "+33142685300"),
+    ("01 42 68 53 00", "FR", "+33142685300"),
+    ("+33 1 42 68", "FR", None),
+    # Germany: 6-11 digits, trunk 0
+    ("+49 30 901820", "DE", "+4930901820"),
+    ("030 901820", "DE", "+4930901820"),
+    # Japan
+    ("+81 3 1234 5678", "JP", "+81312345678"),
+    # China
+    ("+86 10 1234 5678", "CN", "+861012345678"),
+    # India: exactly 10
+    ("+91 98765 43210", "IN", "+919876543210"),
+    ("+91 98765", "IN", None),
+    # Australia
+    ("+61 2 9374 4000", "AU", "+61293744000"),
+    ("02 9374 4000", "AU", "+61293744000"),
+    # Brazil: 10-11
+    ("+55 11 91234 5678", "BR", "+5511912345678"),
+    # Russia: trunk 8, 10 national digits
+    ("+7 495 123 45 67", "RU", "+74951234567"),
+    ("8 495 123 45 67", "RU", "+74951234567"),
+    # Singapore: 8, no trunk
+    ("+65 6123 4567", "SG", "+6561234567"),
+    # South Africa
+    ("+27 11 123 4567", "ZA", "+27111234567"),
+    # Mexico
+    ("+52 55 1234 5678", "MX", "+525512345678"),
+    # Spain
+    ("+34 912 345 678", "ES", "+34912345678"),
+    # garbage
+    ("not a phone", "US", None),
+    ("+999 123456", "US", None),                   # unknown calling code
+    ("0", "US", None),
+]
+
+
+class TestParsePhoneParity:
+    @pytest.mark.parametrize("raw,region,expected", PARITY_FIXTURE)
+    def test_parse(self, raw, region, expected):
+        assert parse_phone(raw, region) == expected
+
+    @pytest.mark.parametrize("raw,region,expected", PARITY_FIXTURE)
+    def test_validate_agrees(self, raw, region, expected):
+        assert validate_phone(raw, region) is (expected is not None)
+
+    def test_none_and_short(self):
+        assert parse_phone(None, "US") is None
+        assert validate_phone(None, "US") is None
+        assert validate_phone("1", "US") is False  # < 2 chars
+
+    def test_region_coverage(self):
+        assert len(supported_regions()) >= 50
+
+    def test_strict_vs_lenient_truncation(self):
+        too_long = "+1 415 555 2671 999"
+        assert parse_phone(too_long, "US", strict=False) == "+14155552671"
+        assert parse_phone(too_long, "US", strict=True) is None
+        assert validate_phone("415 555 2671 99", "US", strict=False) is True
+        assert validate_phone("415 555 2671 99", "US", strict=True) is False
+
+    def test_clean_number(self):
+        assert clean_number(" +1 (415) 555-2671 ") == "+14155552671"
+
+
+class TestRegionResolution:
+    def test_international_format_wins(self):
+        assert resolve_region("+44 20 7183 8750", "US") == INTERNATIONAL_CODE
+
+    def test_exact_region_code(self):
+        assert resolve_region("020 7183 8750", "gb") == "GB"
+
+    def test_fuzzy_country_name(self):
+        assert resolve_region("020", "United Kingdom") == "GB"
+        assert resolve_region("0800", "Deutschland") == "DE"
+        assert resolve_region("0800", "Brasil") == "BR"
+
+    def test_default_fallback(self):
+        assert resolve_region("415 555 2671", None, default_region="US") == "US"
+        assert resolve_region("415 555 2671", "", default_region="CA") == "CA"
+
+
+class TestPhoneStages:
+    def test_parse_default_country(self):
+        f, ds = TestFeatureBuilder.of(
+            "p", Phone, ["(415) 555-2671", "12", None])
+        stage = ParsePhoneDefaultCountry(default_region="US")
+        stage.set_input(f)
+        assert_transformer_spec(stage, ds,
+                                expected=["+14155552671", None, None])
+
+    def test_is_valid_default_country(self):
+        f, ds = TestFeatureBuilder.of(
+            "p", Phone, ["(415) 555-2671", "12", None])
+        stage = IsValidPhoneDefaultCountry(default_region="US")
+        stage.set_input(f)
+        assert_transformer_spec(stage, ds, expected=[True, False, None])
+
+    def test_parse_with_country_column(self):
+        f, ds = TestFeatureBuilder.of(
+            "p", Phone, ["020 7183 8750", "415 555 2671", "06 12 34 56 78"])
+        g, ds2 = TestFeatureBuilder.of(
+            "c", Text, ["United Kingdom", "US", "France"])
+        ds = ds.with_column("c", ds2["c"])
+        stage = ParsePhoneNumber()
+        stage.set_input(f, g)
+        assert_transformer_spec(
+            stage, ds,
+            expected=["+442071838750", "+14155552671", "+33612345678"])
+
+    def test_is_valid_with_country_column(self):
+        f, ds = TestFeatureBuilder.of("p", Phone, ["020 7183 8750", "123"])
+        g, ds2 = TestFeatureBuilder.of("c", Text, ["GB", "GB"])
+        ds = ds.with_column("c", ds2["c"])
+        stage = IsValidPhoneNumber()
+        stage.set_input(f, g)
+        assert_transformer_spec(stage, ds, expected=[True, False])
+
+    def test_phone_map(self):
+        f, ds = TestFeatureBuilder.of(
+            "pm", PhoneMap,
+            [{"home": "415 555 2671", "bad": "12"}, {}, None])
+        stage = IsValidPhoneMapDefaultCountry(default_region="US")
+        stage.set_input(f)
+        out = assert_transformer_spec(stage, ds, check_row_parity=True)
+        rows = out.to_values()
+        assert rows[0] == {"home": True, "bad": False}
+        assert rows[1] in ({}, None)

@@ -42,6 +42,7 @@ from transmogrifai_tpu.types import (
     MultiPickList,
     OPVector,
     Phone,
+    PhoneMap,
     PickList,
     Real,
     RealMap,
@@ -94,6 +95,8 @@ TYPE_VALUES = {
     Phone: ["+14155552671", "4155552671", None, "123", "+442071838750",
             "+81312345678", None, "555-867-5309", "+14155550000", "0",
             "+4930123456", "+14155559999"],
+    PhoneMap: [{"home": "+14155552671", "work": "12"} if i % 3 else {}
+               for i in range(12)],
     Base64: [_PNG, _PDF, None, _PNG, _PDF, _PNG, None, _PDF, _PNG, _PDF,
              _PNG, _PDF],
     Date: [WED_MS + i * _DAY for i in range(11)] + [None],
@@ -249,6 +252,11 @@ CASES = {
     "ValidUrlTransformer": unary(URL),
     "UrlToDomainTransformer": unary(URL),
     "PhoneNumberValidator": unary(Phone),
+    "ParsePhoneDefaultCountry": unary(Phone),
+    "IsValidPhoneDefaultCountry": unary(Phone),
+    "IsValidPhoneMapDefaultCountry": unary(PhoneMap),
+    "ParsePhoneNumber": binary(Phone, Text),
+    "IsValidPhoneNumber": binary(Phone, Text),
     "MimeTypeDetector": unary(Base64),
     "TimePeriodTransformer": unary(Date, {"period": "DayOfWeek"}),
     "TimePeriodListTransformer": unary(DateList, {"period": "DayOfWeek"}),

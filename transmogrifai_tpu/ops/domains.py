@@ -24,15 +24,6 @@ from ..stages.base import Param, UnaryTransformer
 from ..types import Base64 as B64Type
 from ..types import Binary, Email, Phone, PickList, Text, URL
 
-# region -> (country calling code, min national digits, max national digits)
-_PHONE_PLANS = {
-    "US": ("1", 10, 10), "CA": ("1", 10, 10), "GB": ("44", 9, 10),
-    "DE": ("49", 6, 11), "FR": ("33", 9, 9), "ES": ("34", 9, 9),
-    "IT": ("39", 8, 11), "AU": ("61", 9, 9), "JP": ("81", 9, 10),
-    "CN": ("86", 10, 11), "IN": ("91", 10, 10), "BR": ("55", 10, 11),
-    "MX": ("52", 10, 10), "NL": ("31", 9, 9), "SE": ("46", 7, 9),
-}
-
 _EMAIL_RE = re.compile(
     r"^[A-Za-z0-9.!#$%&'*+/=?^_`{|}~-]+@[A-Za-z0-9](?:[A-Za-z0-9-]{0,61}[A-Za-z0-9])?"
     r"(?:\.[A-Za-z0-9](?:[A-Za-z0-9-]{0,61}[A-Za-z0-9])?)+$")
@@ -43,26 +34,16 @@ _URL_RE = re.compile(
     r"(?::\d{1,5})?(?:[/?#].*)?$", re.IGNORECASE)
 
 
-def parse_phone(value: Optional[str], default_region: str = "US") -> Optional[bool]:
-    """Validity of a phone number for the region (PhoneNumberParser.validate)."""
-    if not value:
-        return None
-    digits = re.sub(r"[^\d+]", "", value)
-    if not digits or digits in ("+",):
-        return False
-    plan = _PHONE_PLANS.get(default_region.upper())
-    if digits.startswith("+"):
-        body = digits[1:]
-        for code, lo, hi in _PHONE_PLANS.values():
-            if body.startswith(code) and lo <= len(body) - len(code) <= hi:
-                return True
-        return False
-    if plan is None:
-        return 6 <= len(digits) <= 15  # ITU E.164 envelope
-    code, lo, hi = plan
-    if digits.startswith(code) and lo <= len(digits) - len(code) <= hi:
-        return True
-    return lo <= len(digits) <= hi
+def parse_phone(value: Optional[str], default_region: str = "US",
+                strict: bool = False) -> Optional[bool]:
+    """Validity of a phone number for the region (PhoneNumberParser.validate).
+
+    Delegates to the region-metadata engine in ops/phone.py (calling codes,
+    per-region length tables, NANPA digit patterns, trunk prefixes).
+    """
+    from .phone import validate_phone
+
+    return validate_phone(value, default_region.upper(), strict)
 
 
 def is_valid_email(value: Optional[str]) -> Optional[bool]:
@@ -150,17 +131,19 @@ class _UnaryValueTransformer(UnaryTransformer):
 
 
 class PhoneNumberValidator(_UnaryValueTransformer):
-    """Phone -> Binary validity (OpPhoneNumberParser capability)."""
+    """Phone -> Binary validity (IsValidPhoneDefaultCountry capability; the
+    full four-transformer surface lives in ops/phone.py)."""
 
     input_types = (Phone,)
     output_type = Binary
 
     default_region = Param(default="US")
+    strict_validation = Param(default=False)
 
     def transform_columns(self, cols: List[Column], dataset) -> Column:
-        region = self.default_region
+        region, strict = self.default_region, self.strict_validation
         return Column.from_values(
-            Binary, [parse_phone(v, region) for v in cols[0].data])
+            Binary, [parse_phone(v, region, strict) for v in cols[0].data])
 
 
 class ValidEmailTransformer(_UnaryValueTransformer):
