@@ -1,0 +1,236 @@
+"""Train the shipped NER perceptron artifact (transmogrifai_tpu/artifacts/ner_tagger.npz).
+
+Plays the role of the reference's OpenNLP model-training pipeline whose output
+is the checked-in en-ner-*.bin artifacts (models/src/main/resources/OpenNLP).
+Training data is slot-filled from sentence templates: entity slots draw from
+gazetteers below, and every token gets a gold TAG_SET label from its slot.
+The averaged perceptron learns shape/context cues (honorifics, verbs like
+"visited", org suffixes, prev-tag transitions), so at inference it tags names
+it has never seen — unlike the static gazetteer tagger it augments.
+
+Run from the repo root:  python tools/train_ner_tagger.py
+Deterministic (fixed seed); rewrites the npz artifact in place.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from transmogrifai_tpu.ops.ner import ner_tokenize  # noqa: E402
+from transmogrifai_tpu.ops.ner_model import (  # noqa: E402
+    ARTIFACT_PATH,
+    NUM_BUCKETS,
+    TAG_INDEX,
+    TAG_SET,
+    hash_features,
+    token_features,
+)
+
+FIRST_NAMES = [
+    "James", "Mary", "Robert", "Patricia", "John", "Jennifer", "Michael",
+    "Linda", "David", "Elizabeth", "William", "Barbara", "Richard", "Susan",
+    "Joseph", "Jessica", "Thomas", "Sarah", "Carlos", "Maria", "Luis",
+    "Ana", "Miguel", "Sofia", "Wei", "Li", "Chen", "Yuki", "Hiroshi",
+    "Kenji", "Amit", "Ravi", "Anil", "Fatima", "Omar", "Ahmed", "Yusuf",
+    "Olga", "Ivan", "Dmitri", "Natasha", "Pierre", "Marie", "Jean",
+    "Hans", "Greta", "Klaus", "Ingrid", "Kwame", "Amara", "Chidi",
+]
+SURNAMES = [
+    "Smith", "Johnson", "Williams", "Brown", "Jones", "Garcia", "Miller",
+    "Davis", "Rodriguez", "Martinez", "Hernandez", "Lopez", "Gonzalez",
+    "Wilson", "Anderson", "Taylor", "Moore", "Jackson", "Martin", "Lee",
+    "Tanaka", "Suzuki", "Watanabe", "Kim", "Park", "Nguyen", "Tran",
+    "Patel", "Sharma", "Gupta", "Khan", "Ali", "Hassan", "Ivanov",
+    "Petrov", "Dubois", "Moreau", "Schmidt", "Mueller", "Weber",
+    "Okonkwo", "Mensah", "Diallo", "Abara", "Osei",
+]
+CITIES = [
+    "London", "Paris", "Berlin", "Madrid", "Rome", "Vienna", "Amsterdam",
+    "Dublin", "Lisbon", "Prague", "Tokyo", "Osaka", "Seoul", "Beijing",
+    "Shanghai", "Mumbai", "Delhi", "Singapore", "Sydney", "Melbourne",
+    "Toronto", "Chicago", "Boston", "Seattle", "Denver", "Austin",
+    "Atlanta", "Miami", "Lagos", "Nairobi", "Cairo", "Accra",
+]
+COUNTRIES = [
+    "France", "Germany", "Spain", "Italy", "Japan", "China", "India",
+    "Brazil", "Canada", "Australia", "Nigeria", "Kenya", "Egypt",
+    "Mexico", "Argentina", "Sweden", "Norway", "Poland", "Turkey",
+    "Vietnam", "Thailand", "Ireland", "Portugal", "Austria", "Ghana",
+]
+ORG_HEADS = [
+    "Acme", "Globex", "Initech", "Umbrella", "Stark", "Wayne", "Cyberdyne",
+    "Hooli", "Vandelay", "Wonka", "Tyrell", "Aperture", "Sirius", "Oscorp",
+    "Nakatomi", "Zorin", "Duff", "Pawnee", "Dunder", "Sterling",
+]
+ORG_SUFFIXES = ["Inc.", "Corp.", "Ltd.", "LLC", "Group", "Bank",
+                "University", "Institute", "Foundation", "Company"]
+MONTHS = ["January", "February", "March", "April", "May", "June", "July",
+          "August", "September", "October", "November", "December"]
+WEEKDAYS = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+            "Saturday", "Sunday"]
+HONORIFICS = ["Mr.", "Mrs.", "Ms.", "Dr.", "Prof."]
+
+# templates: {slot} fills below; every filled token is labeled with the slot's
+# tag, all other tokens are O
+TEMPLATES = [
+    ("{hon} {first} {last} visited {city} on {weekday}.",
+     {"first": "Person", "last": "Person", "city": "Location",
+      "weekday": "Date"}),
+    ("{first} {last} works at {orghead} {orgsuf} in {city}.",
+     {"first": "Person", "last": "Person", "orghead": "Organization",
+      "orgsuf": "Organization", "city": "Location"}),
+    ("{orghead} {orgsuf} reported revenue of {money} in {month} {year}.",
+     {"orghead": "Organization", "orgsuf": "Organization", "money": "Money",
+      "month": "Date", "year": "Date"}),
+    ("The meeting with {hon} {last} starts at {time} on {weekday}.",
+     {"last": "Person", "time": "Time", "weekday": "Date"}),
+    ("{first} flew from {city} to {country} last {month}.",
+     {"first": "Person", "city": "Location", "country": "Location",
+      "month": "Date"}),
+    ("Shares of {orghead} {orgsuf} fell {percent} on {slashdate}.",
+     {"orghead": "Organization", "orgsuf": "Organization",
+      "percent": "Percentage", "slashdate": "Date"}),
+    ("{hon} {first} {last} joined {orghead} {orgsuf} as director.",
+     {"first": "Person", "last": "Person", "orghead": "Organization",
+      "orgsuf": "Organization"}),
+    ("{city} is the largest city in {country}.",
+     {"city": "Location", "country": "Location"}),
+    ("On {isodate} {first} {last} paid {money} to {orghead} {orgsuf}.",
+     {"isodate": "Date", "first": "Person", "last": "Person",
+      "money": "Money", "orghead": "Organization", "orgsuf": "Organization"}),
+    ("{first} {last} and {first2} {last2} met in {city} at {time}.",
+     {"first": "Person", "last": "Person", "first2": "Person",
+      "last2": "Person", "city": "Location", "time": "Time"}),
+    ("Growth reached {percent} in {country} during {month}.",
+     {"percent": "Percentage", "country": "Location", "month": "Date"}),
+    ("{orghead} {orgsuf} opened an office in {city}, {country}.",
+     {"orghead": "Organization", "orgsuf": "Organization",
+      "city": "Location", "country": "Location"}),
+    ("Interest rates rose by {percent} last {weekday}.",
+     {"percent": "Percentage", "weekday": "Date"}),
+    ("{hon} {last} of {orghead} {orgsuf} arrives at {time}.",
+     {"last": "Person", "orghead": "Organization", "orgsuf": "Organization",
+      "time": "Time"}),
+    ("The contract is worth {money} over three years.",
+     {"money": "Money"}),
+    ("{first} {last} was born in {city} on {slashdate}.",
+     {"first": "Person", "last": "Person", "city": "Location",
+      "slashdate": "Date"}),
+    ("Prices fell {percent} to {money} in {city}.",
+     {"percent": "Percentage", "money": "Money", "city": "Location"}),
+    ("{country} and {country2} signed the accord in {month} {year}.",
+     {"country": "Location", "country2": "Location", "month": "Date",
+      "year": "Date"}),
+    ("Please call {first} before {time} on {weekday}.",
+     {"first": "Person", "time": "Time", "weekday": "Date"}),
+    ("{orghead} {orgsuf} acquired {orghead2} {orgsuf2} for {money}.",
+     {"orghead": "Organization", "orgsuf": "Organization",
+      "orghead2": "Organization", "orgsuf2": "Organization",
+      "money": "Money"}),
+    ("He paid {money} for {percent} of {orghead} {orgsuf}.",
+     {"money": "Money", "percent": "Percentage", "orghead": "Organization",
+      "orgsuf": "Organization"}),
+    ("{first} offered {money} for {percent} of the shares.",
+     {"first": "Person", "money": "Money", "percent": "Percentage"}),
+    ("The fund returned {percent} after fees of {money}.",
+     {"percent": "Percentage", "money": "Money"}),
+    ("{hon} {first} {last} sold {percent} of {orghead} {orgsuf} for {money}.",
+     {"first": "Person", "last": "Person", "percent": "Percentage",
+      "orghead": "Organization", "orgsuf": "Organization",
+      "money": "Money"}),
+]
+
+
+def _fill(rng):
+    """One labeled sentence: (tokens, tags)."""
+    tpl, slot_tags = TEMPLATES[rng.integers(len(TEMPLATES))]
+    fills = {
+        "hon": HONORIFICS[rng.integers(len(HONORIFICS))],
+        "first": FIRST_NAMES[rng.integers(len(FIRST_NAMES))],
+        "first2": FIRST_NAMES[rng.integers(len(FIRST_NAMES))],
+        "last": SURNAMES[rng.integers(len(SURNAMES))],
+        "last2": SURNAMES[rng.integers(len(SURNAMES))],
+        "city": CITIES[rng.integers(len(CITIES))],
+        "country": COUNTRIES[rng.integers(len(COUNTRIES))],
+        "country2": COUNTRIES[rng.integers(len(COUNTRIES))],
+        "orghead": ORG_HEADS[rng.integers(len(ORG_HEADS))],
+        "orghead2": ORG_HEADS[rng.integers(len(ORG_HEADS))],
+        "orgsuf": ORG_SUFFIXES[rng.integers(len(ORG_SUFFIXES))],
+        "orgsuf2": ORG_SUFFIXES[rng.integers(len(ORG_SUFFIXES))],
+        "month": MONTHS[rng.integers(len(MONTHS))],
+        "weekday": WEEKDAYS[rng.integers(len(WEEKDAYS))],
+        "money": f"${rng.integers(1, 999)}{rng.choice(['M', 'B', 'k', ''])}",
+        # with and without decimals ("10%" must tag like "12.5%")
+        "percent": (f"{rng.integers(1, 99)}.{rng.integers(0, 9)}%"
+                    if rng.random() < 0.5 else f"{rng.integers(1, 99)}%"),
+        "time": f"{rng.integers(1, 12)}:{rng.integers(0, 59):02d}"
+                f"{rng.choice(['am', 'pm', ''])}",
+        "year": str(rng.integers(1990, 2026)),
+        "isodate": f"{rng.integers(1990, 2026)}-{rng.integers(1, 12):02d}"
+                   f"-{rng.integers(1, 28):02d}",
+        "slashdate": f"{rng.integers(1, 12)}/{rng.integers(1, 28)}"
+                     f"/{rng.integers(1990, 2026)}",
+    }
+    tokens, tags = [], []
+    for part in tpl.split():
+        if part.startswith("{"):
+            slot = part.strip("{}.,")
+            toks = ner_tokenize(fills[slot])
+            tag = slot_tags.get(slot, "O")
+        else:
+            toks = ner_tokenize(part)
+            tag = "O"
+        tokens.extend(toks)
+        tags.extend([tag] * len(toks))
+    return tokens, tags
+
+
+def train(n_sentences=6000, epochs=5, seed=13):
+    rng = np.random.default_rng(seed)
+    data = [_fill(rng) for _ in range(n_sentences)]
+    w = np.zeros((NUM_BUCKETS, len(TAG_SET)), np.float64)
+    acc = np.zeros_like(w)  # weight * steps-survived accumulator (averaging)
+    step = 0
+    for epoch in range(epochs):
+        order = rng.permutation(len(data))
+        errors = 0
+        for si in order:
+            tokens, gold = data[si]
+            prev_tag = "O"
+            for i, g in enumerate(gold):
+                idx = hash_features(token_features(tokens, i, prev_tag))
+                scores = w[idx].sum(axis=0)
+                pred = int(scores.argmax())
+                gi = TAG_INDEX[g]
+                if pred != gi:
+                    w[idx, gi] += 1.0
+                    w[idx, pred] -= 1.0
+                    acc[idx, gi] += step
+                    acc[idx, pred] -= step
+                    errors += 1
+                # teacher forcing: condition on the gold previous tag
+                prev_tag = g
+                step += 1
+        print(f"epoch {epoch}: {errors} token errors "
+              f"({errors / max(step, 1):.4f} rate)")
+    # averaged weights: mean over steps = w - acc/step
+    avg = w - acc / max(step, 1)
+    return avg.astype(np.float16)
+
+
+def main():
+    weights = train()
+    os.makedirs(os.path.dirname(ARTIFACT_PATH), exist_ok=True)
+    np.savez_compressed(ARTIFACT_PATH, weights=weights,
+                        tags=np.array(TAG_SET))
+    size = os.path.getsize(ARTIFACT_PATH) / 1e6
+    print(f"wrote {ARTIFACT_PATH} ({size:.2f} MB)")
+
+
+if __name__ == "__main__":
+    main()

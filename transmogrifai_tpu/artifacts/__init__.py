@@ -1,0 +1,2 @@
+"""Shipped model artifacts (the reference models-module role: trained binary
+artifacts checked into the package — models/src/main/resources/OpenNLP)."""

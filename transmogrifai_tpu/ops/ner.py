@@ -20,7 +20,7 @@ from typing import Dict, List, Set
 import numpy as np
 
 from ..data.dataset import Column
-from ..stages.base import UnaryTransformer
+from ..stages.base import Param, UnaryTransformer
 from ..types import MultiPickListMap, Text
 from ..utils.text import split_sentences
 
@@ -211,18 +211,46 @@ class NameEntityRecognizer(UnaryTransformer):
 
     Splits into sentences, tags each, and folds the per-sentence maps by union —
     mirroring the reference's sentence-wise tagging + foldLeft merge.
+
+    Two tagger backends (the reference's OpenNLPNameEntityTagger role):
+    - ``"learned"`` (default): the shipped averaged-perceptron model
+      (ops/ner_model.py, artifact trained by tools/train_ner_tagger.py) —
+      generalizes to unseen names via shape/context features;
+    - ``"rules"``: the deterministic rule + gazetteer tagger above.
+    Falls back to rules if the learned artifact is absent.
     """
 
     input_types = (Text,)
     output_type = MultiPickListMap
 
+    tagger = Param(default="learned",
+                   validator=lambda v: v in ("learned", "rules"))
+
+    def _sentence_tagger(self):
+        if self.tagger == "learned":
+            from .ner_model import load_pretrained
+
+            learned = load_pretrained()
+            if learned is not None:
+                return lambda sent: learned.tag_to_entities(ner_tokenize(sent))
+        rules = RuleNameEntityTagger()
+        return rules.tag
+
     def transform_columns(self, cols: List[Column], dataset) -> Column:
-        tagger = RuleNameEntityTagger()
+        tag = self._sentence_tagger()
         out = np.empty(len(cols[0]), dtype=object)
         for i, text in enumerate(cols[0].data):
             merged: Dict[str, Set[str]] = {}
             for sent in split_sentences(text or ""):
-                for tok, ents in tagger.tag(sent).items():
+                for tok, ents in tag(sent).items():
                     merged.setdefault(tok, set()).update(ents)
             out[i] = {k: sorted(v) for k, v in merged.items()}
         return Column(MultiPickListMap, out)
+
+    def transform_values(self, values):
+        tag = self._sentence_tagger()
+        merged: Dict[str, Set[str]] = {}
+        for sent in split_sentences(values[0] or ""):
+            for tok, ents in tag(sent).items():
+                merged.setdefault(tok, set()).update(ents)
+        return {k: sorted(v) for k, v in merged.items()}

@@ -1,0 +1,177 @@
+"""Learned named-entity tagger: averaged perceptron over hashed features.
+
+Reference capability: the reference ships trained OpenNLP maxent models as
+binary artifacts (models/src/main/resources/OpenNLP/en-ner-person.bin etc.)
+loaded by OpenNLPNameEntityTagger (utils/.../text/OpenNLPNameEntityTagger.scala).
+This module plays both roles natively: a compact averaged-perceptron tagger
+whose trained weights ship as an npz artifact
+(``transmogrifai_tpu/artifacts/ner_tagger.npz``, built by
+``tools/train_ner_tagger.py``), loaded lazily at first use.
+
+Design: per-token multiclass scoring over murmur3-hashed string features
+(word identity, orthographic shape, affixes, neighbors, regex classes, the
+previous predicted tag), greedy left-to-right decode.  Generalizes to unseen
+names through shape + context features, which is exactly what the static
+gazetteer in ops/ner.py cannot do (tests/test_ner.py pins the win on a
+held-out sample).  All host-side string work — strings never reach the
+device (SURVEY §7.9).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from ..utils.hashing import murmur3_32
+
+#: classes, aligned with the reference NameEntityType enum
+#: (utils/.../text/NameEntityTagger.scala:78-86); index 0 is the null tag
+TAG_SET = ("O", "Person", "Location", "Organization", "Date", "Time",
+           "Money", "Percentage", "Misc")
+TAG_INDEX = {t: i for i, t in enumerate(TAG_SET)}
+
+#: hashed feature space (2^15 buckets keeps the shipped artifact ~1 MB fp16)
+NUM_BUCKETS = 1 << 15
+HASH_SEED = 7
+
+ARTIFACT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "artifacts", "ner_tagger.npz")
+
+_MONEY_RE = re.compile(r"^[$€£¥]\d[\d,]*(?:\.\d+)?[kmb]?$", re.IGNORECASE)
+_PERCENT_RE = re.compile(r"^\d[\d,]*(?:\.\d+)?%$")
+_TIME_RE = re.compile(r"^\d{1,2}:\d{2}(?::\d{2})?(?:am|pm)?$|^\d{1,2}(?:am|pm)$",
+                      re.IGNORECASE)
+_DATE_RE = re.compile(r"^\d{4}-\d{2}-\d{2}$|^\d{1,2}/\d{1,2}/\d{2,4}$")
+_YEAR_RE = re.compile(r"^(19|20)\d{2}$")
+_NUM_RE = re.compile(r"^\d[\d,.]*$")
+
+
+def word_shape(tok: str) -> str:
+    """Compressed orthographic shape: 'McDonald' -> 'XxXx', '3:30pm' -> 'd:dx'."""
+    out = []
+    last = None
+    for ch in tok:
+        if ch.isupper():
+            c = "X"
+        elif ch.islower():
+            c = "x"
+        elif ch.isdigit():
+            c = "d"
+        else:
+            c = ch
+        if c != last or c not in "Xxd":
+            out.append(c)
+        last = c
+    return "".join(out)
+
+
+def token_features(tokens: Sequence[str], i: int, prev_tag: str) -> List[str]:
+    """Feature strings for token i (shared by training and inference)."""
+    w = tokens[i]
+    low = w.lower()
+    prev = tokens[i - 1] if i > 0 else "<s>"
+    nxt = tokens[i + 1] if i + 1 < len(tokens) else "</s>"
+    prev_low, nxt_low = prev.lower(), nxt.lower()
+    feats = [
+        f"w={low}",
+        f"shape={word_shape(w)}",
+        f"pre2={low[:2]}", f"pre3={low[:3]}",
+        f"suf2={low[-2:]}", f"suf3={low[-3:]}",
+        f"prev={prev_low}", f"next={nxt_low}",
+        f"prevshape={word_shape(prev)}", f"nextshape={word_shape(nxt)}",
+        f"prevtag={prev_tag}",
+        f"prevtag+shape={prev_tag}|{word_shape(w)}",
+        f"w+next={low}|{nxt_low}",
+        f"prev+w={prev_low}|{low}",
+    ]
+    if i == 0:
+        feats.append("bos")
+    if w[:1].isupper():
+        feats.append("cap")
+        if i > 0:
+            feats.append("cap-mid")  # capitalized NOT at sentence start
+    if w.isupper() and len(w) > 1:
+        feats.append("allcaps")
+    if any(c.isdigit() for c in w):
+        feats.append("hasdigit")
+    if _MONEY_RE.match(w):
+        feats.append("re=money")
+    if _PERCENT_RE.match(w):
+        feats.append("re=percent")
+    if _TIME_RE.match(w):
+        feats.append("re=time")
+    if _DATE_RE.match(w):
+        feats.append("re=date")
+    if _YEAR_RE.match(w):
+        feats.append("re=year")
+    if _NUM_RE.match(w):
+        feats.append("re=num")
+    return feats
+
+
+def hash_features(feats: Sequence[str]) -> np.ndarray:
+    return np.fromiter(
+        (murmur3_32(f, HASH_SEED) % NUM_BUCKETS for f in feats),
+        dtype=np.int64, count=len(feats))
+
+
+class PerceptronNameEntityTagger:
+    """Greedy averaged-perceptron tagger over hashed features.
+
+    ``tag(sentence_tokens)`` -> one TAG_SET entry per token;
+    ``tag_to_entities(tokens)`` -> token -> set(entity types), the
+    NameEntityRecognizer output shape.
+    """
+
+    def __init__(self, weights: np.ndarray):
+        if weights.shape != (NUM_BUCKETS, len(TAG_SET)):
+            raise ValueError(
+                f"NER weights must be {(NUM_BUCKETS, len(TAG_SET))}, "
+                f"got {weights.shape}")
+        self.weights = weights.astype(np.float32)
+
+    def tag(self, tokens: Sequence[str]) -> List[str]:
+        prev_tag = "O"
+        out = []
+        for i in range(len(tokens)):
+            idx = hash_features(token_features(tokens, i, prev_tag))
+            scores = self.weights[idx].sum(axis=0)
+            prev_tag = TAG_SET[int(scores.argmax())]
+            out.append(prev_tag)
+        return out
+
+    def tag_to_entities(self, tokens: Sequence[str]) -> Dict[str, Set[str]]:
+        tags: Dict[str, Set[str]] = {}
+        for tok, tag in zip(tokens, self.tag(tokens)):
+            if tag != "O":
+                tags.setdefault(tok, set()).add(tag)
+        return tags
+
+
+_cached_tagger: Optional[PerceptronNameEntityTagger] = None
+_load_lock = threading.Lock()
+
+
+def load_pretrained(path: Optional[str] = None) -> Optional[PerceptronNameEntityTagger]:
+    """The shipped tagger, or None when the artifact is absent (callers fall
+    back to the rule/gazetteer tagger)."""
+    global _cached_tagger
+    if path is None and _cached_tagger is not None:
+        return _cached_tagger
+    p = path or ARTIFACT_PATH
+    if not os.path.exists(p):
+        return None
+    with _load_lock:
+        if path is None and _cached_tagger is not None:
+            return _cached_tagger
+        with np.load(p) as z:
+            tagger = PerceptronNameEntityTagger(
+                z["weights"].astype(np.float32))
+        if path is None:
+            _cached_tagger = tagger
+    return tagger
