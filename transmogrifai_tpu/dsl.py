@@ -132,6 +132,262 @@ def _filter_keys(self: Feature, white_list=(), black_list=(),
         filter_empty=filter_empty))
 
 
+# ---------------------------------------------------------------------------
+# Per-type vectorize (RichMapFeature/RichDateFeature/... .vectorize):
+# one call produces the type-appropriate OPVector, with per-map-type key
+# white/black-listing (RichMapFeature.scala:91-129, 206-278, 352-497)
+# ---------------------------------------------------------------------------
+
+def _vectorize(self: Feature, others: Sequence[Feature] = (),
+               white_list_keys: Sequence[str] = (),
+               black_list_keys: Sequence[str] = (), **kw) -> Feature:
+    """Type-dispatched single-feature vectorization.
+
+    ``others`` are same-typed features vectorized together (one stage, shared
+    vocab/key space).  For map types, ``white_list_keys``/``black_list_keys``
+    restrict which keys enter the vector.  Remaining ``kw`` flow to the
+    type-specific vectorizer (top_k/min_support/num_hashes/track_nulls/
+    time_periods/pivot...).
+    """
+    from .types import Date, DateList, Geolocation, MultiPickList, OPMap
+    from .types.maps import DateMap, GeolocationMap, TextAreaMap, TextMap
+
+    feats = [self, *list(others)]
+    ftype = self.ftype
+    if issubclass(ftype, OPMap):
+        if white_list_keys or black_list_keys:
+            feats = [f.filter_keys(white_list=white_list_keys,
+                                   black_list=black_list_keys)
+                     for f in feats]
+        from .ops.collections_lift import DateMapToUnitCircleVectorizer
+        from .ops.maps import (
+            GeolocationMapVectorizer,
+            NumericMapVectorizer,
+            TextMapPivotVectorizer,
+        )
+        from .ops.text_smart import SmartTextMapVectorizer
+        from .types.maps import _SetMap, _StringMap
+
+        if issubclass(ftype, DateMap):
+            stage = DateMapToUnitCircleVectorizer(**kw)
+        elif issubclass(ftype, GeolocationMap):
+            stage = GeolocationMapVectorizer(**kw)
+        elif issubclass(ftype, (TextMap, TextAreaMap)):
+            stage = SmartTextMapVectorizer(**kw)
+        elif issubclass(ftype, (_StringMap, _SetMap)):
+            stage = TextMapPivotVectorizer(**kw)
+        else:
+            stage = NumericMapVectorizer(**kw)
+        return feats[0].transform_with(stage, *feats[1:])
+    if white_list_keys or black_list_keys:
+        raise TypeError("key white/black lists only apply to map features")
+    if issubclass(ftype, DateList):
+        from .ops.dates import DateListVectorizer
+
+        return feats[0].transform_with(DateListVectorizer(**kw), *feats[1:])
+    if issubclass(ftype, Date):
+        from .ops.dates import DateToUnitCircleVectorizer
+
+        return feats[0].transform_with(DateToUnitCircleVectorizer(**kw),
+                                       *feats[1:])
+    if issubclass(ftype, MultiPickList):
+        from .ops.onehot import MultiPickListVectorizer
+
+        return feats[0].transform_with(MultiPickListVectorizer(**kw),
+                                       *feats[1:])
+    if issubclass(ftype, Geolocation):
+        from .ops.geo import GeolocationVectorizer
+
+        return feats[0].transform_with(GeolocationVectorizer(**kw), *feats[1:])
+    if kw:
+        raise TypeError(
+            f"vectorize options {sorted(kw)} unsupported for "
+            f"{ftype.__name__}; use the type's vectorizer stage directly")
+    return transmogrify(feats)
+
+
+# -- dates (RichDateFeature.scala:55-107) -----------------------------------
+
+def _to_unit_circle(self: Feature, *periods: str,
+                    others: Sequence[Feature] = ()) -> Feature:
+    """Date/DateMap -> (cos, sin) unit-circle encoding per time period."""
+    from .ops.collections_lift import DateMapToUnitCircleVectorizer
+    from .ops.dates import DateToUnitCircleVectorizer
+    from .types.maps import DateMap
+
+    kw = {"time_periods": list(periods)} if periods else {}
+    cls = (DateMapToUnitCircleVectorizer if issubclass(self.ftype, DateMap)
+           else DateToUnitCircleVectorizer)
+    return self.transform_with(cls(**kw), *others)
+
+
+def _to_time_period(self: Feature, period: str) -> Feature:
+    """Date/DateList/DateMap -> extracted calendar field (toTimePeriod)."""
+    from .ops.dates import (
+        TimePeriodListTransformer,
+        TimePeriodMapTransformer,
+        TimePeriodTransformer,
+    )
+    from .types import DateList
+    from .types.maps import DateMap
+
+    if issubclass(self.ftype, DateMap):
+        stage = TimePeriodMapTransformer(period=period)
+    elif issubclass(self.ftype, DateList):
+        stage = TimePeriodListTransformer(period=period)
+    else:
+        stage = TimePeriodTransformer(period=period)
+    return self.transform_with(stage)
+
+
+# -- text similarity + smart vectorize (RichTextFeature.scala:97-276) -------
+
+def _to_ngram_similarity(self: Feature, other: Feature, n: int = 3) -> Feature:
+    from .ops.text import NGramSimilarity
+
+    return self.transform_with(NGramSimilarity(n=n), other)
+
+
+def _jaccard_similarity(self: Feature, other: Feature) -> Feature:
+    from .ops.text import JaccardSimilarity
+
+    return self.transform_with(JaccardSimilarity(), other)
+
+
+def _smart_vectorize(self: Feature, others: Sequence[Feature] = (),
+                     **kw) -> Feature:
+    from .ops.text_smart import SmartTextVectorizer
+
+    return self.transform_with(SmartTextVectorizer(**kw), *others)
+
+
+def _is_substring(self: Feature, other: Feature) -> Feature:
+    """self a substring of other -> Binary (RichTextFeature.isSubstring)."""
+    from .ops.misc import SubstringTransformer
+
+    return self.transform_with(SubstringTransformer(), other)
+
+
+# -- phone (RichTextFeature.scala:451-544) ----------------------------------
+
+def _parse_phone(self: Feature, region: Optional[Feature] = None,
+                 **kw) -> Feature:
+    from .ops.phone import ParsePhoneDefaultCountry, ParsePhoneNumber
+
+    if region is not None:
+        return self.transform_with(ParsePhoneNumber(**kw), region)
+    return self.transform_with(ParsePhoneDefaultCountry(**kw))
+
+
+def _is_valid_phone(self: Feature, region: Optional[Feature] = None,
+                    **kw) -> Feature:
+    from .ops.phone import IsValidPhoneDefaultCountry, IsValidPhoneNumber
+
+    if region is not None:
+        return self.transform_with(IsValidPhoneNumber(**kw), region)
+    return self.transform_with(IsValidPhoneDefaultCountry(**kw))
+
+
+# -- email / url / base64 (RichTextFeature.scala:565-687) -------------------
+
+def _to_email_prefix(self: Feature) -> Feature:
+    from .ops.domains import email_prefix
+    from .types import Text
+
+    return self.map_to(email_prefix, Text, name="emailPrefix")
+
+
+def _to_email_domain(self: Feature) -> Feature:
+    from .ops.domains import email_domain
+    from .types import Text
+
+    return self.map_to(email_domain, Text, name="emailDomain")
+
+
+def _is_valid_email(self: Feature) -> Feature:
+    from .ops.domains import ValidEmailTransformer
+
+    return self.transform_with(ValidEmailTransformer())
+
+
+def _to_domain(self: Feature) -> Feature:
+    from .ops.domains import UrlToDomainTransformer
+
+    return self.transform_with(UrlToDomainTransformer())
+
+
+def _to_protocol(self: Feature) -> Feature:
+    from .ops.domains import url_protocol
+    from .types import Text
+
+    return self.map_to(url_protocol, Text, name="urlProtocol")
+
+
+def _is_valid_url(self: Feature) -> Feature:
+    from .ops.domains import ValidUrlTransformer
+
+    return self.transform_with(ValidUrlTransformer())
+
+
+def _detect_mime_types(self: Feature) -> Feature:
+    from .ops.domains import MimeTypeDetector
+
+    return self.transform_with(MimeTypeDetector())
+
+
+# -- value transforms + scaling (RichFeature misc) --------------------------
+
+def _scale(self: Feature, **kw) -> Feature:
+    from .ops.misc import ScalerTransformer
+
+    return self.transform_with(ScalerTransformer(**kw))
+
+
+def _descale(self: Feature, scaled: Feature) -> Feature:
+    """Invert the scaling applied to ``scaled`` (RichMapFeature.descale)."""
+    from .ops.misc import DescalerTransformer
+
+    return self.transform_with(DescalerTransformer(), scaled)
+
+
+def _to_occur(self: Feature, match_fn=None) -> Feature:
+    from .ops.misc import ToOccurTransformer
+
+    return self.transform_with(
+        ToOccurTransformer(match_fn=match_fn, input_type=self.ftype))
+
+
+def _exists(self: Feature, predicate) -> Feature:
+    from .ops.misc import ExistsTransformer
+
+    return self.transform_with(
+        ExistsTransformer(predicate=predicate, input_type=self.ftype))
+
+
+def _filter_values(self: Feature, predicate, default) -> Feature:
+    from .ops.misc import FilterTransformer
+
+    return self.transform_with(FilterTransformer(
+        predicate=predicate, default=default, input_type=self.ftype))
+
+
+def _replace_with(self: Feature, old_value, new_value) -> Feature:
+    from .ops.misc import ReplaceTransformer
+
+    return self.transform_with(ReplaceTransformer(
+        input_type=self.ftype, old_value=old_value, new_value=new_value))
+
+
+def combine(features: Sequence[Feature], name: str = "combined") -> Feature:
+    """Concatenate OPVector features (reference ``Seq(...).combine()``)."""
+    from .ops.combiner import VectorsCombiner
+
+    if not features:
+        raise ValueError("combine needs at least one feature")
+    return features[0].transform_with(
+        VectorsCombiner(operation_name=name), *features[1:])
+
+
 Feature.__add__ = _binary_op("plus")
 Feature.__sub__ = _binary_op("minus")
 Feature.__mul__ = _binary_op("multiply")
@@ -150,5 +406,27 @@ Feature.name_entity_tags = _name_entity_tags
 Feature.word2vec = _word2vec
 Feature.lda_topics = _lda_topics
 Feature.filter_keys = _filter_keys
+Feature.vectorize = _vectorize
+Feature.to_unit_circle = _to_unit_circle
+Feature.to_time_period = _to_time_period
+Feature.to_ngram_similarity = _to_ngram_similarity
+Feature.jaccard_similarity = _jaccard_similarity
+Feature.smart_vectorize = _smart_vectorize
+Feature.is_substring = _is_substring
+Feature.parse_phone = _parse_phone
+Feature.is_valid_phone = _is_valid_phone
+Feature.to_email_prefix = _to_email_prefix
+Feature.to_email_domain = _to_email_domain
+Feature.is_valid_email = _is_valid_email
+Feature.to_domain = _to_domain
+Feature.to_protocol = _to_protocol
+Feature.is_valid_url = _is_valid_url
+Feature.detect_mime_types = _detect_mime_types
+Feature.scale = _scale
+Feature.descale = _descale
+Feature.to_occur = _to_occur
+Feature.exists = _exists
+Feature.filter_values = _filter_values
+Feature.replace_with = _replace_with
 
-__all__ = ["transmogrify"]
+__all__ = ["transmogrify", "combine"]

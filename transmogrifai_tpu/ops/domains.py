@@ -80,6 +80,16 @@ def url_domain(value: Optional[str]) -> Optional[str]:
     return m.group("host").lower()
 
 
+def url_protocol(value: Optional[str]) -> Optional[str]:
+    """Scheme of a valid URL (reference RichTextFeature.toProtocol)."""
+    if not value:
+        return None
+    m = _URL_RE.match(value)
+    if m is None or "." not in m.group("host"):
+        return None
+    return m.group("scheme").lower()
+
+
 _MAGIC = [
     (b"%PDF", "application/pdf"),
     (b"\x89PNG", "image/png"),
