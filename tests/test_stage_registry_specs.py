@@ -480,9 +480,12 @@ def test_stage_spec(name):
 
 
 def test_registry_fully_covered():
-    """Every registered stage is swept, a swept estimator's model product, or
-    explicitly exempted with a reason."""
+    """Every PACKAGE stage is swept, a swept estimator's model product, or
+    explicitly exempted with a reason.  Stage classes test modules define for
+    their own fixtures register too — those are out of scope."""
     covered = set(CASES) | set(EXPECTED_MODEL.values()) | set(EXEMPT)
-    missing = sorted(set(STAGE_REGISTRY) - covered)
+    package = {n for n, c in STAGE_REGISTRY.items()
+               if c.__module__.startswith("transmogrifai_tpu.")}
+    missing = sorted(package - covered)
     assert not missing, (
         f"stages registered without spec coverage or exemption: {missing}")

@@ -8,8 +8,11 @@ a param pytree; predict is a jitted batched function.  Estimators that implement
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict, List, Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..data.dataset import Column, Dataset
@@ -24,6 +27,33 @@ def softmax_probs(raw: np.ndarray) -> np.ndarray:
     m = raw.max(axis=1, keepdims=True)
     e = np.exp(raw - m)
     return e / e.sum(axis=1, keepdims=True)
+
+
+@partial(jax.jit, static_argnames=("metric_fn",))
+def eval_metric(payload, y, w, *, metric_fn):
+    """One jitted metric evaluation, cached on the metric's identity.
+
+    Metric functions come from module-level registries (Evaluator.metric_fn),
+    so their identity is stable across cv_sweep calls — WITHOUT this wrapper,
+    every sweep re-traces the metric eagerly (or re-jits a fresh closure) and
+    pays a full backend compile per call.  Sort-based AUC programs cost tens
+    of seconds to compile on remote-compile backends, so this caching is
+    load-bearing for selector throughput, not a micro-optimization.
+    """
+    return metric_fn(payload, y, w)
+
+
+@partial(jax.jit, static_argnames=("metric_fn", "link"))
+def eval_linear_sweep(xd, yd, betas, vw, *, metric_fn, link="identity"):
+    """Metric per (grid, fold) for linear-family sweeps — one cached program.
+
+    betas: (g, k, d); vw: (k, n).  ``link`` maps margins to scores
+    ("identity" for regression/SVM margins, "sigmoid" for logistic probs).
+    """
+    margins = jnp.einsum("nd,gkd->gkn", xd, betas)
+    scores = jax.nn.sigmoid(margins) if link == "sigmoid" else margins
+    per_fold = jax.vmap(lambda s, w_: metric_fn(s, yd, w_), in_axes=(0, 0))
+    return jax.vmap(lambda ps: per_fold(ps, vw), in_axes=0)(scores)
 
 
 class PredictionModelBase(Transformer):
@@ -84,6 +114,7 @@ class PredictionEstimatorBase(Estimator):
         """Metric per (grid, fold).  Default: python loops (generic estimators)."""
         k = train_w.shape[0]
         out = np.zeros((len(grids), k))
+        yd = jnp.asarray(y, jnp.float32)
         for gi, grid in enumerate(grids):
             est = self.copy().set_params(**grid)
             for f in range(k):
@@ -95,5 +126,7 @@ class PredictionEstimatorBase(Estimator):
                     payload = col.prob
                 else:
                     payload = col.score
-                out[gi, f] = float(metric_fn(payload, y, val_w[f]))
+                out[gi, f] = float(eval_metric(
+                    jnp.asarray(payload, jnp.float32), yd,
+                    jnp.asarray(val_w[f]), metric_fn=metric_fn))
         return out

@@ -72,13 +72,10 @@ class LinearRegression(PredictionEstimatorBase):
         xd, yd = jnp.asarray(xs), jnp.asarray(y)
         betas = _ridge_sweep(xd, yd, jnp.asarray(train_w), regs)
 
-        @jax.jit
-        def eval_gk(betas, vw):
-            preds = jnp.einsum("nd,gkd->gkn", xd, betas)
-            per_fold = jax.vmap(lambda s, w_: metric_fn(s, yd, w_), in_axes=(0, 0))
-            return jax.vmap(lambda ps: per_fold(ps, vw), in_axes=0)(preds)
+        from .base import eval_linear_sweep
 
-        return np.asarray(eval_gk(betas, jnp.asarray(val_w)))
+        return np.asarray(eval_linear_sweep(
+            xd, yd, betas, jnp.asarray(val_w), metric_fn=metric_fn))
 
 
 class LinearRegressionModel(PredictionModelBase):

@@ -245,13 +245,10 @@ class LogisticRegression(PredictionEstimatorBase):
         for idx, b in parts:
             betas = betas.at[jnp.asarray(idx)].set(b)
 
-        @jax.jit
-        def eval_gk(betas, vw):
-            probs = jax.nn.sigmoid(jnp.einsum("nd,gkd->gkn", xd, betas))
-            per_fold = jax.vmap(lambda s, w_: metric_fn(s, yd, w_), in_axes=(0, 0))
-            return jax.vmap(lambda ps: per_fold(ps, vw), in_axes=0)(probs)
+        from .base import eval_linear_sweep
 
-        return np.asarray(eval_gk(betas, val_w))
+        return np.asarray(eval_linear_sweep(
+            xd, yd, betas, val_w, metric_fn=metric_fn, link="sigmoid"))
 
 
 class LogisticRegressionModel(PredictionModelBase):

@@ -25,13 +25,15 @@ from .logistic import _standardize
 from .prediction import PredictionColumn
 
 
-@partial(jax.jit, static_argnames=("max_iter",))
-def _svc_core(x: jnp.ndarray, y_pm: jnp.ndarray, w: jnp.ndarray, reg: jnp.ndarray,
-              max_iter: int) -> jnp.ndarray:
-    """Squared-hinge descent; x has trailing ones column, y in {-1, +1}."""
+def _svc_body(x: jnp.ndarray, y_pm: jnp.ndarray, w: jnp.ndarray, reg: jnp.ndarray,
+              max_iter: int, has_intercept: bool = True) -> jnp.ndarray:
+    """Squared-hinge descent; y in {-1, +1}.  With ``has_intercept`` the
+    trailing ones column is exempt from L2 (it IS the intercept); without it
+    every column is a real feature and all are regularized."""
     n, d1 = x.shape
     sw = jnp.maximum(w.sum(), 1e-12)
-    reg_mask = jnp.ones(d1).at[-1].set(0.0)
+    reg_mask = (jnp.ones(d1).at[-1].set(0.0) if has_intercept
+                else jnp.ones(d1))
     # Lipschitz bound for the step size: squared hinge curvature <= 2 ||x||^2
     lip = 2.0 * (w[:, None] * x * x).sum() / sw + reg
     lr = 1.0 / jnp.maximum(lip, 1e-6)
@@ -48,6 +50,39 @@ def _svc_core(x: jnp.ndarray, y_pm: jnp.ndarray, w: jnp.ndarray, reg: jnp.ndarra
     beta0 = jnp.zeros(d1, dtype=x.dtype)
     beta, _ = jax.lax.fori_loop(0, max_iter, step, (beta0, beta0))
     return beta
+
+
+_svc_core = partial(jax.jit,
+                    static_argnames=("max_iter", "has_intercept"))(_svc_body)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "has_intercept", "metric_fn"))
+def _svc_cv_program(x, y, y_pm, train_w, val_w, regs, max_iter: int,
+                    has_intercept: bool, metric_fn):
+    """The whole (grid x fold) SVC sweep in one XLA program.
+
+    Standardization happens per fold ON DEVICE with the fold's train weights
+    (matching _fit_arrays), then the grid vmaps over regs and folds vmap over
+    weights; metrics evaluate on the fold margins without leaving the chip.
+    Mirrors the reference's all-fold concurrency (OpCrossValidation.scala:114).
+    """
+
+    def one_fold(w, vw):
+        sw = jnp.maximum(w.sum(), 1e-12)
+        mean = (w[:, None] * x).sum(0) / sw
+        var = (w[:, None] * (x - mean) ** 2).sum(0) / sw
+        std = jnp.where(var > 0, jnp.sqrt(var), 1.0)
+        xs = (x - mean) / std
+        if has_intercept:
+            xs = jnp.concatenate([xs, jnp.ones((x.shape[0], 1), x.dtype)], 1)
+
+        def one_grid(reg):
+            beta = _svc_body(xs, y_pm, w, reg, max_iter, has_intercept)
+            return metric_fn(xs @ beta, y, vw)
+
+        return jax.vmap(one_grid)(regs)
+
+    return jax.vmap(one_fold)(train_w, val_w).T  # (grids, folds)
 
 
 class LinearSVC(PredictionEstimatorBase):
@@ -73,7 +108,8 @@ class LinearSVC(PredictionEstimatorBase):
         y_pm = np.where(y > 0.5, 1.0, -1.0).astype(np.float32)
         beta = np.asarray(_svc_core(
             jnp.asarray(xs.astype(np.float32)), jnp.asarray(y_pm), jnp.asarray(w),
-            jnp.float32(self.reg_param), int(self.max_iter)))
+            jnp.float32(self.reg_param), int(self.max_iter),
+            has_intercept=bool(self.fit_intercept)))
         if self.fit_intercept:
             coef_s, b0 = beta[:-1], beta[-1]
         else:
@@ -81,6 +117,37 @@ class LinearSVC(PredictionEstimatorBase):
         coef = coef_s / std
         intercept = float(b0 - (coef * mean).sum())
         return LinearSVCModel(coef=coef.astype(np.float64), intercept=intercept)
+
+    def cv_sweep(self, x, y, train_w, val_w, grids: List[Dict[str, Any]],
+                 metric_fn):
+        """Fold-vmapped sweep: the whole (grid x fold) program runs on device
+        (per-fold standardization included), one compile keyed on the metric.
+
+        The vectorized program only varies reg_param; grids touching any other
+        param (max_iter, fit_intercept, ...) take the generic per-grid path so
+        every grid key is honored."""
+        if (not self.standardize
+                or any(set(g) - {"reg_param"} for g in grids)):
+            return super().cv_sweep(x, y, train_w, val_w, grids, metric_fn)
+        from ..parallel.mesh import (
+            DATA_AXIS, pad_rows_bucketed_for_mesh, place, place_rows)
+
+        regs = jnp.asarray(
+            [float(g.get("reg_param", self.reg_param)) for g in grids],
+            dtype=jnp.float32)
+        x32 = np.asarray(x, np.float32)
+        y32 = np.asarray(y, np.float32)
+        y_pm = np.where(y32 > 0.5, 1.0, -1.0).astype(np.float32)
+        n0 = x32.shape[0]
+        x_p, y_p, ypm_p, _ = pad_rows_bucketed_for_mesh(x32, y32, y_pm)
+        pad = x_p.shape[0] - n0
+        tw_p = np.pad(np.asarray(train_w, np.float32), [(0, 0), (0, pad)])
+        vw_p = np.pad(np.asarray(val_w, np.float32), [(0, 0), (0, pad)])
+        out = _svc_cv_program(
+            place_rows(x_p), place_rows(y_p), place_rows(ypm_p),
+            place(tw_p, (None, DATA_AXIS)), place(vw_p, (None, DATA_AXIS)),
+            regs, int(self.max_iter), bool(self.fit_intercept), metric_fn)
+        return np.asarray(out)
 
 
 class LinearSVCModel(PredictionModelBase):

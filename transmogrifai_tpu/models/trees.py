@@ -53,6 +53,17 @@ from .prediction import PredictionColumn
 
 DEFAULT_BINS = 64
 
+#: histogram-accumulation row-chunk size (see _grow_tree); module-level so
+#: tests can shrink it to exercise the chunked path on small data
+_HIST_CHUNK = 8192
+
+
+def _hist_dtype():
+    """MXU input dtype for histogram matmuls: bf16 on TPU (one-hots are exact,
+    gradients tolerate the 8-bit mantissa; accumulation stays f32), full f32
+    elsewhere so CPU tests are exact."""
+    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
 
 # ---------------------------------------------------------------------------
 # Host-side quantile binning
@@ -125,6 +136,27 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     m = 2 ** (max_depth + 1) - 1
     B = n_bins + 1  # + missing slot
 
+    # Row-chunk the histogram accumulation: the per-level activation
+    # one_hot(node) x [grad|hess] is (rows, nodes*2K), and under the fold x
+    # tree CV vmap it multiplies by every lane — at 1M rows x 50 trees x 3
+    # folds that is tens of GB and blows HBM.  Chunking turns it into a
+    # lax.scan whose live temporary is (CHUNK, nodes*2K) per lane (a few MB)
+    # while each step stays an MXU matmul of the same total FLOPs.  Padded
+    # rows carry zero grad/hess so every histogram is exact.
+    CHUNK = _HIST_CHUNK
+    if n > 2 * CHUNK:
+        pad = (-n) % CHUNK
+        if pad:
+            binned = jnp.pad(binned, ((0, pad), (0, 0)))
+            grad = jnp.pad(grad, ((0, pad), (0, 0)))
+            hess = jnp.pad(hess, ((0, pad), (0, 0)))
+            n = n + pad
+        n_chunks = n // CHUNK
+        binned_c = binned.reshape(n_chunks, CHUNK, d)
+    else:
+        n_chunks = 0
+        binned_c = None
+
     feat = jnp.zeros(m, dtype=jnp.int32)
     thr_bin = jnp.full(m, n_bins, dtype=jnp.int32)
     miss_left = jnp.zeros(m, dtype=bool)
@@ -132,26 +164,50 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     value = jnp.zeros((m, K), dtype=jnp.float32)
 
     node = jnp.zeros(n, dtype=jnp.int32)  # current node id per row
+    gh = jnp.concatenate([grad, hess], axis=1)                           # (n, 2K)
+    gh_c = gh.reshape(n_chunks, CHUNK, 2 * K) if n_chunks else None
 
     for depth in range(max_depth + 1):
         first = 2 ** depth - 1
         n_nodes = 2 ** depth
         local = node - first  # (n,) in [0, n_nodes) for active rows
 
-        # per-(node, class, feat, bin) grad/hess histograms as MXU matmuls:
-        # scatter-free — TPU lowers segment_sum to slow sorts, but a one-hot
-        # node matrix contracted against per-bin indicator masks is pure
-        # matmul work (one (nodes*2K, n) @ (n, d) product per bin).
-        node_oh = jax.nn.one_hot(local, n_nodes, dtype=jnp.float32)      # (n, nodes)
-        gh = jnp.concatenate([grad, hess], axis=1)                       # (n, 2K)
-        acc = (node_oh[:, :, None] * gh[:, None, :]).reshape(n, n_nodes * 2 * K)
+        # per-(node, class, feat, bin) grad/hess histograms as ONE MXU matmul
+        # per row block: scatter-free — TPU lowers segment_sum to slow sorts,
+        # but contracting the one-hot(node) x [grad|hess] activation against a
+        # joint one-hot over the (feature, bin) axis is pure matmul work of
+        # shape (nodes*2K, rows) @ (rows, d*B).  The bin one-hot depends only
+        # on ``binned`` (not on the fold/tree vmap axes), so XLA shares it
+        # across all CV lanes.  Inputs go through the MXU in ``hdt``
+        # (bfloat16 on TPU — the one-hot is exact in bf16 and gradients
+        # tolerate 8-bit mantissas, cf. LightGBM's quantized histograms) with
+        # float32 accumulation via preferred_element_type.
+        hdt = _hist_dtype()
 
-        def per_bin(b):
-            mask = (binned == b).astype(jnp.float32)                     # (n, d)
-            return jax.lax.dot(acc.T, mask,
-                               precision=jax.lax.Precision.HIGHEST)      # (nodes*2K, d)
+        def _hist_block(local_blk, gh_blk, binned_blk):
+            rows = local_blk.shape[0]
+            node_oh = jax.nn.one_hot(local_blk, n_nodes, dtype=hdt)
+            acc = (node_oh[:, :, None] * gh_blk[:, None, :].astype(hdt)
+                   ).reshape(rows, n_nodes * 2 * K)
+            bin_oh = jax.nn.one_hot(binned_blk, B, dtype=hdt
+                                    ).reshape(rows, d * B)
+            h = jax.lax.dot_general(
+                acc.T, bin_oh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return h.reshape(n_nodes * 2 * K, d, B)
 
-        hist = jnp.moveaxis(jax.lax.map(per_bin, jnp.arange(B)), 0, -1)
+        if n_chunks:
+            local_c = local.reshape(n_chunks, CHUNK)
+
+            def chunk_step(hacc, blk):
+                lb, gb, bb = blk
+                return hacc + _hist_block(lb, gb, bb), None
+
+            hist0 = jnp.zeros((n_nodes * 2 * K, d, B), jnp.float32)
+            hist, _ = jax.lax.scan(chunk_step, hist0,
+                                   (local_c, gh_c, binned_c))
+        else:
+            hist = _hist_block(local, gh, binned)
         hist = hist.reshape(n_nodes, 2 * K, d, B)
         hist_g, hist_h = hist[:, :K], hist[:, K:]                        # (nodes,K,d,B)
 
