@@ -1,0 +1,91 @@
+"""Local (engine-free) scoring latency — the reference's MLeap-serving role.
+
+Builds a realistic fitted pipeline (transmogrify + SanityChecker + selected
+LR + GBT competing), binds ``score_function``, and reports single-record
+p50/p99 latency plus columnar batch throughput.
+
+Prints one JSON line.  Run:  python benchmarks/local_scoring_latency.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench_env  # noqa: F401,E402
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    from transmogrifai_tpu import (
+        BinaryClassificationModelSelector,
+        Dataset,
+        FeatureBuilder,
+        Workflow,
+        transmogrify,
+    )
+    from transmogrifai_tpu.local import score_function
+    from transmogrifai_tpu.models.logistic import LogisticRegression
+    from transmogrifai_tpu.models.trees import GradientBoostedTreesClassifier
+    from transmogrifai_tpu.types import PickList, Real, RealNN
+
+    rng = np.random.default_rng(5)
+    n = 2000
+    cols = {
+        "x1": rng.normal(size=n).tolist(),
+        "x2": rng.normal(size=n).tolist(),
+        "color": rng.choice(["red", "green", "blue"], n).tolist(),
+        "label": (rng.random(n) > 0.5).astype(float).tolist(),
+    }
+    ds = Dataset.from_features(cols, {"x1": Real, "x2": Real,
+                                      "color": PickList, "label": RealNN})
+    label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+    feats = [FeatureBuilder.of("x1", Real).extract_field().as_predictor(),
+             FeatureBuilder.of("x2", Real).extract_field().as_predictor(),
+             FeatureBuilder.of("color", PickList).extract_field().as_predictor()]
+    checked = label.sanity_check(transmogrify(feats))
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, models=[
+            (LogisticRegression(), [{"reg_param": 0.01}]),
+            (GradientBoostedTreesClassifier(),
+             [{"num_rounds": 20, "max_depth": 3}]),
+        ])
+    pred = label.transform_with(sel, checked)
+    model = Workflow().set_input_dataset(ds).set_result_features(label, pred) \
+        .train()
+
+    scorer = score_function(model)
+    records = [{"x1": float(rng.normal()), "x2": float(rng.normal()),
+                "color": str(rng.choice(["red", "green", "blue"]))}
+               for _ in range(500)]
+    scorer(records[0])  # warm
+
+    times = []
+    for r in records:
+        t0 = time.perf_counter()
+        scorer(r)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    p50 = times[len(times) // 2] * 1e3
+    p99 = times[int(len(times) * 0.99)] * 1e3
+
+    t0 = time.perf_counter()
+    scorer.batch(records)
+    batch_rps = len(records) / (time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "local_scoring_p50_ms",
+        "value": round(p50, 3),
+        "unit": "ms/record (single-record score_function)",
+        "p99_ms": round(p99, 3),
+        "batch_records_per_sec": round(batch_rps, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

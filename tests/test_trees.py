@@ -515,3 +515,30 @@ class TestChunkedHistograms:
         assert vals.shape == (3,)
         assert np.isfinite(vals).all()
         assert vals.mean() > 0.7
+
+
+class TestHostPredictParity:
+    def test_host_and_device_margins_match(self):
+        """Small batches predict on host numpy; must match the device path."""
+        rng = np.random.default_rng(31)
+        n, d = 700, 6
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        x[::13, 2] = np.nan
+        y = (x[:, 0] > 0).astype(np.float64)
+        m = GradientBoostedTreesClassifier(
+            num_rounds=8, max_depth=3)._fit_arrays(x, y, np.ones(n, np.float32))
+        # one call above the host threshold (device), one below (host)
+        big = np.asarray(m.predict_column(Column.vector(x)).prob)
+        small = np.asarray(m.predict_column(Column.vector(x[:100])).prob)
+        np.testing.assert_allclose(small, big[:100], rtol=1e-6, atol=1e-9)
+
+    def test_host_path_multiclass(self):
+        rng = np.random.default_rng(32)
+        n, d = 600, 5
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = rng.integers(0, 3, n).astype(np.float64)
+        m = RandomForestClassifier(
+            num_trees=5, max_depth=3)._fit_arrays(x, y, np.ones(n, np.float32))
+        big = np.asarray(m.predict_column(Column.vector(x)).prob)
+        small = np.asarray(m.predict_column(Column.vector(x[:50])).prob)
+        np.testing.assert_allclose(small, big[:50], rtol=1e-6, atol=1e-9)

@@ -599,14 +599,49 @@ class _TreeEnsembleModelBase(PredictionModelBase):
         # mirror the fit path: non-finite (NaN AND +/-inf) -> reserved missing bin
         return jnp.where(jnp.isfinite(xd), binned, self.n_bins).astype(jnp.int32)
 
+    #: batches at or below this row count predict on HOST numpy — a device
+    #: dispatch per record is the wrong trade for ms-grade local serving
+    #: (the reference's MLeap role), especially over remote-device transports
+    _HOST_PREDICT_MAX_ROWS = 512
+
     def _margin(self, x: np.ndarray) -> np.ndarray:
         """(n, K) summed leaf values + base score."""
-        binned = self._bin(x)
-        s = _predict_trees_sum(self._tree_batch(), binned, self.max_depth, self.n_bins)
         # re-normalize here too: serde restores attrs via setattr, bypassing
         # the __init__ reshape (a loaded model may hold a plain float)
         base = np.asarray(self.base_score, dtype=np.float64).reshape(-1)
+        x = np.asarray(x, dtype=np.float32)
+        if x.shape[0] <= self._HOST_PREDICT_MAX_ROWS:
+            return self._margin_host(x) + base[None, :]
+        binned = self._bin(x)
+        s = _predict_trees_sum(self._tree_batch(), binned, self.max_depth, self.n_bins)
         return np.asarray(s, dtype=np.float64) + base[None, :]
+
+    def _margin_host(self, x: np.ndarray) -> np.ndarray:
+        """Pure-numpy traversal (exact parity with the device path)."""
+        n, d = x.shape
+        binned = np.empty((n, d), np.int32)
+        for j in range(d):
+            binned[:, j] = np.searchsorted(self.edges[j], x[:, j], side="right")
+        binned[~np.isfinite(x)] = self.n_bins
+        feat = self.trees["feat"]          # (T, m)
+        thr = self.trees["thr_bin"]
+        miss = self.trees["miss_left"]
+        leaf = self.trees["is_leaf"]
+        value = self.trees["value"]        # (T, m, K)
+        T = feat.shape[0]
+        node = np.zeros((T, n), np.int32)
+        rows = np.arange(n)
+        for _ in range(self.max_depth):
+            nf = np.take_along_axis(feat, node, 1)              # (T, n)
+            nb = binned[rows[None, :], nf]
+            nmiss = np.take_along_axis(miss, node, 1)
+            nthr = np.take_along_axis(thr, node, 1)
+            go_left = np.where(nb == self.n_bins, nmiss, nb <= nthr)
+            child = np.where(go_left, 2 * node + 1, 2 * node + 2)
+            node = np.where(np.take_along_axis(leaf, node, 1), node, child)
+        # (T, n, K) leaf values summed over trees
+        vals = value[np.arange(T)[:, None], node]
+        return vals.sum(axis=0).astype(np.float64)
 
     @property
     def n_trees(self) -> int:
