@@ -56,6 +56,19 @@ def eval_linear_sweep(xd, yd, betas, vw, *, metric_fn, link="identity"):
     return jax.vmap(lambda ps: per_fold(ps, vw), in_axes=0)(scores)
 
 
+@partial(jax.jit, static_argnames=("metric_fn",))
+def eval_softmax_sweep(xd, yd, bs, vw, *, metric_fn):
+    """Metric per (grid, fold) for multiclass sweeps — one cached program.
+
+    bs: (g, k, d, C) per-(grid, fold) softmax weights; the metric receives the
+    (n, C) probability matrix (multiclass payload convention).
+    """
+    logits = jnp.einsum("nd,gkdc->gknc", xd, bs)
+    probs = jax.nn.softmax(logits, axis=-1)
+    per_fold = jax.vmap(lambda p, w_: metric_fn(p, yd, w_), in_axes=(0, 0))
+    return jax.vmap(lambda ps: per_fold(ps, vw), in_axes=0)(probs)
+
+
 class PredictionModelBase(Transformer):
     """Fitted model transformer: scores the feature vector; label input is optional."""
 

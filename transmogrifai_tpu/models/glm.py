@@ -38,13 +38,15 @@ def _family_funcs(family: str):
     raise ValueError(f"Unknown family {family!r}; expected one of {FAMILIES}")
 
 
-@partial(jax.jit, static_argnames=("family", "max_iter"))
-def _glm_core(x: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, reg: jnp.ndarray,
-              family: str, max_iter: int) -> jnp.ndarray:
-    """IRLS with log/logit/identity links; x has trailing ones column."""
+def _glm_body(x: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, reg: jnp.ndarray,
+              family: str, max_iter: int,
+              has_intercept: bool = True) -> jnp.ndarray:
+    """IRLS with log/logit/identity links; with ``has_intercept`` the trailing
+    ones column is exempt from L2 (it IS the intercept)."""
     inv_link, var_fn = _family_funcs(family)
     n, d1 = x.shape
-    reg_mask = jnp.ones(d1).at[-1].set(0.0)
+    reg_mask = (jnp.ones(d1).at[-1].set(0.0) if has_intercept
+                else jnp.ones(d1))
 
     # working-response IRLS: z = eta + (y - mu) * deta/dmu,
     # W = w * (dmu/deta)^2 / V(mu).  binomial(logit) and poisson(log) are canonical
@@ -72,6 +74,28 @@ def _glm_core(x: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, reg: jnp.ndarray,
     return jax.lax.fori_loop(0, max_iter, step, beta0)
 
 
+_glm_core = partial(jax.jit, static_argnames=("family", "max_iter",
+                                              "has_intercept"))(_glm_body)
+
+
+@partial(jax.jit, static_argnames=("family", "max_iter", "has_intercept",
+                                  "metric_fn"))
+def _glm_cv_program(x, y, train_w, val_w, regs, family: str, max_iter: int,
+                    has_intercept: bool, metric_fn):
+    """All (reg grid x fold) fits + metrics of ONE family in one program
+    (the family changes the link functions, hence the trace)."""
+    inv_link, _ = _family_funcs(family)
+
+    def one_fold(w, vw):
+        def one_grid(reg):
+            beta = _glm_body(x, y, w, reg, family, max_iter, has_intercept)
+            return metric_fn(inv_link(x @ beta), y, vw)
+
+        return jax.vmap(one_grid)(regs)
+
+    return jax.vmap(one_fold)(train_w, val_w).T  # (grids, folds)
+
+
 class GeneralizedLinearRegression(PredictionEstimatorBase):
     """GLM regressor (OpGeneralizedLinearRegression capability)."""
 
@@ -93,13 +117,54 @@ class GeneralizedLinearRegression(PredictionEstimatorBase):
         iters = 1 if self.family == "gaussian" else int(self.max_iter)
         beta = np.asarray(_glm_core(
             jnp.asarray(xs), jnp.asarray(y32), jnp.asarray(w),
-            jnp.float32(self.reg_param), str(self.family), iters))
+            jnp.float32(self.reg_param), str(self.family), iters,
+            has_intercept=bool(self.fit_intercept)))
         if self.fit_intercept:
             coef, intercept = beta[:-1], float(beta[-1])
         else:
             coef, intercept = beta, 0.0
         return GLMModel(coef=coef.astype(np.float64), intercept=intercept,
                         family=str(self.family))
+
+    def cv_sweep(self, x, y, train_w, val_w, grids, metric_fn):
+        """Fold-vmapped sweep, one cached program per family in the grid
+        (reference all-fold concurrency, OpCrossValidation.scala:114-134)."""
+        if any(set(g) - {"reg_param", "family"} for g in grids):
+            return super().cv_sweep(x, y, train_w, val_w, grids, metric_fn)
+        from ..parallel.mesh import (
+            DATA_AXIS, pad_rows_bucketed_for_mesh, place, place_rows)
+
+        x32 = np.asarray(x, np.float32)
+        if self.fit_intercept:
+            x32 = np.hstack(
+                [x32, np.ones((x32.shape[0], 1), dtype=np.float32)])
+        y32 = np.asarray(y, np.float32)
+        n0 = x32.shape[0]
+        x_p, y_p, _ = pad_rows_bucketed_for_mesh(x32, y32)
+        pad = x_p.shape[0] - n0
+        tw_p = np.pad(np.asarray(train_w, np.float32), [(0, 0), (0, pad)])
+        vw_p = np.pad(np.asarray(val_w, np.float32), [(0, 0), (0, pad)])
+        xd, yd = place_rows(x_p), place_rows(y_p)
+        twd = place(tw_p, (None, DATA_AXIS))
+        vwd = place(vw_p, (None, DATA_AXIS))
+
+        out = np.zeros((len(grids), train_w.shape[0]))
+        by_family = {}
+        for i, g in enumerate(grids):
+            by_family.setdefault(
+                str(g.get("family", self.family)), []).append(i)
+        for family, idxs in by_family.items():
+            y_fam = yd
+            if family in ("poisson", "gamma"):
+                y_fam = jnp.maximum(yd, 1e-8)
+            iters = 1 if family == "gaussian" else int(self.max_iter)
+            regs = jnp.asarray(
+                [float(grids[i].get("reg_param", self.reg_param))
+                 for i in idxs], dtype=jnp.float32)
+            out[idxs] = np.asarray(_glm_cv_program(
+                xd, y_fam, twd, vwd, regs, family, iters,
+                bool(self.fit_intercept), metric_fn))
+        return out
 
 
 class GLMModel(PredictionModelBase):

@@ -23,12 +23,15 @@ MAX_ITER_DEFAULT = 200
 LR_DEFAULT = 0.3
 
 
-@partial(jax.jit, static_argnames=("n_classes", "max_iter"))
-def _softmax_core(x, y_onehot, w, reg, n_classes: int, max_iter: int):
-    """x (n, d+1) with ones column; returns B (d+1, C)."""
+@partial(jax.jit, static_argnames=("n_classes", "max_iter", "has_intercept"))
+def _softmax_core(x, y_onehot, w, reg, n_classes: int, max_iter: int,
+                  has_intercept: bool = True):
+    """x (n, d[+1]); the trailing ones column (when present) is exempt from
+    L2.  Returns B (d[+1], C)."""
     n, d1 = x.shape
     sw = jnp.maximum(w.sum(), 1e-12)
-    reg_mask = jnp.ones((d1, 1)).at[-1, 0].set(0.0)
+    reg_mask = (jnp.ones((d1, 1)).at[-1, 0].set(0.0) if has_intercept
+                else jnp.ones((d1, 1)))
 
     def loss_grad(b):
         logits = x @ b
@@ -79,7 +82,8 @@ class MultinomialLogisticRegression(PredictionEstimatorBase):
         xs = self._with_ones(x)
         reg = jnp.float32(float(self.reg_param) * (1.0 - float(self.elastic_net)))
         b = np.asarray(_softmax_core(jnp.asarray(xs), jnp.asarray(y_onehot), jnp.asarray(w),
-                                     reg, c, int(self.max_iter)))
+                                     reg, c, int(self.max_iter),
+                                     has_intercept=bool(self.fit_intercept)))
         if self.fit_intercept:
             coef, intercept = b[:-1], b[-1]
         else:
@@ -98,19 +102,18 @@ class MultinomialLogisticRegression(PredictionEstimatorBase):
         yoh = jnp.asarray(y_onehot)
         yd = jnp.asarray(y.astype(np.int32))
 
+        has_icpt = bool(self.fit_intercept)
         fit_fold = jax.vmap(
-            lambda w_, reg: _softmax_core(xd, yoh, w_, reg, c, int(self.max_iter)),
+            lambda w_, reg: _softmax_core(xd, yoh, w_, reg, c,
+                                          int(self.max_iter),
+                                          has_intercept=has_icpt),
             in_axes=(0, None))
         bs = jax.vmap(lambda reg: fit_fold(jnp.asarray(train_w), reg), in_axes=0)(regs)
 
-        @jax.jit
-        def eval_gk(bs, vw):
-            logits = jnp.einsum("nd,gkdc->gknc", xd, bs)
-            probs = jax.nn.softmax(logits, axis=-1)
-            per_fold = jax.vmap(lambda p, w_: metric_fn(p, yd, w_), in_axes=(0, 0))
-            return jax.vmap(lambda ps: per_fold(ps, vw), in_axes=0)(probs)
+        from .base import eval_softmax_sweep
 
-        return np.asarray(eval_gk(bs, jnp.asarray(val_w)))
+        return np.asarray(eval_softmax_sweep(
+            xd, yd, bs, jnp.asarray(val_w), metric_fn=metric_fn))
 
 
 class MultinomialLogisticRegressionModel(PredictionModelBase):
