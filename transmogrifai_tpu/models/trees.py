@@ -152,6 +152,22 @@ def _row_select(binned: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     return (binned.astype(jnp.float32) * oh).sum(axis=1).astype(jnp.int32)
 
 
+def _node_lookup(tbl: jnp.ndarray, node: jnp.ndarray) -> jnp.ndarray:
+    """tbl[node] for a small per-tree node table, as a fused compare-reduce.
+
+    Same rationale as _row_select: a (n,) gather from a (m,) / (m, K) table
+    per vmap lane serializes on TPU; the compare against iota fuses into a
+    VPU streaming reduce (n * m * K multiply-adds, m <= 2^(depth+1)-1).
+    """
+    m = tbl.shape[0]
+    oh = node[:, None] == jnp.arange(m, dtype=node.dtype)[None, :]   # (n, m)
+    if tbl.ndim == 1:
+        if tbl.dtype == jnp.bool_:
+            return (oh & tbl[None, :]).any(axis=1)
+        return jnp.where(oh, tbl[None, :], 0).sum(axis=1)
+    return (oh[:, :, None] * tbl[None, :, :]).sum(axis=1)            # (n, K)
+
+
 def _leaf_value(G, H, reg_lambda, alpha, eta, max_delta_step):
     raw = -_soft_threshold(G, alpha) / (H + reg_lambda + 1e-12)
     clipped = jnp.where(max_delta_step > 0.0,
@@ -361,11 +377,12 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         value = value.at[sl].set(node_val)
 
         # route rows: rows at leaf nodes stay put
-        nf = feat[node]
+        nf = _node_lookup(feat, node)
         nb = _row_select(binned, nf)
-        go_left = jnp.where(nb == n_bins, miss_left[node], nb <= thr_bin[node])
+        go_left = jnp.where(nb == n_bins, _node_lookup(miss_left, node),
+                            nb <= _node_lookup(thr_bin, node))
         child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
-        node = jnp.where(is_leaf[node], node, child)
+        node = jnp.where(_node_lookup(is_leaf, node), node, child)
 
     return Tree(feat, thr_bin, miss_left, is_leaf, value)
 
@@ -377,14 +394,15 @@ def _predict_tree(tree: Tree, binned: jnp.ndarray, max_depth: int, n_bins: int
     node = jnp.zeros(n, dtype=jnp.int32)
 
     def step(_, node):
-        nf = tree.feat[node]
+        nf = _node_lookup(tree.feat, node)
         nb = _row_select(binned, nf)
-        go_left = jnp.where(nb == n_bins, tree.miss_left[node], nb <= tree.thr_bin[node])
+        go_left = jnp.where(nb == n_bins, _node_lookup(tree.miss_left, node),
+                            nb <= _node_lookup(tree.thr_bin, node))
         child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
-        return jnp.where(tree.is_leaf[node], node, child)
+        return jnp.where(_node_lookup(tree.is_leaf, node), node, child)
 
     node = jax.lax.fori_loop(0, max_depth, step, node)
-    return tree.value[node]
+    return _node_lookup(tree.value, node)
 
 
 # ---------------------------------------------------------------------------
