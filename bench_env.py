@@ -1,11 +1,22 @@
 """Shared pre-jax environment setup for every benchmark entry point.
 
-Import this BEFORE anything that imports jax: it points XLA's persistent
-compilation cache at a per-user dir so repeated benchmark runs on a real host
-skip the ~60s of backend compiles.
+Import this BEFORE anything else that imports jax: it points XLA's persistent
+compilation cache at a per-user dir so repeated benchmark runs skip backend
+compiles (which cost tens of seconds per program on remote-compile backends).
+The env var alone is not honored by every jax version, so the config is also
+set explicitly post-import.
 """
 
 import os
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.expanduser("~/.cache/transmogrifai_tpu/xla"))
+_CACHE_DIR = os.path.expanduser("~/.cache/transmogrifai_tpu/xla")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:  # pragma: no cover - older jax without these knobs
+    pass
