@@ -137,6 +137,30 @@ def _standardize(x: np.ndarray, w: np.ndarray):
     return mean.astype(np.float32), std.astype(np.float32)
 
 
+@partial(jax.jit, static_argnames=("has_intercept", "standardize"))
+def _device_prepare(x, n_valid, has_intercept: bool, standardize: bool):
+    """Standardize + ones-append ON DEVICE from the shared raw placement.
+
+    ``x`` is zero-row-padded past ``n_valid``; the explicit row mask keeps the
+    moments exact (matches the host _standardize with unit weights).  Padded
+    rows end up at (-mean/std) but always carry zero fold weights downstream.
+    """
+    n = x.shape[0]
+    if standardize:
+        m = (jnp.arange(n) < n_valid)[:, None].astype(x.dtype)
+        tot = jnp.asarray(n_valid, x.dtype)
+        mean = (x * m).sum(axis=0) / tot  # zero-padded rows contribute 0
+        var = (((x - mean) * m) ** 2).sum(axis=0) / tot
+        std = jnp.sqrt(var)
+        std = jnp.where(std < 1e-12, 1.0, std)
+        xs = (x - mean) / std
+    else:
+        xs = x
+    if has_intercept:
+        xs = jnp.concatenate([xs, jnp.ones((n, 1), x.dtype)], axis=1)
+    return xs
+
+
 class LogisticRegression(PredictionEstimatorBase):
     """Binary logistic regression estimator (OpLogisticRegression capability)."""
 
@@ -210,25 +234,31 @@ class LogisticRegression(PredictionEstimatorBase):
         # a grid must never silently evaluate as all-zero coefficients
         l2_idx = [i for i, (l1, _) in enumerate(l1l2) if l1 <= 0.0]
         en_idx = [i for i, (l1, _) in enumerate(l1l2) if l1 > 0.0]
-        xs, _, _ = self._prepare(x, np.ones(x.shape[0], dtype=np.float32))
         # Rows zero-pad twice over (safe — fold weights pad to zero, so padded
         # rows never enter the weighted IRLS or the validation metric):
         # 1. to a power-of-two bucket, so the sweep compiles per bucket rather
         #    than per dataset size (XLA compile is seconds per shape);
         # 2. to the ambient mesh's data-axis multiple for sharding.
+        # The RAW block places once per selector fit (shared across families
+        # via place_rows_bucketed_cached); standardization runs on device.
         from ..parallel.mesh import (
-            DATA_AXIS, pad_rows_bucketed_for_mesh, place, place_rows)
+            DATA_AXIS, pad_rows_bucketed_for_mesh, place,
+            place_rows_bucketed_cached, place_rows)
 
-        n0 = xs.shape[0]
-        xs_p, y_p, _ = pad_rows_bucketed_for_mesh(xs, np.asarray(y))
-        pad = xs_p.shape[0] - n0
+        x32 = np.asarray(x, np.float32)
+        xd_raw, n0 = place_rows_bucketed_cached(x32)
+        xd = _device_prepare(xd_raw, jnp.int32(n0),
+                             has_intercept=bool(self.fit_intercept),
+                             standardize=bool(self.standardize))
+        y_p, _ = pad_rows_bucketed_for_mesh(np.asarray(y))
+        pad = xd_raw.shape[0] - n0
         train_w_p = np.pad(np.asarray(train_w), [(0, 0), (0, pad)])
         val_w_p = np.pad(np.asarray(val_w), [(0, 0), (0, pad)])
-        xd, yd = place_rows(xs_p), place_rows(y_p)
+        yd = place_rows(y_p)
         train_w = place(train_w_p, (None, DATA_AXIS))
         val_w = place(val_w_p, (None, DATA_AXIS))
 
-        k, d1 = train_w.shape[0], xs_p.shape[1]
+        k, d1 = train_w.shape[0], int(xd.shape[1])
         has_icpt = bool(self.fit_intercept)
         parts = []
         if l2_idx:

@@ -86,36 +86,55 @@ def quantile_bin(x: np.ndarray, n_bins: int = DEFAULT_BINS
     the edges come from a fixed-seed row sample (exact below it).
     """
     n, d = x.shape
-    # column-contiguous copies: per-column quantile/searchsorted on the
-    # row-major layout pays a 128-element stride per access and is ~4x slower
+    edges = quantile_edges(x, n_bins)
+    # column-contiguous copy: per-column searchsorted on the row-major layout
+    # pays a d-element stride per access and is ~4x slower
+    xt = np.ascontiguousarray(x.T)
+    binned_t = np.full((d, n), n_bins, dtype=np.int32)
+    for j in range(d):
+        col = xt[j]
+        # NaNs sort past the last edge; the where() reroutes them to the
+        # reserved missing bin without a masked scatter
+        idx_j = np.searchsorted(edges[j], col, side="right").astype(np.int32)
+        binned_t[j] = np.where(np.isfinite(col), idx_j, n_bins)
+    return np.ascontiguousarray(binned_t.T), edges
+
+
+def quantile_edges(x: np.ndarray, n_bins: int = DEFAULT_BINS) -> np.ndarray:
+    """Per-feature quantile edges (d, n_bins-1) — the sketch half of
+    quantile_bin (sampled above _QUANTILE_SAMPLE rows, fixed seed)."""
+    n, d = x.shape
     if n > _QUANTILE_SAMPLE:
         idx = np.random.default_rng(0).choice(n, _QUANTILE_SAMPLE,
                                               replace=False)
         idx.sort()
         xt_q = np.ascontiguousarray(x[idx].T)  # row-gather first: rows are
     else:                                      # contiguous, columns are not
-        xt_q = None
-    xt = np.ascontiguousarray(x.T)
-    if xt_q is None:
-        xt_q = xt
+        xt_q = np.ascontiguousarray(x.T)
     edges = np.zeros((d, n_bins - 1), dtype=np.float32)
-    binned_t = np.full((d, n), n_bins, dtype=np.int32)
     qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]
     for j in range(d):
-        col = xt[j]
         colq = xt_q[j]
         okq = np.isfinite(colq)
         if okq.sum() == 0:
-            edges[j] = 0.0
             continue
         e = np.quantile(colq[okq], qs).astype(np.float32)
-        e = np.maximum.accumulate(e)  # enforce monotone (ties collapse)
-        edges[j] = e
-        # NaNs sort past the last edge; the where() reroutes them to the
-        # reserved missing bin without a masked scatter
-        idx_j = np.searchsorted(e, col, side="right").astype(np.int32)
-        binned_t[j] = np.where(np.isfinite(col), idx_j, n_bins)
-    return np.ascontiguousarray(binned_t.T), edges
+        edges[j] = np.maximum.accumulate(e)  # enforce monotone (ties collapse)
+    return edges
+
+
+@partial(jax.jit, static_argnames=("n_bins",))
+def _digitize_device(x: jnp.ndarray, edges: jnp.ndarray, n_bins: int
+                     ) -> jnp.ndarray:
+    """Device digitization against fitted edges; non-finite -> missing bin.
+
+    Lets CV sweeps bin from the SHARED raw device placement instead of
+    transferring a second (n, d) int32 block per tree family.
+    """
+    binned = jax.vmap(
+        lambda col, e: jnp.searchsorted(e, col, side="right"),
+        in_axes=(1, 0), out_axes=1)(x, edges)
+    return jnp.where(jnp.isfinite(x), binned, n_bins).astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -726,17 +745,31 @@ class _TreeEstimatorBase(PredictionEstimatorBase):
         return jnp.asarray(binned), edges
 
     def cv_sweep(self, x, y, train_w, val_w, grids: List[Dict[str, Any]], metric_fn):
-        """Fold-vmapped sweep: bins once, dispatches one async program per grid
-        point, fetches all metrics in a single gather at the end (VERDICT r1 #2)."""
-        binned, _ = self._binned(x)
-        tw = jnp.asarray(train_w)
-        vw = jnp.asarray(val_w)
+        """Fold-vmapped sweep: bins ON DEVICE from the shared raw placement,
+        dispatches one async program per grid point, fetches all metrics in a
+        single gather at the end (VERDICT r1 #2)."""
+        from ..parallel.mesh import place_rows_bucketed_cached
+
+        x32 = np.asarray(x, np.float32)
+        xd, n0 = place_rows_bucketed_cached(x32)  # shared across families
+        binned = _digitize_device(
+            xd, jnp.asarray(quantile_edges(x32, int(self.n_bins))),
+            int(self.n_bins))
+        pad = xd.shape[0] - n0
+        y_p = np.pad(np.asarray(y, np.float64), (0, pad))
+        tw = jnp.asarray(np.pad(np.asarray(train_w, np.float32),
+                                [(0, 0), (0, pad)]))
+        vw = jnp.asarray(np.pad(np.asarray(val_w, np.float32),
+                                [(0, 0), (0, pad)]))
         pending = []
         for grid in grids:
             est = self.copy().set_params(**grid)
             # a grid point that changes the binning resolution needs its own codes
-            b = binned if int(est.n_bins) == int(self.n_bins) else est._binned(x)[0]
-            pending.append(est._sweep_folds(b, x, y, tw, vw, metric_fn))
+            b = binned if int(est.n_bins) == int(self.n_bins) else \
+                _digitize_device(
+                    xd, jnp.asarray(quantile_edges(x32, int(est.n_bins))),
+                    int(est.n_bins))
+            pending.append(est._sweep_folds(b, x, y_p, tw, vw, metric_fn))
         return np.stack(jax.device_get(pending))
 
     def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn):
@@ -913,9 +946,16 @@ class _ForestBase(_TreeEstimatorBase):
         return trees, edges
 
     def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn):
+        # bootstrap weights draw at the ORIGINAL row count so the PRNG stream
+        # (and thus every tree) matches _fit_arrays exactly; bucket-padded
+        # rows get zero weight
+        boot = self._boot(int(x.shape[0]))
+        pad = int(binned.shape[0]) - int(x.shape[0])
+        if pad:
+            boot = jnp.pad(jnp.asarray(boot), ((0, 0), (0, pad)))
         return _forest_cv_program(
             binned, jnp.asarray(y, jnp.float32), jnp.asarray(self._y_cols(y)),
-            train_w, val_w, self._masks(x.shape[1]), self._boot(x.shape[0]),
+            train_w, val_w, self._masks(x.shape[1]), boot,
             int(self.max_depth), int(self.n_bins), jnp.float32(self.reg_lambda),
             jnp.float32(self.min_child_weight), classification=self.classification,
             metric_fn=metric_fn,

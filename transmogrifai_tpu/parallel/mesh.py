@@ -193,3 +193,34 @@ def pad_rows_bucketed_for_mesh(*arrays, n: Optional[int] = None):
     n_valid = int(arrays[0].shape[0] if n is None else n)
     bucketed = pad_rows_to_bucket(n_valid, *arrays)
     return pad_rows_for_mesh(*bucketed)[:-1] + (n_valid,)
+
+
+# -- shared device placement cache -------------------------------------------
+# One selector fit runs several model families over the SAME feature block;
+# without sharing, every family pays its own host->device transfer of the
+# padded (n, d) matrix (tens of seconds each on slow transports).  The cache
+# keys on the SOURCE array's identity — families receive the same numpy
+# object from the validator — and evicts when the source is garbage-collected.
+_PLACED_ROWS_CACHE: dict = {}
+
+
+def place_rows_bucketed_cached(arr: np.ndarray,
+                               mesh: Optional[Mesh] = None):
+    """(device_array, n_valid) for bucket+mesh padded ``arr``, cached on the
+    source array object so repeated placements of the same block are free."""
+    import weakref
+
+    mesh = mesh if mesh is not None else current_mesh()
+    arr = np.asarray(arr)
+    key = (id(arr), arr.shape, str(arr.dtype), id(mesh))
+    hit = _PLACED_ROWS_CACHE.get(key)
+    if hit is not None and hit[0]() is not None:
+        return hit[1], hit[2]
+    padded, n_valid = pad_rows_bucketed_for_mesh(arr)[0], arr.shape[0]
+    placed = place_rows(padded, mesh)
+    try:
+        ref = weakref.ref(arr, lambda _ref, _k=key: _PLACED_ROWS_CACHE.pop(_k, None))
+    except TypeError:  # pragma: no cover - non-weakrefable input
+        ref = lambda: arr  # noqa: E731 - keep alive, never evict
+    _PLACED_ROWS_CACHE[key] = (ref, placed, n_valid)
+    return placed, n_valid
