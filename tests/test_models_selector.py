@@ -382,3 +382,54 @@ class TestNoInterceptSweepParity:
             ref = float(M.METRICS_BINARY["auPR"](
                 jnp.asarray(s, jnp.float32), jnp.asarray(y), jnp.asarray(vw[f])))
             np.testing.assert_allclose(swept[0, f], ref, atol=2e-3)
+
+
+class TestHoldoutEvaluation:
+    def test_reserved_fraction_reports_holdout_metrics(self):
+        """DataSplitter(reserve_test_fraction) must exclude the holdout from
+        training AND surface its metrics (reference test-set evaluation)."""
+        import numpy as np
+
+        from transmogrifai_tpu.data.dataset import Column
+        from transmogrifai_tpu import (BinaryClassificationModelSelector,
+                                       Dataset, FeatureBuilder)
+        from transmogrifai_tpu.models.logistic import LogisticRegression
+        from transmogrifai_tpu.models.tuning import DataSplitter
+        from transmogrifai_tpu.types import OPVector, RealNN
+        from transmogrifai_tpu.utils.vector_metadata import (
+            VectorColumnMetadata, VectorMetadata)
+
+        rng = np.random.default_rng(23)
+        n, d = 600, 5
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = (rng.random(n) < 1 / (1 + np.exp(-1.5 * x[:, 0]))).astype(float)
+        meta = VectorMetadata(
+            "v", [VectorColumnMetadata(f"f{j}", "Real") for j in range(d)]
+        ).reindexed()
+        ds = Dataset({"label": Column.from_values(RealNN, list(y)),
+                      "v": Column.vector(x, meta)})
+        label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+        vec = FeatureBuilder.of("v", OPVector).extract_field().as_predictor()
+
+        splitter = DataSplitter(reserve_test_fraction=0.25, seed=7)
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, splitter=splitter,
+            models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+        label.transform_with(sel, vec)
+        model = sel.fit(ds)
+        s = model.summary
+
+        assert s.data_prep.details["holdoutRows"] > 0
+        assert s.holdout_evaluation, "holdout metrics must be reported"
+        assert 0.5 < s.holdout_evaluation["auPR"] <= 1.0
+        # holdout is a quarter of rows, genuinely excluded from training
+        assert abs(s.data_prep.details["holdoutRows"] / n - 0.25) < 0.07
+
+    def test_no_reserved_fraction_keeps_holdout_empty(self):
+        from transmogrifai_tpu.models.tuning import DataSplitter
+        import numpy as np
+
+        sp = DataSplitter()
+        w, summary = sp.prepare(np.ones(50))
+        assert sp.holdout_mask is None
+        assert (w == 1.0).all()

@@ -156,6 +156,19 @@ class ModelSelector(PredictionEstimatorBase):
             except Exception:
                 pass
 
+        # holdout metrics on rows the splitter reserved out of training
+        # (reference test-set evaluation)
+        holdout_eval: Dict[str, float] = {}
+        hmask = getattr(self.splitter, "holdout_mask", None)
+        if hmask is not None and hmask.any():
+            hw = hmask.astype(np.float64)
+            for ev in ([self.validator.evaluator] + self.train_evaluators):
+                try:
+                    holdout_eval.update(ev.evaluate_arrays(
+                        y.astype(np.float64), pred_col, w=hw))
+                except Exception:
+                    pass
+
         summary = ModelSelectorSummary(
             validation_type=type(self.validator).__name__,
             validation_results=result.evaluations,
@@ -166,6 +179,7 @@ class ModelSelector(PredictionEstimatorBase):
             larger_is_better=self.validator.evaluator.larger_is_better,
             data_prep=prep_summary,
             train_evaluation=train_eval,
+            holdout_evaluation=holdout_eval,
             failed_models=list(getattr(result, "failed_models", [])),
         )
         return SelectedModel(model=best_model, summary=summary,

@@ -32,14 +32,32 @@ class PrepSummary:
 
 
 class DataSplitter:
-    """Reserve a test fraction; no label-based prep (regression default)."""
+    """Reserve a test fraction; no label-based prep (regression default).
+
+    With ``reserve_test_fraction`` > 0 a random holdout gets zero training
+    weight — excluded from CV folds AND the final best-model fit — and the
+    selector reports its metrics as ``holdout_evaluation`` (the reference's
+    test-set evaluation, ModelSelector.scala holdout path).  The mask is kept
+    on the splitter (``holdout_mask``) for the selector to read.
+    """
 
     def __init__(self, reserve_test_fraction: float = 0.0, seed: int = 42):
         self.reserve_test_fraction = reserve_test_fraction
         self.seed = seed
+        self.holdout_mask: Optional[np.ndarray] = None
 
     def prepare(self, y: np.ndarray) -> Tuple[np.ndarray, PrepSummary]:
         """Per-row training weights (1 = keep at weight 1)."""
+        f = float(self.reserve_test_fraction)
+        if f > 0.0:
+            rng = np.random.default_rng(self.seed)
+            self.holdout_mask = rng.random(len(y)) < f
+            w = np.where(self.holdout_mask, 0.0, 1.0).astype(np.float32)
+            return w, PrepSummary(
+                "DataSplitter",
+                {"reserveTestFraction": f,
+                 "holdoutRows": int(self.holdout_mask.sum())})
+        self.holdout_mask = None
         return np.ones_like(y, dtype=np.float32), PrepSummary("DataSplitter")
 
 
