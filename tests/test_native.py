@@ -83,3 +83,36 @@ class TestVectorizerIntegration:
 
 def self_docs():
     return [["a", "b"], ["c"], []]
+
+
+class TestFusedTokenizeHash:
+    def test_matches_python_path_ascii_and_unicode(self):
+        """The fused native kernel must be bit-identical to
+        tokenize() + hash_count_block(), including unicode fallback rows."""
+        from transmogrifai_tpu import native
+        from transmogrifai_tpu.utils.text import tokenize
+
+        texts = ["Hello World 42", "", None, "the-quick-brown fox!!",
+                 "héllo wörld straße", "日本語 text 123", "a b c",
+                 "UPPER lower MiXeD 7x7", "x" * 5000 + " tail"]
+        width = 32
+        want = native.hash_count_block(
+            [tokenize(t) for t in ["" if t is None else t for t in texts]],
+            width)
+        got, counts = native.tokenize_hash_count(texts, width)
+        np.testing.assert_array_equal(got, want)
+        for i, t in enumerate(texts):
+            assert counts[i] == len(tokenize("" if t is None else t))
+
+    def test_native_path_when_available(self):
+        from transmogrifai_tpu import native
+
+        if not native.available():
+            import pytest
+            pytest.skip("no toolchain")
+        texts = [f"token{i} alpha beta {i}" for i in range(3000)]
+        got, counts = native.tokenize_hash_count(texts, 16)
+        assert got.shape == (3000, 16)
+        # "token0" splits into alpha+digit runs -> 5 tokens per row
+        assert (counts == 5).all()
+        assert (got.sum(axis=1) == 5).all()

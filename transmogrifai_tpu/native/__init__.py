@@ -60,6 +60,12 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
             ctypes.c_uint32, ctypes.c_int32, ctypes.POINTER(ctypes.c_float)]
         lib.hash_count_block.restype = None
+        lib.tokenize_hash_count.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_uint32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.tokenize_hash_count.restype = None
         return lib
     except Exception:
         return None
@@ -147,3 +153,49 @@ def hash_count_block(docs: Sequence[Optional[Sequence[str]]], width: int,
         len(tokens), width, seed, 1 if binary else 0,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
     return out
+
+
+def tokenize_hash_count(texts: Sequence[Optional[str]], width: int,
+                        lowercase: bool = True, min_token_length: int = 1,
+                        binary: bool = False, seed: int = 42
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Fused Text -> hashed-count block: tokenize + murmur3 + bucket count in
+    one native pass with NO token strings materialized (the SmartText /
+    HashingTF hot path at table scale).
+
+    Returns ((n, width) float32 block, (n,) int64 token counts).  Rows the
+    native tokenizer cannot handle exactly (non-ASCII bytes, >4KB tokens) are
+    flagged by the kernel and re-done through the exact Unicode Python
+    tokenizer, so results are identical to tokenize() + hash_count_block().
+    """
+    from ..utils.text import tokenize
+
+    n = len(texts)
+    vals = ["" if t is None else str(t) for t in texts]
+
+    def _python_row(v):
+        return tokenize(v, to_lowercase=lowercase,
+                        min_token_length=min_token_length)
+
+    lib = _lib(force=n >= _BUILD_THRESHOLD)
+    if lib is None:
+        docs = [_python_row(v) for v in vals]
+        counts = np.array([len(d) for d in docs], np.int64)
+        return hash_count_block(docs, width, binary=binary, seed=seed), counts
+    buf, offsets = _pack(vals)
+    out = np.zeros((n, width), np.float32)
+    counts = np.zeros(n, np.int64)
+    lib.tokenize_hash_count(
+        buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        width, seed, 1 if lowercase else 0, int(min_token_length),
+        1 if binary else 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    for i in np.nonzero(counts < 0)[0]:
+        out[i] = 0.0
+        toks = _python_row(vals[i])
+        counts[i] = len(toks)
+        if toks:
+            out[i:i + 1] = hash_count_block([toks], width, binary=binary,
+                                            seed=seed)
+    return out, counts

@@ -87,4 +87,72 @@ void hash_count_block(const char* buf, const int64_t* offsets,
   }
 }
 
+// Fused tokenizer + hashing trick: ASCII letter runs / digit runs (the
+// [^\W\d_]+|\d+ analyzer on ASCII input), lowercased, hashed with murmur3 into
+// `width` buckets — no token strings ever materialize.  Rows containing any
+// byte >= 0x80 are SKIPPED and flagged with n_tokens_out[row] = -1 so the
+// caller re-runs them through the exact Unicode Python path; pure-ASCII rows
+// are bit-identical to tokenize() + hash_count_block().
+void tokenize_hash_count(const char* buf, const int64_t* offsets, int64_t n_rows,
+                         int32_t width, uint32_t seed, int32_t lowercase,
+                         int32_t min_len, int32_t binary, float* out,
+                         int64_t* n_tokens_out) {
+  char tok[4096];
+  for (int64_t r = 0; r < n_rows; r++) {
+    const char* p = buf + offsets[r];
+    const int64_t len = offsets[r + 1] - offsets[r];
+    bool ascii = true;
+    for (int64_t i = 0; i < len; i++) {
+      if (static_cast<unsigned char>(p[i]) >= 0x80u) { ascii = false; break; }
+    }
+    if (!ascii) {
+      n_tokens_out[r] = -1;
+      continue;
+    }
+    float* row = out + r * static_cast<int64_t>(width);
+    int64_t count = 0;
+    int64_t i = 0;
+    while (i < len) {
+      unsigned char c = static_cast<unsigned char>(p[i]);
+      const bool alpha = (c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z');
+      const bool digit = (c >= '0' && c <= '9');
+      if (!alpha && !digit) { i++; continue; }
+      int64_t t = 0;
+      bool overflow = false;
+      if (alpha) {
+        while (i < len) {
+          c = static_cast<unsigned char>(p[i]);
+          const bool up = (c >= 'A' && c <= 'Z');
+          if (!up && !(c >= 'a' && c <= 'z')) break;
+          if (t == static_cast<int64_t>(sizeof(tok))) { overflow = true; break; }
+          tok[t++] = (lowercase && up) ? static_cast<char>(c + 32) : static_cast<char>(c);
+          i++;
+        }
+      } else {
+        while (i < len) {
+          c = static_cast<unsigned char>(p[i]);
+          if (!(c >= '0' && c <= '9')) break;
+          if (t == static_cast<int64_t>(sizeof(tok))) { overflow = true; break; }
+          tok[t++] = static_cast<char>(c);
+          i++;
+        }
+      }
+      if (overflow) {  // pathological >4KB token: exact path handles the row
+        count = -1;
+        break;
+      }
+      if (t < min_len) continue;
+      count++;
+      const uint32_t h = murmur3_32(tok, t, seed);
+      float* cell = row + (h % static_cast<uint32_t>(width));
+      if (binary) {
+        *cell = 1.0f;
+      } else {
+        *cell += 1.0f;
+      }
+    }
+    n_tokens_out[r] = count;  // -1 flags a fallback row
+  }
+}
+
 }  // extern "C"

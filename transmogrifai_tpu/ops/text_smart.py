@@ -156,7 +156,10 @@ class SmartTextVectorizerModel(Transformer):
                                                    self.track_nulls))
             else:
                 width = self.num_hashes
-                block = hash_count_block([tokenize(v) for v in col.data], width)
+                # fused native tokenize+hash — no token strings materialize
+                from ..native import tokenize_hash_count
+
+                block, _ = tokenize_hash_count(list(col.data), width)
                 for b in range(width):
                     meta_cols.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
                                                           descriptor_value=f"hash_{b}"))
@@ -253,8 +256,9 @@ class SmartTextMapVectorizerModel(Transformer):
                     meta_cols.extend(_categorical_meta(f, spec["vocab"], grouping,
                                                        self.track_nulls))
                 else:
-                    block = hash_count_block(
-                        [tokenize(v) for v in values], self.num_hashes)
+                    from ..native import tokenize_hash_count
+
+                    block, _ = tokenize_hash_count(values, self.num_hashes)
                     for b in range(self.num_hashes):
                         meta_cols.append(VectorColumnMetadata(
                             f.name, tname, grouping=grouping,
