@@ -197,6 +197,7 @@ class TestCliGen:
         assert kind.value == "binary"
         assert os.path.exists(os.path.join(out, "main.py"))
         assert os.path.exists(os.path.join(out, "README.md"))
+        assert os.path.exists(os.path.join(out, "test_project.py"))
         # the generated project must actually train end-to-end
         env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
         r = subprocess.run(
