@@ -233,3 +233,61 @@ class TestTransmogrify:
         assert col.meta.size == col.data.shape[1]
         parents = {c.parent_feature for c in col.meta.columns}
         assert parents == {"age", "fare", "sex", "pclass", "alone"}
+
+
+class TestTransmogrifyTypeCoverage:
+    def test_every_scalar_and_map_type_vectorizes(self):
+        """transmogrify must have a default for EVERY vectorizable feature
+        type — a new type without a family fails here, not in user code."""
+        import transmogrifai_tpu.types as TT
+        from transmogrifai_tpu import Workflow, transmogrify
+        from transmogrifai_tpu.types.base import FeatureType
+
+        WED_MS = 1528887600000
+        samples = {
+            "Real": 1.5, "RealNN": 1.5, "Binary": True, "Integral": 3,
+            "Percent": 0.4, "Currency": 9.5, "Date": WED_MS,
+            "DateTime": WED_MS, "Text": "hello world", "TextArea": "long txt",
+            "PickList": "red", "ComboBox": "opt", "ID": "u-1",
+            "Email": "a@b.com", "URL": "https://x.io", "Phone": "+14155552671",
+            "Base64": "aGVsbG8=", "Country": "France", "State": "CA",
+            "City": "Paris", "Street": "1 Main St", "PostalCode": "94105",
+            "TextList": ["a", "b"], "DateList": [WED_MS],
+            "DateTimeList": [WED_MS], "MultiPickList": {"x", "y"},
+            "Geolocation": [37.7, -122.4, 5.0],
+            # maps
+            "TextMap": {"k": "v"}, "TextAreaMap": {"k": "long"},
+            "RealMap": {"k": 1.0}, "IntegralMap": {"k": 2},
+            "CurrencyMap": {"k": 3.0}, "PercentMap": {"k": 0.5},
+            "BinaryMap": {"k": True}, "PickListMap": {"k": "red"},
+            "ComboBoxMap": {"k": "o"}, "IDMap": {"k": "u"},
+            "EmailMap": {"k": "a@b.com"}, "URLMap": {"k": "https://x.io"},
+            "PhoneMap": {"k": "+14155552671"}, "Base64Map": {"k": "aGVsbG8="},
+            "CountryMap": {"k": "France"}, "StateMap": {"k": "CA"},
+            "CityMap": {"k": "Paris"}, "StreetMap": {"k": "1 Main"},
+            "PostalCodeMap": {"k": "94105"}, "DateMap": {"k": WED_MS},
+            "DateTimeMap": {"k": WED_MS}, "MultiPickListMap": {"k": ["x"]},
+            "GeolocationMap": {"k": [37.7, -122.4, 5.0]},
+        }
+        abstract = {"OPMap", "OPList", "OPSet", "OPNumeric", "FeatureType",
+                    "OPCollection", "NonNullable", "SomeValue",
+                    "OPVector", "Prediction"}  # vector/prediction pass through
+        missing_samples = []
+        for name in sorted(dir(TT)):
+            cls = getattr(TT, name)
+            if not (isinstance(cls, type) and issubclass(cls, FeatureType)):
+                continue
+            if name in abstract:
+                continue
+            if name not in samples:
+                missing_samples.append(name)
+                continue
+            val = samples[name]
+            f = FeatureBuilder.of("c", cls).extract_field().as_predictor()
+            rows = [val, val] if name == "RealNN" else [val, None]
+            ds = Dataset.from_features({"c": rows}, {"c": cls})
+            v = transmogrify([f])
+            model = Workflow().set_input_dataset(ds).set_result_features(v).train()
+            out = model.score(ds)[v.name]
+            assert out.data.shape[0] == 2, name
+        assert not missing_samples, f"add samples for: {missing_samples}"

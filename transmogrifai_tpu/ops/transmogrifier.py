@@ -70,6 +70,10 @@ def _family(ftype: Type[FeatureType]) -> str:
         return "multipicklist"
     if issubclass(ftype, Geolocation):
         return "geolocation"
+    from ..types import DateList
+
+    if issubclass(ftype, DateList):
+        return "date_list"
     if issubclass(ftype, TextList):
         return "text_list"
     if issubclass(ftype, OPVector):
@@ -111,6 +115,10 @@ def transmogrify(features: Sequence[Feature], label: Feature | None = None,
             stage = MultiPickListVectorizer()
         elif family == "geolocation":
             stage = GeolocationVectorizer()
+        elif family == "date_list":
+            from .dates import DateListVectorizer
+
+            stage = DateListVectorizer()
         elif family == "text_list":
             stage = TextListHashingVectorizer()
         elif family == "vector":
