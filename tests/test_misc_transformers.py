@@ -273,3 +273,24 @@ class TestRandomParamBuilder:
             RandomParamBuilder().exponential("a", 0.0, 1.0)
         with pytest.raises(ValueError, match="no param"):
             RandomParamBuilder().build(3)
+
+
+class TestDateListReferenceDateSnapshot:
+    def test_default_reference_date_fixed_at_construction(self):
+        """None snapshots now() ONCE at construction (reference
+        TransmogrifierDefaults.ReferenceDate semantics) so transforms are
+        deterministic and serde carries the date into serving."""
+        import time
+
+        t = DateListVectorizer(pivot="SinceLast")
+        assert t.reference_date_ms is not None
+        ref = t.reference_date_ms
+        assert abs(ref - time.time() * 1000) < 60_000
+        f = _feat("d", DateList)
+        f.transform_with(t)
+        ds = Dataset.from_features({"d": [[WED_MS]]}, {"d": DateList})
+        v1 = t.transform(ds)[t.output_name].data.copy()
+        time.sleep(0.05)
+        v2 = t.transform(ds)[t.output_name].data
+        np.testing.assert_array_equal(v1, v2)
+        assert t.copy().reference_date_ms == ref

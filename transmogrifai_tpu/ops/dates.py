@@ -164,8 +164,22 @@ class DateListVectorizer(SequenceTransformer):
 
     pivot = Param(default="SinceFirst", validator=lambda v: v in DATE_LIST_PIVOTS)
     fill_value = Param(default=0.0, doc="SinceFirst/SinceLast value for empty lists")
-    reference_date_ms = Param(default=None, doc="epoch millis; None = now at transform")
+    reference_date_ms = Param(
+        default=None,
+        doc="epoch millis; None snapshots 'now' ONCE at stage construction")
     track_nulls = Param(default=True)
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        # Resolve the reference date at CONSTRUCTION and store it in the param
+        # so transforms are deterministic and serde carries it into serving —
+        # the reference's TransmogrifierDefaults.ReferenceDate is likewise a
+        # single now() snapshot baked into the fitted pipeline
+        # (TransmogrifierDefaults.scala:58).  Resolving at transform time
+        # would shift every SinceFirst/SinceLast value between train and
+        # score runs.
+        if self.reference_date_ms is None:
+            self.reference_date_ms = int(_time.time() * 1000)
 
     def _since_block(self, lists, ref_ms: int, first: bool):
         out = np.full(len(lists), float(self.fill_value))
@@ -194,8 +208,6 @@ class DateListVectorizer(SequenceTransformer):
 
     def transform_columns(self, cols: List[Column], dataset):
         ref_ms = self.reference_date_ms
-        if ref_ms is None:
-            ref_ms = int(_time.time() * 1000)
         blocks: List[np.ndarray] = []
         meta_cols: List[VectorColumnMetadata] = []
         for f, col in zip(self.inputs, cols):

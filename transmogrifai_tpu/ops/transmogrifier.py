@@ -12,6 +12,7 @@ from typing import Dict, List, Sequence, Type
 
 from ..features.feature import Feature
 from ..types import (
+    DateList,
     Base64,
     Binary,
     City,
@@ -38,7 +39,7 @@ from ..types import (
     URL,
 )
 from .combiner import VectorsCombiner
-from .dates import DateToUnitCircleVectorizer
+from .dates import DateListVectorizer, DateToUnitCircleVectorizer
 from .geo import GeolocationVectorizer
 from .numeric import BinaryVectorizer, NumericVectorizer, RealNNVectorizer
 from .onehot import MultiPickListVectorizer, OneHotVectorizer
@@ -70,8 +71,6 @@ def _family(ftype: Type[FeatureType]) -> str:
         return "multipicklist"
     if issubclass(ftype, Geolocation):
         return "geolocation"
-    from ..types import DateList
-
     if issubclass(ftype, DateList):
         return "date_list"
     if issubclass(ftype, TextList):
@@ -116,8 +115,6 @@ def transmogrify(features: Sequence[Feature], label: Feature | None = None,
         elif family == "geolocation":
             stage = GeolocationVectorizer()
         elif family == "date_list":
-            from .dates import DateListVectorizer
-
             stage = DateListVectorizer()
         elif family == "text_list":
             stage = TextListHashingVectorizer()
