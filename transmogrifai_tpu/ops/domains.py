@@ -17,12 +17,10 @@ import binascii
 import re
 from typing import List, Optional
 
-import numpy as np
-
-from ..data.dataset import Column, Dataset
+from ..data.dataset import Column
 from ..stages.base import Param, UnaryTransformer
 from ..types import Base64 as B64Type
-from ..types import Binary, Email, Phone, PickList, Text, URL
+from ..types import Binary, Email, Phone, PickList, URL
 
 _EMAIL_RE = re.compile(
     r"^[A-Za-z0-9.!#$%&'*+/=?^_`{|}~-]+@[A-Za-z0-9](?:[A-Za-z0-9-]{0,61}[A-Za-z0-9])?"

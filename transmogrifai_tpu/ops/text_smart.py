@@ -18,8 +18,6 @@ from ..data.dataset import Column
 from ..stages.base import Param, SequenceEstimator, Transformer
 from ..types import OPVector, Text
 from ..types.maps import _StringMap
-from ..native import hash_count_block
-from ..utils.text import tokenize
 from ..utils.vector_metadata import (
     NULL_INDICATOR,
     OTHER_INDICATOR,
