@@ -5,9 +5,9 @@ region), ValidEmailTransformer.scala, EmailToPickListMapTransformer, URL handlin
 dsl/RichTextFeature.scala, MimeTypeDetector.scala (Tika magic-byte sniffing for Base64).
 
 All host-side string analysis; outputs are Binary/PickList columns that vectorize
-downstream.  The phone validity table is a reduced libphonenumber: country calling
-codes + national number length ranges for the major regions (documented divergence:
-full per-region dial plans are out of scope).
+downstream.  Phone validity delegates to the region-metadata engine in
+``ops/phone.py`` (calling codes, per-region length tables, NANPA patterns,
+trunk prefixes — the full four-transformer surface lives there).
 """
 
 from __future__ import annotations
