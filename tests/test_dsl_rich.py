@@ -198,3 +198,16 @@ class TestScaleCombine:
 
 def _over_two(v):
     return v is not None and v > 2.0
+
+
+class TestLanguageDetection:
+    def test_detect_languages_map(self):
+        f, ds = _feat("t", Text, [
+            "the quick brown fox jumps over the lazy dog and it was good",
+            "el perro y el gato son los animales de la casa", None])
+        col = _run(f.detect_languages(), ds)
+        rows = col.to_values()
+        assert rows[0] and max(rows[0], key=rows[0].get) == "en"
+        assert rows[1] and max(rows[1], key=rows[1].get) == "es"
+        assert rows[2] in ({}, None)
+        assert abs(sum(rows[0].values()) - 1.0) < 1e-9

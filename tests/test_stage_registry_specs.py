@@ -246,6 +246,7 @@ CASES = {
         Real, {"scaling_type": "linear", "slope": 2.0, "intercept": 1.0}),
     "TextTokenizer": unary(Text),
     "TextLenTransformer": unary(Text),
+    "LanguageDetector": unary(Text),
     "NameEntityRecognizer": unary(Text),
     "EmailToPickList": unary(Email),
     "ValidEmailTransformer": unary(Email),

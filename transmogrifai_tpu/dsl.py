@@ -261,6 +261,13 @@ def _smart_vectorize(self: Feature, others: Sequence[Feature] = (),
     return self.transform_with(SmartTextVectorizer(**kw), *others)
 
 
+def _detect_languages(self: Feature) -> Feature:
+    """Text -> RealMap of language confidences (RichTextFeature.detectLanguages)."""
+    from .ops.text import LanguageDetector
+
+    return self.transform_with(LanguageDetector())
+
+
 def _is_substring(self: Feature, other: Feature) -> Feature:
     """self a substring of other -> Binary (RichTextFeature.isSubstring)."""
     from .ops.misc import SubstringTransformer
@@ -412,6 +419,7 @@ Feature.to_time_period = _to_time_period
 Feature.to_ngram_similarity = _to_ngram_similarity
 Feature.jaccard_similarity = _jaccard_similarity
 Feature.smart_vectorize = _smart_vectorize
+Feature.detect_languages = _detect_languages
 Feature.is_substring = _is_substring
 Feature.parse_phone = _parse_phone
 Feature.is_valid_phone = _is_valid_phone

@@ -22,7 +22,7 @@ from ..stages.base import (
     UnaryEstimator,
     UnaryTransformer,
 )
-from ..types import Integral, MultiPickList, OPVector, RealNN, Text, TextList
+from ..types import Integral, MultiPickList, OPVector, RealMap, RealNN, Text, TextList
 from ..native import hash_count_block
 from ..utils.text import (
     char_ngrams,
@@ -103,6 +103,25 @@ class TextLenTransformer(UnaryTransformer):
     def transform_columns(self, cols: List[Column], dataset) -> Column:
         return Column.from_values(
             Integral, [len(v) if v else 0 for v in cols[0].data])
+
+
+class LanguageDetector(UnaryTransformer):
+    """Text -> RealMap of language -> confidence
+    (reference RichTextFeature.detectLanguages, LangDetector capability)."""
+
+    input_types = (Text,)
+    output_type = RealMap
+
+    def transform_columns(self, cols: List[Column], dataset) -> Column:
+        from ..utils.text import detect_language_scores
+
+        return Column.from_values(
+            RealMap, [detect_language_scores(v) for v in cols[0].data])
+
+    def transform_values(self, values):
+        from ..utils.text import detect_language_scores
+
+        return detect_language_scores(values[0])
 
 
 def _hash_block(col: Column, width: int, binary: bool) -> np.ndarray:

@@ -92,6 +92,22 @@ def detect_language(text: Optional[str]) -> str:
     return best
 
 
+def detect_language_scores(text: Optional[str]) -> dict:
+    """Per-language confidence map (reference LanguageDetector.detectLanguages
+    returns language -> confidence).  Scores are stop-word-overlap fractions
+    normalized to sum to 1 over languages with any signal; empty when none."""
+    if not text:
+        return {}
+    tokens = set(_TOKEN_RE.findall(text.lower()))
+    if not tokens:
+        return {}
+    raw = {lang: len(tokens & stops) for lang, stops in _LANG_STOPWORDS.items()}
+    total = sum(raw.values())
+    if total == 0:
+        return {}
+    return {lang: c / total for lang, c in raw.items() if c > 0}
+
+
 def stop_words_for(language: str) -> frozenset:
     return _LANG_STOPWORDS.get(language, STOP_WORDS)
 
