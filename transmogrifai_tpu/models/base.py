@@ -29,6 +29,33 @@ def softmax_probs(raw: np.ndarray) -> np.ndarray:
     return e / e.sum(axis=1, keepdims=True)
 
 
+def sweep_placements(x32: np.ndarray, extras, train_w, val_w):
+    """Shared device placement for a fold-vmapped CV sweep.
+
+    Places the raw feature block ONCE per selector fit (cached on the source
+    array identity — every family receives the same object from the
+    validator), bucket/mesh-pads the row-aligned ``extras`` (labels, one-hots,
+    sign targets, ...), and pads+places the fold weight matrices.
+
+    Returns (xd, [extra_devs...], tw_dev, vw_dev, n_valid).
+    """
+    from ..parallel.mesh import (
+        DATA_AXIS, pad_rows_bucketed_for_mesh, place,
+        place_rows_bucketed_cached, place_rows)
+
+    xd, n0 = place_rows_bucketed_cached(x32)
+    pad = int(xd.shape[0]) - n0
+    extra_devs = [
+        place_rows(pad_rows_bucketed_for_mesh(np.asarray(e), n=n0)[0])
+        for e in extras
+    ]
+    tw = place(np.pad(np.asarray(train_w, np.float32), [(0, 0), (0, pad)]),
+               (None, DATA_AXIS))
+    vw = place(np.pad(np.asarray(val_w, np.float32), [(0, 0), (0, pad)]),
+               (None, DATA_AXIS))
+    return xd, extra_devs, tw, vw, n0
+
+
 @partial(jax.jit, static_argnames=("metric_fn",))
 def eval_metric(payload, y, w, *, metric_fn):
     """One jitted metric evaluation, cached on the metric's identity.

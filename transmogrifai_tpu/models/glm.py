@@ -131,22 +131,18 @@ class GeneralizedLinearRegression(PredictionEstimatorBase):
         (reference all-fold concurrency, OpCrossValidation.scala:114-134)."""
         if any(set(g) - {"reg_param", "family"} for g in grids):
             return super().cv_sweep(x, y, train_w, val_w, grids, metric_fn)
-        from ..parallel.mesh import (
-            DATA_AXIS, pad_rows_bucketed_for_mesh, place, place_rows)
+        from .base import sweep_placements
+        from .logistic import _device_prepare
 
         x32 = np.asarray(x, np.float32)
-        if self.fit_intercept:
-            x32 = np.hstack(
-                [x32, np.ones((x32.shape[0], 1), dtype=np.float32)])
         y32 = np.asarray(y, np.float32)
-        n0 = x32.shape[0]
-        x_p, y_p, _ = pad_rows_bucketed_for_mesh(x32, y32)
-        pad = x_p.shape[0] - n0
-        tw_p = np.pad(np.asarray(train_w, np.float32), [(0, 0), (0, pad)])
-        vw_p = np.pad(np.asarray(val_w, np.float32), [(0, 0), (0, pad)])
-        xd, yd = place_rows(x_p), place_rows(y_p)
-        twd = place(tw_p, (None, DATA_AXIS))
-        vwd = place(vw_p, (None, DATA_AXIS))
+        xd_raw, (yd,), twd, vwd, n0 = sweep_placements(
+            x32, [y32], train_w, val_w)
+        # append the intercept column ON DEVICE so the raw placement stays
+        # shared with the other selector families
+        xd = _device_prepare(xd_raw, jnp.int32(n0),
+                             has_intercept=bool(self.fit_intercept),
+                             standardize=False)
 
         out = np.zeros((len(grids), train_w.shape[0]))
         by_family = {}

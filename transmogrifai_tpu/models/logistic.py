@@ -240,23 +240,15 @@ class LogisticRegression(PredictionEstimatorBase):
         #    than per dataset size (XLA compile is seconds per shape);
         # 2. to the ambient mesh's data-axis multiple for sharding.
         # The RAW block places once per selector fit (shared across families
-        # via place_rows_bucketed_cached); standardization runs on device.
-        from ..parallel.mesh import (
-            DATA_AXIS, pad_rows_bucketed_for_mesh, place,
-            place_rows_bucketed_cached, place_rows)
+        # via sweep_placements); standardization runs on device.
+        from .base import sweep_placements
 
         x32 = np.asarray(x, np.float32)
-        xd_raw, n0 = place_rows_bucketed_cached(x32)
+        xd_raw, (yd,), train_w, val_w, n0 = sweep_placements(
+            x32, [np.asarray(y)], train_w, val_w)
         xd = _device_prepare(xd_raw, jnp.int32(n0),
                              has_intercept=bool(self.fit_intercept),
                              standardize=bool(self.standardize))
-        y_p, _ = pad_rows_bucketed_for_mesh(np.asarray(y))
-        pad = xd_raw.shape[0] - n0
-        train_w_p = np.pad(np.asarray(train_w), [(0, 0), (0, pad)])
-        val_w_p = np.pad(np.asarray(val_w), [(0, 0), (0, pad)])
-        yd = place_rows(y_p)
-        train_w = place(train_w_p, (None, DATA_AXIS))
-        val_w = place(val_w_p, (None, DATA_AXIS))
 
         k, d1 = train_w.shape[0], int(xd.shape[1])
         has_icpt = bool(self.fit_intercept)

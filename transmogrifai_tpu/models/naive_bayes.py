@@ -95,8 +95,7 @@ class NaiveBayes(PredictionEstimatorBase):
             # non-contiguous class labels or exotic grids: generic path keeps
             # exact per-grid set_params semantics
             return super().cv_sweep(x, y, train_w, val_w, grids, metric_fn)
-        from ..parallel.mesh import (
-            DATA_AXIS, pad_rows_bucketed_for_mesh, place, place_rows)
+        from .base import sweep_placements
 
         smoothings = jnp.asarray(
             [float(g.get("smoothing", self.smoothing)) for g in grids],
@@ -105,14 +104,10 @@ class NaiveBayes(PredictionEstimatorBase):
         y32 = np.asarray(y, np.float32)
         y_oh = (y32[:, None] == classes[None, :].astype(np.float32)
                 ).astype(np.float32)
-        n0 = x32.shape[0]
-        x_p, y_p, yoh_p, _ = pad_rows_bucketed_for_mesh(x32, y32, y_oh)
-        pad = x_p.shape[0] - n0
-        tw_p = np.pad(np.asarray(train_w, np.float32), [(0, 0), (0, pad)])
-        vw_p = np.pad(np.asarray(val_w, np.float32), [(0, 0), (0, pad)])
+        xd, (yd, yohd), tw, vw, _ = sweep_placements(
+            x32, [y32, y_oh], train_w, val_w)
         out = _nb_cv_program(
-            place_rows(x_p), place_rows(y_p), place_rows(yoh_p),
-            place(tw_p, (None, DATA_AXIS)), place(vw_p, (None, DATA_AXIS)),
+            xd, yd, yohd, tw, vw,
             smoothings, metric_fn=metric_fn,
             multiclass_payload=len(classes) > 2)
         return np.asarray(out)

@@ -129,9 +129,7 @@ class LinearSVC(PredictionEstimatorBase):
         if (not self.standardize
                 or any(set(g) - {"reg_param"} for g in grids)):
             return super().cv_sweep(x, y, train_w, val_w, grids, metric_fn)
-        from ..parallel.mesh import (
-            DATA_AXIS, pad_rows_bucketed_for_mesh, place,
-            place_rows_bucketed_cached, place_rows)
+        from .base import sweep_placements
 
         regs = jnp.asarray(
             [float(g.get("reg_param", self.reg_param)) for g in grids],
@@ -139,14 +137,10 @@ class LinearSVC(PredictionEstimatorBase):
         x32 = np.asarray(x, np.float32)
         y32 = np.asarray(y, np.float32)
         y_pm = np.where(y32 > 0.5, 1.0, -1.0).astype(np.float32)
-        xd, n0 = place_rows_bucketed_cached(x32)  # shared across families
-        y_p, ypm_p, _ = pad_rows_bucketed_for_mesh(y32, y_pm)
-        pad = xd.shape[0] - n0
-        tw_p = np.pad(np.asarray(train_w, np.float32), [(0, 0), (0, pad)])
-        vw_p = np.pad(np.asarray(val_w, np.float32), [(0, 0), (0, pad)])
+        xd, (yd, ypmd), tw, vw, _ = sweep_placements(
+            x32, [y32, y_pm], train_w, val_w)
         out = _svc_cv_program(
-            xd, place_rows(y_p), place_rows(ypm_p),
-            place(tw_p, (None, DATA_AXIS)), place(vw_p, (None, DATA_AXIS)),
+            xd, yd, ypmd, tw, vw,
             regs, int(self.max_iter), bool(self.fit_intercept), metric_fn)
         return np.asarray(out)
 

@@ -748,19 +748,15 @@ class _TreeEstimatorBase(PredictionEstimatorBase):
         """Fold-vmapped sweep: bins ON DEVICE from the shared raw placement,
         dispatches one async program per grid point, fetches all metrics in a
         single gather at the end (VERDICT r1 #2)."""
-        from ..parallel.mesh import place_rows_bucketed_cached
+        from .base import sweep_placements
 
         x32 = np.asarray(x, np.float32)
-        xd, n0 = place_rows_bucketed_cached(x32)  # shared across families
+        xd, _, tw, vw, n0 = sweep_placements(x32, [], train_w, val_w)
         binned = _digitize_device(
             xd, jnp.asarray(quantile_edges(x32, int(self.n_bins))),
             int(self.n_bins))
-        pad = xd.shape[0] - n0
+        pad = int(xd.shape[0]) - n0
         y_p = np.pad(np.asarray(y, np.float64), (0, pad))
-        tw = jnp.asarray(np.pad(np.asarray(train_w, np.float32),
-                                [(0, 0), (0, pad)]))
-        vw = jnp.asarray(np.pad(np.asarray(val_w, np.float32),
-                                [(0, 0), (0, pad)]))
         pending = []
         for grid in grids:
             est = self.copy().set_params(**grid)
