@@ -20,22 +20,26 @@ from .base import PredictionEstimatorBase, PredictionModelBase
 from .prediction import PredictionColumn
 
 
-@jax.jit
-def _ridge_core(x: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, reg: jnp.ndarray
-                ) -> jnp.ndarray:
-    """x includes trailing ones column; averaged-loss ridge (intercept unpenalized)."""
+@partial(jax.jit, static_argnames=("has_intercept",))
+def _ridge_core(x: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, reg: jnp.ndarray,
+                has_intercept: bool = True) -> jnp.ndarray:
+    """Averaged-loss ridge; with ``has_intercept`` the trailing ones column is
+    exempt from L2 (it IS the intercept)."""
     d1 = x.shape[1]
     sw = jnp.maximum(w.sum(), 1e-12)
-    reg_mask = jnp.ones(d1).at[-1].set(0.0)
+    reg_mask = (jnp.ones(d1).at[-1].set(0.0) if has_intercept
+                else jnp.ones(d1))
     xtwx = (x.T * w) @ x / sw
     xtwy = x.T @ (w * y) / sw
     h = xtwx + jnp.diag(reg * reg_mask + 1e-9)
     return jnp.linalg.solve(h, xtwy)
 
 
-@jax.jit
-def _ridge_sweep(x, y, train_w, regs):
-    fit_fold = jax.vmap(lambda w, reg: _ridge_core(x, y, w, reg), in_axes=(0, None))
+@partial(jax.jit, static_argnames=("has_intercept",))
+def _ridge_sweep(x, y, train_w, regs, has_intercept: bool = True):
+    fit_fold = jax.vmap(
+        lambda w, reg: _ridge_core(x, y, w, reg, has_intercept=has_intercept),
+        in_axes=(0, None))
     return jax.vmap(lambda reg: fit_fold(train_w, reg), in_axes=0)(regs)
 
 
@@ -59,7 +63,9 @@ class LinearRegression(PredictionEstimatorBase):
     def _fit_arrays(self, x, y, w):
         xs = self._with_ones(x)
         reg = jnp.float32(float(self.reg_param) * (1.0 - float(self.elastic_net)))
-        beta = np.asarray(_ridge_core(jnp.asarray(xs), jnp.asarray(y), jnp.asarray(w), reg))
+        beta = np.asarray(_ridge_core(
+            jnp.asarray(xs), jnp.asarray(y), jnp.asarray(w), reg,
+            has_intercept=bool(self.fit_intercept)))
         coef, intercept = self._split_beta(beta)
         return LinearRegressionModel(coef=coef, intercept=intercept)
 
@@ -68,14 +74,18 @@ class LinearRegression(PredictionEstimatorBase):
             [float(g.get("reg_param", self.reg_param))
              * (1.0 - float(g.get("elastic_net", self.elastic_net))) for g in grids],
             dtype=jnp.float32)
-        xs = self._with_ones(x)
-        xd, yd = jnp.asarray(xs), jnp.asarray(y)
-        betas = _ridge_sweep(xd, yd, jnp.asarray(train_w), regs)
+        from .base import eval_linear_sweep, sweep_placements
+        from .logistic import _device_prepare
 
-        from .base import eval_linear_sweep
-
+        has_icpt = bool(self.fit_intercept)
+        xd_raw, (yd,), twd, vwd, n0 = sweep_placements(
+            np.asarray(x, np.float32), [np.asarray(y, np.float32)],
+            train_w, val_w)
+        xd = _device_prepare(xd_raw, jnp.int32(n0), has_intercept=has_icpt,
+                             standardize=False)
+        betas = _ridge_sweep(xd, yd, twd, regs, has_intercept=has_icpt)
         return np.asarray(eval_linear_sweep(
-            xd, yd, betas, jnp.asarray(val_w), metric_fn=metric_fn))
+            xd, yd, betas, vwd, metric_fn=metric_fn))
 
 
 class LinearRegressionModel(PredictionModelBase):

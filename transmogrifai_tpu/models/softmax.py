@@ -93,27 +93,28 @@ class MultinomialLogisticRegression(PredictionEstimatorBase):
     def cv_sweep(self, x, y, train_w, val_w, grids: List[Dict[str, Any]], metric_fn):
         c = self._n_classes(y)
         y_onehot = np.eye(c, dtype=np.float32)[y.astype(np.int32)]
-        xs = self._with_ones(x)
         regs = jnp.asarray(
             [float(g.get("reg_param", self.reg_param))
              * (1.0 - float(g.get("elastic_net", self.elastic_net))) for g in grids],
             dtype=jnp.float32)
-        xd = jnp.asarray(xs)
-        yoh = jnp.asarray(y_onehot)
-        yd = jnp.asarray(y.astype(np.int32))
+        from .base import eval_softmax_sweep, sweep_placements
+        from .logistic import _device_prepare
 
         has_icpt = bool(self.fit_intercept)
+        xd_raw, (yd, yoh), twd, vwd, n0 = sweep_placements(
+            np.asarray(x, np.float32),
+            [y.astype(np.float32), y_onehot], train_w, val_w)
+        xd = _device_prepare(xd_raw, jnp.int32(n0), has_intercept=has_icpt,
+                             standardize=False)
         fit_fold = jax.vmap(
             lambda w_, reg: _softmax_core(xd, yoh, w_, reg, c,
                                           int(self.max_iter),
                                           has_intercept=has_icpt),
             in_axes=(0, None))
-        bs = jax.vmap(lambda reg: fit_fold(jnp.asarray(train_w), reg), in_axes=0)(regs)
-
-        from .base import eval_softmax_sweep
+        bs = jax.vmap(lambda reg: fit_fold(twd, reg), in_axes=0)(regs)
 
         return np.asarray(eval_softmax_sweep(
-            xd, yd, bs, jnp.asarray(val_w), metric_fn=metric_fn))
+            xd, yd.astype(jnp.int32), bs, vwd, metric_fn=metric_fn))
 
 
 class MultinomialLogisticRegressionModel(PredictionModelBase):
