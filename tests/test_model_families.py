@@ -355,3 +355,30 @@ class TestGLMSweep:
             fit_intercept=False, reg_param=100.0)._fit_arrays(x, y, w)
         # heavy L2 must shrink the LAST coefficient too
         assert abs(m_high.coef[-1]) < abs(m_low.coef[-1]) * 0.9
+
+
+class TestMLPSweep:
+    def test_vmapped_sweep_matches_generic_path(self):
+        import jax.numpy as jnp
+
+        from transmogrifai_tpu.models.base import PredictionEstimatorBase
+        from transmogrifai_tpu.models.mlp import MultilayerPerceptronClassifier
+
+        rng = np.random.default_rng(27)
+        n, d = 300, 5
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
+        folds = rng.integers(0, 2, n)
+        tw = np.stack([(folds != f).astype(np.float32) for f in range(2)])
+        vw = np.stack([(folds == f).astype(np.float32) for f in range(2)])
+        grids = [{"hidden_layers": (4,), "max_iter": 40},
+                 {"hidden_layers": (8,), "max_iter": 40}]
+
+        def metric(payload, yt, w):
+            pred = (payload > 0.5).astype(jnp.float32)
+            return (w * (pred == yt)).sum() / jnp.maximum(w.sum(), 1e-12)
+
+        est = MultilayerPerceptronClassifier()
+        fast = est.cv_sweep(x, y, tw, vw, grids, metric)
+        slow = PredictionEstimatorBase.cv_sweep(est, x, y, tw, vw, grids, metric)
+        np.testing.assert_allclose(fast, slow, rtol=1e-4, atol=1e-5)

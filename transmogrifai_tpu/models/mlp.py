@@ -79,6 +79,23 @@ def _mlp_fit(x, y_onehot, w, sizes, max_iter, lr, seed):
     return jax.tree_util.tree_unflatten(tree, flat)
 
 
+@partial(jax.jit, static_argnames=("sizes", "max_iter", "metric_fn",
+                                   "multiclass_payload"))
+def _mlp_cv_program(x, y, y_onehot, train_w, val_w, lr, seed, sizes,
+                    max_iter: int, metric_fn, multiclass_payload: bool):
+    """All folds of one MLP grid point in one program.  ``sizes`` is static
+    (hidden_layers change the network shape), so grids sweep as one program
+    each with the folds vmapped inside — not a fit per (grid, fold)."""
+
+    def one_fold(w, vw):
+        params = _mlp_fit(x, y_onehot, w, sizes, max_iter, lr, seed)
+        probs = jax.nn.softmax(_forward(params, x), axis=-1)
+        payload = probs if multiclass_payload else probs[:, 1]
+        return metric_fn(payload, y, vw)
+
+    return jax.vmap(one_fold)(train_w, val_w)
+
+
 class MultilayerPerceptronClassifier(PredictionEstimatorBase):
     """MLP classifier (OpMultilayerPerceptronClassifier capability)."""
 
@@ -99,6 +116,33 @@ class MultilayerPerceptronClassifier(PredictionEstimatorBase):
         weights = [(np.asarray(wm, dtype=np.float64), np.asarray(b, dtype=np.float64))
                    for wm, b in params]
         return MLPClassifierModel(classes=classes.astype(np.float64), weights=weights)
+
+    def cv_sweep(self, x, y, train_w, val_w, grids, metric_fn):
+        """One fold-vmapped program per grid point (hidden_layers are static
+        shapes), over the shared device placement."""
+        allowed = {"hidden_layers", "learning_rate", "max_iter", "seed"}
+        classes = np.unique(y)
+        if (any(set(g) - allowed for g in grids)
+                or not np.array_equal(classes, np.arange(len(classes)))):
+            return super().cv_sweep(x, y, train_w, val_w, grids, metric_fn)
+        from .base import sweep_placements
+
+        x32 = np.asarray(x, np.float32)
+        y32 = np.asarray(y, np.float32)
+        y_oh = (y32[:, None] == classes[None, :].astype(np.float32)
+                ).astype(np.float32)
+        xd, (yd, yohd), tw, vw, _ = sweep_placements(
+            x32, [y32, y_oh], train_w, val_w)
+        pending = []
+        for g in grids:
+            est = self.copy().set_params(**g)
+            sizes = (x32.shape[1],
+                     *tuple(int(h) for h in est.hidden_layers), len(classes))
+            pending.append(_mlp_cv_program(
+                xd, yd, yohd, tw, vw, jnp.float32(est.learning_rate),
+                int(est.seed), sizes, int(est.max_iter),
+                metric_fn=metric_fn, multiclass_payload=len(classes) > 2))
+        return np.stack(jax.device_get(pending))
 
 
 class MLPClassifierModel(PredictionModelBase):
