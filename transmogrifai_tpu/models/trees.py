@@ -197,15 +197,20 @@ def _leaf_value(G, H, reg_lambda, alpha, eta, max_delta_step):
 def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                feat_mask: jnp.ndarray, key, max_depth: int, n_bins: int,
                reg_lambda, alpha, gamma, min_child_weight, eta, max_delta_step,
-               colsample_bylevel: float = 1.0) -> Tree:
+               colsample_bylevel: float = 1.0):
     """Level-wise histogram growth of ONE multi-output tree; static shapes, jit-safe.
 
     binned: (n, d) int32 in [0, n_bins] (n_bins = missing).
     grad/hess: (n, K) per-class — zero-weight rows contribute nothing.
     feat_mask: (d,) float 1/0 — colsample_bytree support.
     key: PRNG key for colsample_bylevel (ignored when colsample_bylevel >= 1).
+
+    Returns (Tree, node): ``node`` is each input row's FINAL leaf assignment —
+    callers that need in-sample predictions (boosting margin updates, forest
+    training-set votes) read ``value[node]`` directly instead of re-traversing.
     """
     n, d = binned.shape
+    n_orig = n
     K = grad.shape[1]
     m = 2 ** (max_depth + 1) - 1
     B = n_bins + 1  # + missing slot
@@ -403,7 +408,7 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
         node = jnp.where(_node_lookup(is_leaf, node), node, child)
 
-    return Tree(feat, thr_bin, miss_left, is_leaf, value)
+    return Tree(feat, thr_bin, miss_left, is_leaf, value), node[:n_orig]
 
 
 def _predict_tree(tree: Tree, binned: jnp.ndarray, max_depth: int, n_bins: int
@@ -491,10 +496,12 @@ def _fit_gbt_impl(binned, y, w, key, n_rounds: int, max_depth: int, n_bins: int,
         else:  # reg:squarederror
             grad = (wt * (margin[:, 0] - y))[:, None]
             hess = wt[:, None] * jnp.ones((1, 1), jnp.float32)
-        tree = _grow_tree(binned, grad, hess, feat_mask, rkey, max_depth, n_bins,
-                          reg_lambda, alpha, gamma, min_child_weight, eta,
-                          max_delta_step, colsample_bylevel)
-        new_margin = margin + _predict_tree(tree, binned, max_depth, n_bins)
+        tree, node = _grow_tree(binned, grad, hess, feat_mask, rkey, max_depth,
+                                n_bins, reg_lambda, alpha, gamma,
+                                min_child_weight, eta, max_delta_step,
+                                colsample_bylevel)
+        # the grower already routed every row to its leaf — no re-traversal
+        new_margin = margin + _node_lookup(tree.value, node)
         return new_margin, tree
 
     margin0 = jnp.broadcast_to(base_score.astype(jnp.float32), (n, K))
@@ -534,14 +541,14 @@ def _fit_forest_impl(binned, y_cols, w, max_depth: int, n_bins: int,
         return _grow_tree(binned, grad, hess, fm, key, max_depth, n_bins,
                           reg_lambda, 0.0, 0.0, min_child_weight, 1.0, 0.0)
 
-    return jax.vmap(one_tree)(feat_masks, boot_w)
+    return jax.vmap(one_tree)(feat_masks, boot_w)  # (trees, nodes (T, n))
 
 
 @partial(jax.jit, static_argnames=("max_depth", "n_bins"))
 def _fit_forest(binned, y_cols, w, max_depth, n_bins,
                 reg_lambda, min_child_weight, feat_masks, boot_w):
     return _fit_forest_impl(binned, y_cols, w, max_depth, n_bins,
-                            reg_lambda, min_child_weight, feat_masks, boot_w)
+                            reg_lambda, min_child_weight, feat_masks, boot_w)[0]
 
 
 @partial(jax.jit, static_argnames=("max_depth", "n_bins"))
@@ -594,9 +601,13 @@ def _forest_cv_program(binned, y, y_cols, train_w, val_w, feat_masks, boot_w,
     n_trees = feat_masks.shape[0]
 
     def one_fold(w_, vw_):
-        trees = _fit_forest_impl(binned, y_cols, w_, max_depth, n_bins,
-                                 reg_lambda, min_child_weight, feat_masks, boot_w)
-        mean = _predict_trees_sum(trees, binned, max_depth, n_bins) / n_trees
+        trees, nodes = _fit_forest_impl(binned, y_cols, w_, max_depth, n_bins,
+                                        reg_lambda, min_child_weight,
+                                        feat_masks, boot_w)
+        # in-sample votes read each tree's final row->leaf assignment from the
+        # grower — no re-traversal of the whole forest
+        vals = jax.vmap(_node_lookup)(trees.value, nodes)        # (T, n, K)
+        mean = vals.sum(axis=0) / n_trees
         if classification:
             payload = mean[:, 0] if mean.shape[1] == 1 else \
                 jnp.clip(mean, 0.0, 1.0) / jnp.maximum(
