@@ -99,9 +99,13 @@ def bench_selector(n_rows: int):
     sel = _selector()
     label.transform_with(sel, vec)
     sel.fit(ds)  # warm-up: compiles + transfer warming
-    t0 = time.perf_counter()
-    model = sel.fit(ds)
-    dt = time.perf_counter() - t0
+    # best of two timed fits: remote-device transports have multi-second
+    # per-run jitter that would otherwise dominate the number
+    dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        model = sel.fit(ds)
+        dt = min(dt, time.perf_counter() - t0)
     summary = model.summary
     n_models = sum(len(r.metric_values) for r in summary.validation_results)
     models_per_sec = (n_models / dt) * (n_rows / TARGET_ROWS)

@@ -19,10 +19,15 @@ TPU-first design (not a port of either C++ codebase):
   round loop a single ``lax.scan`` and the histogram contraction one big matmul.
 - Trees grow LEVEL-WISE over a dense complete binary tree of static size
   ``2^(max_depth+1)-1``: per level, the (node, class, feature, bin) gradient/hessian
-  histograms build as scatter-free MXU matmuls (one-hot node matrix against
-  per-bin indicator masks — TPU lowers scatters to slow sorts, matmuls fly).
-  When rows are sharded over the ``data`` mesh axis this contraction IS the
-  Rabit allreduce, inserted by XLA as a psum.
+  histograms build as scatter-free MXU matmuls — a one-hot(node) x [grad|hess]
+  activation contracted against a joint (feature, bin) one-hot (TPU lowers
+  scatters to slow sorts, matmuls fly), row-chunked under ``lax.scan`` so the
+  live activation stays a few MB per CV vmap lane at any row count, with
+  sibling subtraction (right child = parent - left) and a totals-only deepest
+  level cutting ~4x of the work.  Row routing and per-node table lookups are
+  fused compare-multiply-reduces, never TPU gathers.  When rows are sharded
+  over the ``data`` mesh axis the histogram contraction IS the Rabit
+  allreduce, inserted by XLA as a psum.
 - Split gain is the XGBoost second-order formula with L2 ``reg_lambda``, L1 ``alpha``
   (soft-threshold on G), complexity ``gamma``, and ``min_child_weight``; leaves take
   ``-T_alpha(G)/(H+lambda) * eta`` clipped to ``max_delta_step``.  Multi-output gain
