@@ -1,0 +1,87 @@
+"""Text-pipeline example: review sentiment with the full text stack.
+
+Shows what the framework does with free text end to end:
+- ``SmartTextVectorizer`` (via ``transmogrify``) decides categorical-vs-hashed
+  per feature and hashes free text with the fused native tokenize+hash kernel;
+- DSL text shortcuts: ``tokenize``, ``detect_languages``, ``name_entity_tags``;
+- the usual ``sanity_check`` -> selector -> train/evaluate flow, with hashed
+  slots excludable from correlations (``correlation_exclusion='hashed_text'``).
+
+Run:  PYTHONPATH=. python examples/text_reviews.py
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    Dataset,
+    FeatureBuilder,
+    Workflow,
+    transmogrify,
+)
+from transmogrifai_tpu.checkers.sanity import SanityChecker
+from transmogrifai_tpu.evaluators.base import Evaluators
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.types import PickList, Real, RealNN, Text
+
+_POS = ["great", "excellent", "loved", "wonderful", "perfect", "amazing",
+        "fantastic", "happy", "best", "recommend"]
+_NEG = ["terrible", "awful", "hated", "broken", "poor", "worst",
+        "refund", "disappointed", "useless", "never"]
+_FILL = ["the", "product", "arrived", "yesterday", "and", "it", "was",
+         "overall", "quite", "really", "shipping", "box", "works"]
+
+
+def reviews_dataframe(n: int = 2000, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    label = (rng.random(n) > 0.5).astype(float)
+    texts, category, stars = [], [], []
+    for i in range(n):
+        lex = _POS if label[i] else _NEG
+        words = list(rng.choice(_FILL, 6)) + list(rng.choice(lex, 3))
+        rng.shuffle(words)
+        texts.append(" ".join(words))
+        category.append(str(rng.choice(["home", "garden", "tech"])))
+        stars.append(float(rng.integers(1, 6)))
+    return {"review": texts, "category": category, "stars": stars,
+            "label": list(label)}
+
+
+def main():
+    cols = reviews_dataframe()
+    ds = Dataset.from_features(
+        cols, {"review": Text, "category": PickList, "stars": Real,
+               "label": RealNN})
+
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    review = FeatureBuilder.Text("review").extract_field().as_predictor()
+    category = FeatureBuilder.PickList("category").extract_field().as_predictor()
+    stars = FeatureBuilder.Real("stars").extract_field().as_predictor()
+
+    features = transmogrify([review, category, stars])
+    checked = label.transform_with(
+        SanityChecker(correlation_exclusion="hashed_text"), features)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3,
+        models=[(LogisticRegression(), [{"reg_param": r} for r in (0.01, 0.1)])])
+    prediction = label.transform_with(selector, checked)
+
+    model = Workflow().set_input_dataset(ds) \
+        .set_result_features(label, prediction).train()
+    metrics = model.evaluate(Evaluators.binary_classification(), ds)
+    print(f"AuPR = {metrics['auPR']:.4f}")
+
+    # DSL text shortcuts on the same raw feature
+    langs = review.detect_languages()
+    ner = review.name_entity_tags()
+    side = Workflow().set_input_dataset(ds) \
+        .set_result_features(langs, ner).train().score(ds)
+    print("language:", max(side[langs.name].to_values()[0].items(),
+                           key=lambda kv: kv[1])[0])
+    return metrics
+
+
+if __name__ == "__main__":
+    main()
