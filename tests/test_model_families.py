@@ -284,7 +284,7 @@ class TestNaiveBayesSweep:
 
         est = NaiveBayes()
         fast = est.cv_sweep(x, y, tw, vw, grids, metric)
-        slow = PredictionEstimatorBase.cv_sweep(est, x, y, tw, vw, grids, metric)
+        slow = PredictionEstimatorBase._cv_sweep_generic(est, x, y, tw, vw, grids, metric)
         np.testing.assert_allclose(fast, slow, rtol=1e-5, atol=1e-6)
 
     def test_noncontiguous_classes_fall_back(self):
@@ -334,7 +334,7 @@ class TestGLMSweep:
         y_pos = np.abs(y)  # poisson support
         est = GeneralizedLinearRegression()
         fast = est.cv_sweep(x, y_pos, tw, vw, grids, metric)
-        slow = PredictionEstimatorBase.cv_sweep(
+        slow = PredictionEstimatorBase._cv_sweep_generic(
             est, x, y_pos, tw, vw, grids, metric)
         np.testing.assert_allclose(fast, slow, rtol=1e-3, atol=1e-4)
 
@@ -380,5 +380,5 @@ class TestMLPSweep:
 
         est = MultilayerPerceptronClassifier()
         fast = est.cv_sweep(x, y, tw, vw, grids, metric)
-        slow = PredictionEstimatorBase.cv_sweep(est, x, y, tw, vw, grids, metric)
+        slow = PredictionEstimatorBase._cv_sweep_generic(est, x, y, tw, vw, grids, metric)
         np.testing.assert_allclose(fast, slow, rtol=1e-4, atol=1e-5)

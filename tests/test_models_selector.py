@@ -10,6 +10,7 @@ from transmogrifai_tpu.evaluators.base import (
     MultiClassificationEvaluator,
     RegressionEvaluator,
 )
+from transmogrifai_tpu.models.base import PredictionEstimatorBase
 from transmogrifai_tpu.models.linear import LinearRegression
 from transmogrifai_tpu.models.logistic import LogisticRegression
 from transmogrifai_tpu.models.prediction import PredictionColumn
@@ -75,7 +76,7 @@ class TestLogisticRegression:
         est = LogisticRegression()
         fast = est.cv_sweep(x, y, tw, vw, grids, ev.metric_fn())
         # generic loop path (base class implementation)
-        slow = super(LogisticRegression, est).cv_sweep(x, y, tw, vw, grids, ev.metric_fn())
+        slow = PredictionEstimatorBase._cv_sweep_generic(est, x, y, tw, vw, grids, ev.metric_fn())
         np.testing.assert_allclose(fast, slow, atol=2e-2)
 
 
@@ -433,3 +434,39 @@ class TestHoldoutEvaluation:
         w, summary = sp.prepare(np.ones(50))
         assert sp.holdout_mask is None
         assert (w == 1.0).all()
+
+    def test_balancer_and_cutter_apply_reserved_fraction(self):
+        """DataBalancer/DataCutter (the classification defaults) must honor
+        reserve_test_fraction too: holdout rows get zero training weight and
+        the rebalance statistics come from the training rows only
+        (ADVICE r2 medium: the holdout silently no-op'd for classification)."""
+        import numpy as np
+
+        from transmogrifai_tpu.models.tuning import DataBalancer, DataCutter
+
+        rng = np.random.default_rng(5)
+        y = (rng.random(2000) < 0.05).astype(np.float64)  # rare positives
+        bal = DataBalancer(sample_fraction=0.2, reserve_test_fraction=0.25,
+                           seed=11)
+        w, summary = bal.prepare(y)
+        assert bal.holdout_mask is not None and bal.holdout_mask.sum() > 0
+        assert (w[bal.holdout_mask] == 0.0).all(), \
+            "holdout rows must not train"
+        train = ~bal.holdout_mask
+        assert (w[train] > 0.0).all()
+        # weighted positive fraction on the training rows hits the target
+        wpos = w[train][y[train] == 1.0].sum()
+        assert abs(wpos / w[train].sum() - 0.2) < 1e-5
+        assert summary.details["holdoutRows"] == int(bal.holdout_mask.sum())
+
+        yc = rng.integers(0, 3, size=2000).astype(np.float64)
+        yc[:3] = 9.0  # rare label, dropped by min_label_fraction
+        cut = DataCutter(min_label_fraction=0.01, reserve_test_fraction=0.25,
+                         seed=11)
+        wc, csum = cut.prepare(yc)
+        assert cut.holdout_mask is not None
+        assert (wc[cut.holdout_mask] == 0.0).all()
+        assert (wc[(yc == 9.0)] == 0.0).all()  # rare label still cut
+        kept = (~cut.holdout_mask) & (yc != 9.0)
+        assert (wc[kept] == 1.0).all()
+        assert csum.details["holdoutRows"] == int(cut.holdout_mask.sum())

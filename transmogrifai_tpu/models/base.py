@@ -56,6 +56,14 @@ def sweep_placements(x32: np.ndarray, extras, train_w, val_w):
     return xd, extra_devs, tw, vw, n0
 
 
+def gather_scores(pending) -> np.ndarray:
+    """Host-fetch a pending sweep result: a (g, k) device array or a list of
+    per-grid (k,) device arrays (one async fetch either way)."""
+    if isinstance(pending, (list, tuple)):
+        return np.stack(jax.device_get(list(pending)))
+    return np.asarray(jax.device_get(pending))
+
+
 @partial(jax.jit, static_argnames=("metric_fn",))
 def eval_metric(payload, y, w, *, metric_fn):
     """One jitted metric evaluation, cached on the metric's identity.
@@ -142,6 +150,27 @@ class PredictionEstimatorBase(Estimator):
         raise NotImplementedError
 
     # --- sweep protocol (overridden by device-sweepable estimators) ----------
+    def _cv_sweep_device(
+        self,
+        x: np.ndarray,
+        y: np.ndarray,
+        train_w: np.ndarray,
+        val_w: np.ndarray,
+        grids: List[Dict[str, Any]],
+        metric_fn,
+    ):
+        """Dispatch this family's whole (grid x fold) sweep WITHOUT blocking.
+
+        Returns the pending (g, k) device array — or a list of per-grid (k,)
+        pending arrays — or ``None`` when this family (or this particular
+        grid) has no vectorized device path and must take the generic loop.
+        Device dispatch is async in JAX, so the validator can launch EVERY
+        family's program before fetching any metrics (the reference's
+        all-model all-fold concurrency, OpCrossValidation.scala:114-134,
+        without its Futures pool).
+        """
+        return None
+
     def cv_sweep(
         self,
         x: np.ndarray,
@@ -151,7 +180,32 @@ class PredictionEstimatorBase(Estimator):
         grids: List[Dict[str, Any]],
         metric_fn,             # device fn (scores, y, w) -> metric
     ) -> np.ndarray:
-        """Metric per (grid, fold).  Default: python loops (generic estimators)."""
+        """Metric per (grid, fold).  Blocking: device path when available,
+        else python loops (generic estimators)."""
+        pending = self._cv_sweep_device(x, y, train_w, val_w, grids, metric_fn)
+        if pending is not None:
+            return gather_scores(pending)
+        return self._cv_sweep_generic(x, y, train_w, val_w, grids, metric_fn)
+
+    def cv_sweep_async(self, x, y, train_w, val_w, grids, metric_fn):
+        """Dispatch and return a zero-arg gather -> (g, k) metric ndarray.
+
+        Families with a device sweep return while their XLA program is still
+        running; generic families compute eagerly (the gather is then a no-op).
+        """
+        if type(self).cv_sweep is not PredictionEstimatorBase.cv_sweep:
+            # subclass overrode the blocking entry point itself — honor it
+            # (custom estimators predate the async protocol)
+            scores = self.cv_sweep(x, y, train_w, val_w, grids, metric_fn)
+            return lambda: scores
+        pending = self._cv_sweep_device(x, y, train_w, val_w, grids, metric_fn)
+        if pending is not None:
+            return lambda: gather_scores(pending)
+        scores = self._cv_sweep_generic(x, y, train_w, val_w, grids, metric_fn)
+        return lambda: scores
+
+    def _cv_sweep_generic(self, x, y, train_w, val_w,
+                          grids: List[Dict[str, Any]], metric_fn) -> np.ndarray:
         k = train_w.shape[0]
         out = np.zeros((len(grids), k))
         yd = jnp.asarray(y, jnp.float32)

@@ -126,11 +126,11 @@ class GeneralizedLinearRegression(PredictionEstimatorBase):
         return GLMModel(coef=coef.astype(np.float64), intercept=intercept,
                         family=str(self.family))
 
-    def cv_sweep(self, x, y, train_w, val_w, grids, metric_fn):
+    def _cv_sweep_device(self, x, y, train_w, val_w, grids, metric_fn):
         """Fold-vmapped sweep, one cached program per family in the grid
         (reference all-fold concurrency, OpCrossValidation.scala:114-134)."""
         if any(set(g) - {"reg_param", "family"} for g in grids):
-            return super().cv_sweep(x, y, train_w, val_w, grids, metric_fn)
+            return None
         from .base import sweep_placements
         from .logistic import _device_prepare
 
@@ -144,7 +144,9 @@ class GeneralizedLinearRegression(PredictionEstimatorBase):
                              has_intercept=bool(self.fit_intercept),
                              standardize=False)
 
-        out = np.zeros((len(grids), train_w.shape[0]))
+        # assemble per-family-group results ON DEVICE so the whole grid stays
+        # one pending array — no host sync between family groups
+        out = jnp.zeros((len(grids), train_w.shape[0]), dtype=jnp.float32)
         by_family = {}
         for i, g in enumerate(grids):
             by_family.setdefault(
@@ -157,9 +159,10 @@ class GeneralizedLinearRegression(PredictionEstimatorBase):
             regs = jnp.asarray(
                 [float(grids[i].get("reg_param", self.reg_param))
                  for i in idxs], dtype=jnp.float32)
-            out[idxs] = np.asarray(_glm_cv_program(
+            part = _glm_cv_program(
                 xd, y_fam, twd, vwd, regs, family, iters,
-                bool(self.fit_intercept), metric_fn))
+                bool(self.fit_intercept), metric_fn)
+            out = out.at[jnp.asarray(idxs)].set(part.astype(jnp.float32))
         return out
 
 

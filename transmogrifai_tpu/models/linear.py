@@ -69,7 +69,8 @@ class LinearRegression(PredictionEstimatorBase):
         coef, intercept = self._split_beta(beta)
         return LinearRegressionModel(coef=coef, intercept=intercept)
 
-    def cv_sweep(self, x, y, train_w, val_w, grids: List[Dict[str, Any]], metric_fn):
+    def _cv_sweep_device(self, x, y, train_w, val_w,
+                         grids: List[Dict[str, Any]], metric_fn):
         regs = jnp.asarray(
             [float(g.get("reg_param", self.reg_param))
              * (1.0 - float(g.get("elastic_net", self.elastic_net))) for g in grids],
@@ -84,8 +85,7 @@ class LinearRegression(PredictionEstimatorBase):
         xd = _device_prepare(xd_raw, jnp.int32(n0), has_intercept=has_icpt,
                              standardize=False)
         betas = _ridge_sweep(xd, yd, twd, regs, has_intercept=has_icpt)
-        return np.asarray(eval_linear_sweep(
-            xd, yd, betas, vwd, metric_fn=metric_fn))
+        return eval_linear_sweep(xd, yd, betas, vwd, metric_fn=metric_fn)
 
 
 class LinearRegressionModel(PredictionModelBase):

@@ -221,9 +221,11 @@ class LogisticRegression(PredictionEstimatorBase):
         return LogisticRegressionModel(coef=coef, intercept=intercept)
 
     # --- device CV sweep ------------------------------------------------------
-    def cv_sweep(self, x, y, train_w, val_w, grids: List[Dict[str, Any]], metric_fn):
+    def _cv_sweep_device(self, x, y, train_w, val_w,
+                         grids: List[Dict[str, Any]], metric_fn):
         """One XLA program per solver for the whole (grid x fold) sweep: pure-L2
-        grids fit via vmapped IRLS, elastic-net grids via vmapped exact FISTA."""
+        grids fit via vmapped IRLS, elastic-net grids via vmapped exact FISTA.
+        Returns the pending device metric array (no host sync)."""
         l1l2 = []
         for g in grids:
             rp = float(g.get("reg_param", self.reg_param))
@@ -269,8 +271,8 @@ class LogisticRegression(PredictionEstimatorBase):
 
         from .base import eval_linear_sweep
 
-        return np.asarray(eval_linear_sweep(
-            xd, yd, betas, val_w, metric_fn=metric_fn, link="sigmoid"))
+        return eval_linear_sweep(
+            xd, yd, betas, val_w, metric_fn=metric_fn, link="sigmoid")
 
 
 class LogisticRegressionModel(PredictionModelBase):

@@ -117,14 +117,14 @@ class MultilayerPerceptronClassifier(PredictionEstimatorBase):
                    for wm, b in params]
         return MLPClassifierModel(classes=classes.astype(np.float64), weights=weights)
 
-    def cv_sweep(self, x, y, train_w, val_w, grids, metric_fn):
+    def _cv_sweep_device(self, x, y, train_w, val_w, grids, metric_fn):
         """One fold-vmapped program per grid point (hidden_layers are static
         shapes), over the shared device placement."""
         allowed = {"hidden_layers", "learning_rate", "max_iter", "seed"}
         classes = np.unique(y)
         if (any(set(g) - allowed for g in grids)
                 or not np.array_equal(classes, np.arange(len(classes)))):
-            return super().cv_sweep(x, y, train_w, val_w, grids, metric_fn)
+            return None
         from .base import sweep_placements
 
         x32 = np.asarray(x, np.float32)
@@ -142,7 +142,7 @@ class MultilayerPerceptronClassifier(PredictionEstimatorBase):
                 xd, yd, yohd, tw, vw, jnp.float32(est.learning_rate),
                 int(est.seed), sizes, int(est.max_iter),
                 metric_fn=metric_fn, multiclass_payload=len(classes) > 2))
-        return np.stack(jax.device_get(pending))
+        return pending
 
 
 class MLPClassifierModel(PredictionModelBase):

@@ -86,7 +86,7 @@ class NaiveBayes(PredictionEstimatorBase):
             log_theta=np.asarray(log_theta, dtype=np.float64),
             shift=shift.astype(np.float64))
 
-    def cv_sweep(self, x, y, train_w, val_w, grids, metric_fn):
+    def _cv_sweep_device(self, x, y, train_w, val_w, grids, metric_fn):
         """Fold-vmapped sweep over smoothing grids, one cached XLA program
         (reference all-fold concurrency, OpCrossValidation.scala:114-134)."""
         classes = np.unique(y)
@@ -94,7 +94,7 @@ class NaiveBayes(PredictionEstimatorBase):
                 or not np.array_equal(classes, np.arange(len(classes)))):
             # non-contiguous class labels or exotic grids: generic path keeps
             # exact per-grid set_params semantics
-            return super().cv_sweep(x, y, train_w, val_w, grids, metric_fn)
+            return None
         from .base import sweep_placements
 
         smoothings = jnp.asarray(
@@ -106,11 +106,10 @@ class NaiveBayes(PredictionEstimatorBase):
                 ).astype(np.float32)
         xd, (yd, yohd), tw, vw, _ = sweep_placements(
             x32, [y32, y_oh], train_w, val_w)
-        out = _nb_cv_program(
+        return _nb_cv_program(
             xd, yd, yohd, tw, vw,
             smoothings, metric_fn=metric_fn,
             multiclass_payload=len(classes) > 2)
-        return np.asarray(out)
 
 
 class NaiveBayesModel(PredictionModelBase):

@@ -90,7 +90,8 @@ class MultinomialLogisticRegression(PredictionEstimatorBase):
             coef, intercept = b, np.zeros(c)
         return MultinomialLogisticRegressionModel(coef=coef, intercept=intercept)
 
-    def cv_sweep(self, x, y, train_w, val_w, grids: List[Dict[str, Any]], metric_fn):
+    def _cv_sweep_device(self, x, y, train_w, val_w,
+                         grids: List[Dict[str, Any]], metric_fn):
         c = self._n_classes(y)
         y_onehot = np.eye(c, dtype=np.float32)[y.astype(np.int32)]
         regs = jnp.asarray(
@@ -113,8 +114,8 @@ class MultinomialLogisticRegression(PredictionEstimatorBase):
             in_axes=(0, None))
         bs = jax.vmap(lambda reg: fit_fold(twd, reg), in_axes=0)(regs)
 
-        return np.asarray(eval_softmax_sweep(
-            xd, yd.astype(jnp.int32), bs, vwd, metric_fn=metric_fn))
+        return eval_softmax_sweep(
+            xd, yd.astype(jnp.int32), bs, vwd, metric_fn=metric_fn)
 
 
 class MultinomialLogisticRegressionModel(PredictionModelBase):

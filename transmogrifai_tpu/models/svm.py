@@ -118,8 +118,8 @@ class LinearSVC(PredictionEstimatorBase):
         intercept = float(b0 - (coef * mean).sum())
         return LinearSVCModel(coef=coef.astype(np.float64), intercept=intercept)
 
-    def cv_sweep(self, x, y, train_w, val_w, grids: List[Dict[str, Any]],
-                 metric_fn):
+    def _cv_sweep_device(self, x, y, train_w, val_w,
+                         grids: List[Dict[str, Any]], metric_fn):
         """Fold-vmapped sweep: the whole (grid x fold) program runs on device
         (per-fold standardization included), one compile keyed on the metric.
 
@@ -128,7 +128,7 @@ class LinearSVC(PredictionEstimatorBase):
         every grid key is honored."""
         if (not self.standardize
                 or any(set(g) - {"reg_param"} for g in grids)):
-            return super().cv_sweep(x, y, train_w, val_w, grids, metric_fn)
+            return None
         from .base import sweep_placements
 
         regs = jnp.asarray(
@@ -139,10 +139,9 @@ class LinearSVC(PredictionEstimatorBase):
         y_pm = np.where(y32 > 0.5, 1.0, -1.0).astype(np.float32)
         xd, (yd, ypmd), tw, vw, _ = sweep_placements(
             x32, [y32, y_pm], train_w, val_w)
-        out = _svc_cv_program(
+        return _svc_cv_program(
             xd, yd, ypmd, tw, vw,
             regs, int(self.max_iter), bool(self.fit_intercept), metric_fn)
-        return np.asarray(out)
 
 
 class LinearSVCModel(PredictionModelBase):

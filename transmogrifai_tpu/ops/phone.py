@@ -298,10 +298,12 @@ class _PhoneCountryMixin(_PhoneParamsMixin):
                           doc="country names aligned with region_codes")
 
     def _region_for(self, phone, rc):
+        # pass country_names through unmodified: resolve_region derives the
+        # names aligned with region_codes when None, so defaulting here would
+        # pair custom codes with the wrong default names
         return resolve_region(
             phone, rc, self.default_region,
-            self.region_codes or list(COUNTRY_NAMES),
-            self.country_names or list(COUNTRY_NAMES.values()))
+            self.region_codes, self.country_names)
 
 
 class ParsePhoneNumber(_PhoneCountryMixin, BinaryTransformer):

@@ -199,28 +199,42 @@ def pad_rows_bucketed_for_mesh(*arrays, n: Optional[int] = None):
 # One selector fit runs several model families over the SAME feature block;
 # without sharing, every family pays its own host->device transfer of the
 # padded (n, d) matrix (tens of seconds each on slow transports).  The cache
-# keys on the SOURCE array's identity — families receive the same numpy
-# object from the validator — and evicts when the source is garbage-collected.
+# keys on a CONTENT fingerprint (shape + dtype + full-buffer checksum), so a
+# family that re-materialises an identical float32 copy still hits, and an
+# in-place mutation of the source changes the stamp and misses instead of
+# serving stale device data.  Bounded strong-ref FIFO: entries survive their
+# source array (a family's temporary copy dying must not evict the shared
+# transfer) but old blocks roll off so device memory stays bounded.
 _PLACED_ROWS_CACHE: dict = {}
+_PLACED_ROWS_CACHE_MAX = 3
+
+
+def _content_stamp(a: np.ndarray) -> int:
+    """Full-buffer crc32 content fingerprint (zero-copy via memoryview).
+
+    ~0.2 s on a 512 MB block — negligible next to the multi-second transfer
+    it deduplicates, and unlike a sampled checksum it cannot false-hit on
+    blocks that differ only in unsampled regions."""
+    import zlib
+
+    raw = a if a.flags["C_CONTIGUOUS"] else np.ascontiguousarray(a)
+    return zlib.crc32(memoryview(raw).cast("B"))
 
 
 def place_rows_bucketed_cached(arr: np.ndarray,
                                mesh: Optional[Mesh] = None):
-    """(device_array, n_valid) for bucket+mesh padded ``arr``, cached on the
-    source array object so repeated placements of the same block are free."""
-    import weakref
-
+    """(device_array, n_valid) for bucket+mesh padded ``arr``, cached on a
+    content fingerprint of the source block so repeated placements of the
+    same data (even via a fresh equal-valued copy) are free."""
     mesh = mesh if mesh is not None else current_mesh()
     arr = np.asarray(arr)
-    key = (id(arr), arr.shape, str(arr.dtype), id(mesh))
+    key = (arr.shape, str(arr.dtype), _content_stamp(arr), id(mesh))
     hit = _PLACED_ROWS_CACHE.get(key)
-    if hit is not None and hit[0]() is not None:
-        return hit[1], hit[2]
+    if hit is not None:
+        return hit
     padded, n_valid = pad_rows_bucketed_for_mesh(arr)[0], arr.shape[0]
     placed = place_rows(padded, mesh)
-    try:
-        ref = weakref.ref(arr, lambda _ref, _k=key: _PLACED_ROWS_CACHE.pop(_k, None))
-    except TypeError:  # pragma: no cover - non-weakrefable input
-        ref = lambda: arr  # noqa: E731 - keep alive, never evict
-    _PLACED_ROWS_CACHE[key] = (ref, placed, n_valid)
+    _PLACED_ROWS_CACHE[key] = (placed, n_valid)
+    while len(_PLACED_ROWS_CACHE) > _PLACED_ROWS_CACHE_MAX:
+        _PLACED_ROWS_CACHE.pop(next(iter(_PLACED_ROWS_CACHE)))
     return placed, n_valid
