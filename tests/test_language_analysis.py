@@ -1,0 +1,196 @@
+"""Per-language text analysis tests (VERDICT r2 #3).
+
+Reference parity targets: optimaize LanguageDetector (70+ languages, wired
+through TextTokenizer.scala autoDetectLanguage) and the Lucene per-language
+analyzers (LuceneTextAnalyzer.scala:1-236 — stemming + per-language
+stopwords).  The fixture sentences below are DISJOINT from the seed texts
+the profiles were built from.
+"""
+
+import numpy as np
+
+from transmogrifai_tpu.data.dataset import Column
+from transmogrifai_tpu.utils.lang import (
+    LANGUAGES,
+    STEMMED_LANGUAGES,
+    analyzer_languages,
+    detect_language,
+    detect_language_scores,
+    stem,
+    stem_tokens,
+)
+from transmogrifai_tpu.utils.text import analyze
+
+# held-out sentences, one per language (not from SEED_TEXTS)
+FIXTURE = {
+    "en": "She walked slowly through the garden while birds were singing in the trees",
+    "es": "Los estudiantes llegaron temprano a la escuela porque tenían un examen importante",
+    "fr": "Les étudiants sont arrivés tôt à l'école parce qu'ils avaient un examen important",
+    "de": "Die Studenten kamen früh zur Schule weil sie eine wichtige Prüfung hatten",
+    "it": "Gli studenti sono arrivati presto a scuola perché avevano un esame importante",
+    "pt": "Os estudantes chegaram cedo à escola porque tinham uma prova importante",
+    "nl": "De studenten kwamen vroeg naar school omdat ze een belangrijk examen hadden",
+    "ru": "Студенты пришли в школу рано утром потому что у них был важный экзамен",
+    "uk": "Студенти прийшли до школи рано вранці тому що в них був важливий іспит",
+    "pl": "Studenci przyszli wcześnie do szkoły ponieważ mieli ważny egzamin",
+    "cs": "Studenti přišli do školy brzy protože měli důležitou zkoušku",
+    "ro": "Studenții au ajuns devreme la școală pentru că aveau un examen important",
+    "hu": "A diákok korán érkeztek az iskolába mert fontos vizsgájuk volt",
+    "fi": "Opiskelijat saapuivat kouluun aikaisin koska heillä oli tärkeä koe",
+    "sv": "Studenterna kom tidigt till skolan eftersom de hade ett viktigt prov",
+    "da": "Studerende kom tidligt i skole fordi de havde en vigtig eksamen",
+    "tr": "Öğrenciler o sabah önemli bir sınavları olduğu için okula erken geldiler",
+    "el": "Οι μαθητές έφτασαν νωρίς στο σχολείο γιατί είχαν μια σημαντική εξέταση",
+    "ar": "وصل الطلاب إلى المدرسة مبكرا لأنه كان لديهم امتحان مهم في ذلك الصباح",
+    "he": "התלמידים הגיעו מוקדם לבית הספר כי היה להם מבחן חשוב באותו בוקר",
+    "fa": "دانش‌آموزان صبح زود به مدرسه رسیدند زیرا آن روز امتحان مهمی داشتند",
+    "hi": "छात्र सुबह जल्दी स्कूल पहुंचे क्योंकि उस दिन उनकी एक महत्वपूर्ण परीक्षा थी",
+    "bn": "ছাত্ররা সকালে তাড়াতাড়ি স্কুলে পৌঁছেছিল কারণ সেদিন তাদের একটি পরীক্ষা ছিল",
+    "zh": "学生们那天早上很早就到了学校因为他们有一场重要的考试",
+    "ja": "学生たちはその朝重要な試験があったので早く学校に着きました",
+    "ko": "학생들은 그날 아침 중요한 시험이 있어서 학교에 일찍 도착했습니다",
+    "th": "นักเรียนมาถึงโรงเรียนแต่เช้าเพราะมีสอบสำคัญในเช้าวันนั้น",
+    "vi": "Các học sinh đến trường sớm vì sáng hôm đó họ có một kỳ thi quan trọng",
+    "id": "Para siswa tiba di sekolah lebih awal karena mereka memiliki ujian penting",
+    "sw": "Wanafunzi walifika shuleni mapema kwa sababu walikuwa na mtihani muhimu",
+}
+
+
+class TestLanguageDetection:
+    def test_covers_30_languages(self):
+        assert len(LANGUAGES) >= 30
+        assert set(FIXTURE) <= set(LANGUAGES)
+
+    def test_detection_accuracy_on_heldout_fixture(self):
+        """≥90% accuracy over ≥10 languages (VERDICT r2 #3 Done criterion) —
+        the fixture actually covers 30+, and must hit ≥90% across ALL."""
+        correct = sum(1 for lang, s in FIXTURE.items()
+                      if detect_language(s) == lang)
+        acc = correct / len(FIXTURE)
+        assert len(FIXTURE) >= 10
+        assert acc >= 0.9, (
+            f"accuracy {acc:.2f}: "
+            f"{[(l, detect_language(s)) for l, s in FIXTURE.items() if detect_language(s) != l]}")
+
+    def test_scores_normalized_and_ranked(self):
+        scores = detect_language_scores(FIXTURE["fr"])
+        assert abs(sum(scores.values()) - 1.0) < 1e-9
+        assert max(scores, key=scores.get) == "fr"
+
+    def test_script_decided_languages_are_confident(self):
+        for lang in ("ru", "el", "ar", "he", "fa", "hi", "th", "zh", "ja", "ko"):
+            scores = detect_language_scores(FIXTURE[lang])
+            assert max(scores.values()) > 0.5, (lang, scores)
+
+    def test_empty_and_junk(self):
+        assert detect_language("") == "unknown"
+        assert detect_language(None) == "unknown"
+        assert detect_language("12345 !!! ???") == "unknown"
+
+
+class TestStemmers:
+    def test_ten_languages_have_stemmers(self):
+        assert len(STEMMED_LANGUAGES) >= 10
+        assert len(analyzer_languages()) >= 10
+
+    def test_english_porter_lite(self):
+        cases = {"running": "run", "flies": "fli", "happiness": "happi",
+                 "nationalization": "nationalize", "cats": "cat",
+                 "hopeful": "hope", "relational": "relate"}
+        for w, expect in cases.items():
+            assert stem(w, "en") == expect, (w, stem(w, "en"))
+
+    def test_inflections_collapse(self):
+        """Morphological variants must map to one stem per language — the
+        property that makes stemmed hash features merge buckets."""
+        groups = {
+            "es": ["corriendo", "correr"],            # running / to run
+            "fr": ["lumières", "lumière"],            # lights / light
+            "de": ["wichtige", "wichtigen"],          # important (infl.)
+            "it": ["importante", "importanti"],
+            "pt": ["chegando", "chegar"],
+            "ru": ["важный", "важного"],
+            "sv": ["viktiga", "viktig"],
+            "fi": ["koulussa", "koulu"],              # in school / school
+            "nl": ["lichten", "licht"],
+        }
+        for lang, words in groups.items():
+            stems = {stem(w.lower(), lang) for w in words}
+            assert len(stems) == 1, (lang, words, stems)
+
+    def test_unknown_language_is_identity(self):
+        assert stem("palabra", "xx") == "palabra"
+        assert stem_tokens(["a", "b"], "zz") == ["a", "b"]
+
+
+class TestLanguageAwareAnalyze:
+    def test_auto_detects_and_stems_non_english(self):
+        toks = analyze("las luces de la ciudad se apagaban lentamente",
+                       remove_stop_words=True)
+        # es stopwords removed, remaining tokens stemmed
+        assert "las" not in toks and "de" not in toks
+        assert "luc" in toks or "luce" in toks, toks
+
+    def test_english_not_stemmed_by_default(self):
+        toks = analyze("the lights of the city were fading slowly")
+        assert "lights" in toks  # Lucene StandardAnalyzer semantics: no stem
+
+    def test_short_english_rows_never_mangled(self):
+        """Short rows misdetect easily ('hello' -> nl); auto-stemming must
+        not apply a wrong-language stemmer to them (code-review r3)."""
+        for s in ("Payment failed please retry", "Server error occurred",
+                  "hello", "OK thanks", "restart the server now"):
+            assert analyze(s) == analyze(s, language="en"), s
+
+    def test_always_stems_english(self):
+        toks = analyze("the lights were fading", stemming="always")
+        assert "light" in toks and "fade" in toks, toks
+
+
+class TestSmartTextLanguageAware:
+    def _features(self, rows, **params):
+        from transmogrifai_tpu.ops.text_smart import SmartTextVectorizer
+        from transmogrifai_tpu.testkit.builder import TestFeatureBuilder
+        from transmogrifai_tpu.types import Text
+
+        f, ds = TestFeatureBuilder.of("t", Text, rows)
+        stage = SmartTextVectorizer(num_hashes=64, min_support=1, top_k=2,
+                                    max_cardinality=2, **params)
+        stage.set_input(f)
+        model = stage.fit(ds)
+        return model, np.asarray(model.transform(ds)[model.output_name].data)
+
+    def test_spanish_column_uses_stemmed_analyzer(self):
+        """Stemming must CHANGE the hash features for es/fr/de inputs
+        (VERDICT r2 #3 Done criterion): inflected variants land in the same
+        bucket only under the language-aware analyzer."""
+        rows = ["las luces brillando en la ciudad",
+                "la luz brillante de las ciudades",
+                "corriendo por las calles corría",
+                "los niños corren por la calle"] * 2
+        model_auto, block_auto = self._features(rows)
+        model_en, block_en = self._features(rows, language="en")
+        assert model_auto.languages and model_auto.languages[0] == "es"
+        assert model_en.languages[0] == "en"
+        # the stemmed analyzer merges inflections -> different hash layout
+        assert block_auto.shape == block_en.shape
+        assert not np.allclose(block_auto, block_en), \
+            "es analyzer must change SmartText hash features"
+
+    def test_english_column_unchanged_by_language_analysis(self):
+        """English keeps the fused native path — features identical to a
+        forced-en model (backward compatibility of hash layouts)."""
+        rows = ["the quick brown fox jumps over the lazy dog tonight",
+                "a slow green turtle walks under the busy bridge today"] * 3
+        model_auto, block_auto = self._features(rows)
+        model_en, block_en = self._features(rows, language="en")
+        assert model_auto.languages[0] == "en"
+        np.testing.assert_array_equal(block_auto, block_en)
+
+    def test_serde_roundtrip_keeps_languages(self):
+        from transmogrifai_tpu.testkit.specs import _roundtrip
+
+        rows = ["las luces brillando en la ciudad de noche hermosa"] * 4
+        model, block = self._features(rows)
+        restored = _roundtrip(model)
+        assert restored.languages == model.languages

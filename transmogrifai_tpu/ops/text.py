@@ -24,21 +24,18 @@ from ..stages.base import (
 )
 from ..types import Integral, MultiPickList, OPVector, RealMap, RealNN, Text, TextList
 from ..native import hash_count_block
-from ..utils.text import (
-    char_ngrams,
-    detect_language,
-    ngrams,
-    stop_words_for,
-    tokenize,
-)
+from ..utils.text import char_ngrams, ngrams, stop_words_for
 from ..utils.vector_metadata import NULL_INDICATOR, VectorColumnMetadata, VectorMetadata
 
 
 class TextTokenizer(UnaryTransformer):
-    """Text -> TextList with optional language auto-detection (TextTokenizer.scala).
+    """Text -> TextList with language auto-detection, per-language stopwords
+    and Snowball-style stemming (TextTokenizer.scala + LuceneTextAnalyzer).
 
-    ``language='auto'`` detects per row and applies that language's stop list when
-    ``remove_stop_words`` is on (reference: LangDetector + per-language Lucene analyzer).
+    ``language='auto'`` detects per row (30+ language n-gram profiles);
+    ``stemming='auto'`` stems every language with a language-specific
+    analyzer except English — exactly Lucene's analyzer inventory semantics
+    (the default English pipeline is the non-stemming StandardAnalyzer).
     """
 
     input_types = (Text,)
@@ -48,18 +45,18 @@ class TextTokenizer(UnaryTransformer):
     min_token_length = Param(default=1)
     remove_stop_words = Param(default=False)
     language = Param(default="auto")
+    stemming = Param(default="auto", doc="auto | always | never")
 
     def transform_columns(self, cols: List[Column], dataset) -> Column:
+        from ..utils.text import analyze
+
         out = np.empty(len(cols[0]), dtype=object)
-        fixed_lang = None if self.language == "auto" else self.language
         for i, v in enumerate(cols[0].data):
-            toks = tokenize(v, to_lowercase=self.to_lowercase,
-                            min_token_length=self.min_token_length)
-            if self.remove_stop_words and toks:
-                lang = fixed_lang or detect_language(v)
-                stops = stop_words_for(lang)
-                toks = [t for t in toks if t.lower() not in stops]
-            out[i] = toks
+            out[i] = analyze(
+                v, language=self.language, to_lowercase=self.to_lowercase,
+                min_token_length=self.min_token_length,
+                remove_stop_words=self.remove_stop_words,
+                stemming=self.stemming)
         return Column(TextList, out)
 
 

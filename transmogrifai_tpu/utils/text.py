@@ -55,61 +55,66 @@ def char_ngrams(text: str, n: int = 3) -> List[str]:
     return [text[i:i + n] for i in range(len(text) - n + 1)]
 
 
-# per-language stopword cores for the frequency-overlap language heuristic
-# (reference uses the optimaize LanguageDetector; this is the same signal reduced
-# to the highest-frequency function words)
-_LANG_STOPWORDS = {
-    "en": frozenset("the and of to in is you that it he was for on are as with his "
-                    "they at be this have from or had by not but what all were we "
-                    "when your can said there use an each which she do how their "
-                    "if will up other about out many then them these so some her "
-                    "would make like him into time has look two more".split()),
-    "es": frozenset("de la que el en y a los del se las por un para con no una su "
-                    "al lo como más pero sus le ya o este sí porque esta entre "
-                    "cuando muy sin sobre también me hasta hay donde quien desde "
-                    "todo nos durante todos uno les ni contra otros".split()),
-    "fr": frozenset("de la le et les des en un du une que est pour qui dans a par "
-                    "plus pas au sur ne se ce il sont la avec son au ses mais "
-                    "comme ou si leur y dont elle deux ses tout nous sa".split()),
-    "de": frozenset("der die und in den von zu das mit sich des auf für ist im dem "
-                    "nicht ein eine als auch es an werden aus er hat dass sie nach "
-                    "wird bei einer um am sind noch wie einem über einen so zum".split()),
-}
+# Language identification, per-language stopwords, and stemming live in
+# utils/lang.py (30+ language char-n-gram profiles, 10 Snowball-style
+# stemmers — the optimaize LanguageDetector + LuceneTextAnalyzer roles).
+from .lang import (  # noqa: E402, F401 — re-exported public surface
+    LANGUAGES,
+    STEMMED_LANGUAGES,
+    analyzer_languages,
+    detect_language,
+    detect_language_scores,
+    stem,
+    stem_tokens,
+    stop_words_for,
+)
 
 
-def detect_language(text: Optional[str]) -> str:
-    """Best-effort language id by stop-word overlap; 'unknown' when no signal."""
+def analyze(
+    text: Optional[str],
+    language: str = "auto",
+    to_lowercase: bool = True,
+    min_token_length: int = MIN_TOKEN_LENGTH,
+    remove_stop_words: bool = False,
+    stemming: str = "auto",
+) -> List[str]:
+    """Language-aware analysis: tokenize + per-language stopwords + stemming
+    (the LuceneTextAnalyzer per-language analyzer role, TextTokenizer.scala).
+
+    ``language='auto'`` detects per input.  ``stemming`` mirrors Lucene's
+    analyzer inventory semantics: ``'auto'`` stems every language that has a
+    language-specific analyzer EXCEPT English (Lucene's default English
+    pipeline is the non-stemming StandardAnalyzer, so English hash features
+    stay stable); ``'always'`` also applies the English Porter-lite pass;
+    ``'never'`` disables stemming.
+    """
     if not text:
-        return "unknown"
-    tokens = set(_TOKEN_RE.findall(text.lower()))
-    if not tokens:
-        return "unknown"
-    best, best_score = "unknown", 0
-    for lang, stops in _LANG_STOPWORDS.items():
-        score = len(tokens & stops)
-        if score > best_score:
-            best, best_score = lang, score
-    return best
+        return []
+    tokens = tokenize(text, to_lowercase=to_lowercase,
+                      min_token_length=min_token_length)
+    wants_stem = stemming in ("always", "auto")
+    if not (remove_stop_words or wants_stem):
+        return tokens  # nothing downstream reads the language — skip detect
 
-
-def detect_language_scores(text: Optional[str]) -> dict:
-    """Per-language confidence map (reference LanguageDetector.detectLanguages
-    returns language -> confidence).  Scores are stop-word-overlap fractions
-    normalized to sum to 1 over languages with any signal; empty when none."""
-    if not text:
-        return {}
-    tokens = set(_TOKEN_RE.findall(text.lower()))
-    if not tokens:
-        return {}
-    raw = {lang: len(tokens & stops) for lang, stops in _LANG_STOPWORDS.items()}
-    total = sum(raw.values())
-    if total == 0:
-        return {}
-    return {lang: c / total for lang, c in raw.items() if c > 0}
-
-
-def stop_words_for(language: str) -> frozenset:
-    return _LANG_STOPWORDS.get(language, STOP_WORDS)
+    if language != "auto":
+        lang, confident = language, True
+    else:
+        # Short rows carry too little n-gram signal to trust a non-English
+        # analyzer: a misdetected 'sv'/'nl' stemmer would silently mangle
+        # English tokens ("Server error" -> "serv err").  Auto-stemming
+        # requires a confident detection over enough text; stopword removal
+        # uses the detected language either way (en fallback is harmless).
+        scores = detect_language_scores(text)
+        lang = max(scores, key=scores.get) if scores else "unknown"
+        confident = (bool(scores) and scores[lang] >= 0.55
+                     and len(text) >= 24)
+    if remove_stop_words and tokens:
+        stops = stop_words_for(lang)
+        tokens = [t for t in tokens if t.lower() not in stops]
+    if stemming == "always" or (stemming == "auto" and confident
+                                and lang != "en"):
+        tokens = stem_tokens(tokens, lang)
+    return tokens
 
 
 _ABBREVIATIONS = frozenset({
