@@ -70,6 +70,46 @@ def word_shape(tok: str) -> str:
     return "".join(out)
 
 
+#: role titles that precede a Person the way honorifics do ("Inspector
+#: Valdez", "Secretary Hammond") — dictionary feature, not a tag by itself
+ROLE_TITLES = frozenset({
+    "secretary", "inspector", "captain", "professor", "sergeant", "maestro",
+    "madame", "miss", "uncle", "aunt", "grandmother", "grandfather",
+    "councilman", "councilwoman", "senator", "governor", "mayor", "judge",
+    "general", "colonel", "lieutenant", "detective", "officer", "president",
+    "chairman", "chairwoman", "minister", "ambassador", "bishop", "father",
+    "sister", "brother", "coach", "principal", "dean", "reverend",
+})
+
+
+def _dictionary_feats(low: str) -> List[str]:
+    """Gazetteer-membership features (the OpenNLP dictionary-feature role):
+    the model learns how much to trust each list from data."""
+    from .ner import (_CITIES, _COUNTRIES, _FIRST_NAMES, _HONORIFICS,
+                      _MONTHS, _ORG_SUFFIXES, _STATES, _WEEKDAYS)
+
+    feats = []
+    if low in _MONTHS:
+        feats.append("dict=month")
+    if low in _WEEKDAYS:
+        feats.append("dict=weekday")
+    if low in _CITIES:
+        feats.append("dict=city")
+    if low in _COUNTRIES:
+        feats.append("dict=country")
+    if low in _STATES:
+        feats.append("dict=state")
+    if low in _ORG_SUFFIXES:
+        feats.append("dict=orgsuf")
+    if low in _FIRST_NAMES:
+        feats.append("dict=firstname")
+    if low in _HONORIFICS:
+        feats.append("dict=honorific")
+    if low in ROLE_TITLES:
+        feats.append("dict=role")
+    return feats
+
+
 def token_features(tokens: Sequence[str], i: int, prev_tag: str) -> List[str]:
     """Feature strings for token i (shared by training and inference)."""
     w = tokens[i]
@@ -89,6 +129,11 @@ def token_features(tokens: Sequence[str], i: int, prev_tag: str) -> List[str]:
         f"w+next={low}|{nxt_low}",
         f"prev+w={prev_low}|{low}",
     ]
+    feats.extend(_dictionary_feats(low))
+    for df in _dictionary_feats(prev_low):
+        feats.append(f"prev{df}")
+    for df in _dictionary_feats(nxt_low):
+        feats.append(f"next{df}")
     if i == 0:
         feats.append("bos")
     if w[:1].isupper():
