@@ -1,0 +1,167 @@
+"""MIME detection fixture parity (VERDICT r2 #8).
+
+Reference: MimeTypeDetector.scala wraps Tika's magic-byte database.  This
+fixture builds 50+ files in memory — real headers, real zip containers for
+the OOXML/ODF/epub family — and asserts the detected type for each.
+"""
+
+import base64
+import io
+import struct
+import zipfile
+
+import pytest
+
+from transmogrifai_tpu.ops.domains import detect_mime_type
+
+
+def _b64(data: bytes) -> str:
+    return base64.b64encode(data).decode("ascii")
+
+
+def _zip_with(names, mimetype_literal=None) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_STORED) as z:
+        if mimetype_literal is not None:
+            z.writestr("mimetype", mimetype_literal)
+        for name in names:
+            z.writestr(name, b"x" * 16)
+    return buf.getvalue()
+
+
+def _ooxml(prefix: str) -> bytes:
+    return _zip_with(["[Content_Types].xml", f"{prefix}/document.xml"])
+
+
+def _riff(subtype: bytes) -> bytes:
+    return b"RIFF" + struct.pack("<I", 36) + subtype + b"\x00" * 24
+
+
+def _ftyp(brand: bytes) -> bytes:
+    return struct.pack(">I", 24) + b"ftyp" + brand.ljust(4, b"\x00") + b"\x00" * 12
+
+
+def _tar() -> bytes:
+    block = bytearray(512)
+    block[0:4] = b"file"
+    block[257:262] = b"ustar"
+    return bytes(block) + b"\x00" * 512
+
+
+# (label, raw bytes, expected mime)
+FIXTURE = [
+    # images (10)
+    ("png", b"\x89PNG\r\n\x1a\n" + b"\x00" * 16, "image/png"),
+    ("jpeg", b"\xff\xd8\xff\xe0" + b"\x00" * 16, "image/jpeg"),
+    ("gif87", b"GIF87a" + b"\x00" * 10, "image/gif"),
+    ("gif89", b"GIF89a" + b"\x00" * 10, "image/gif"),
+    ("bmp", b"BM" + b"\x00" * 20, "image/bmp"),
+    ("tiff-le", b"II*\x00" + b"\x00" * 12, "image/tiff"),
+    ("tiff-be", b"MM\x00*" + b"\x00" * 12, "image/tiff"),
+    ("ico", b"\x00\x00\x01\x00\x01\x00" + b"\x00" * 12,
+     "image/vnd.microsoft.icon"),
+    ("psd", b"8BPS\x00\x01" + b"\x00" * 12, "image/vnd.adobe.photoshop"),
+    ("webp", _riff(b"WEBP"), "image/webp"),
+    # modern image containers (3)
+    ("heic", _ftyp(b"heic"), "image/heic"),
+    ("avif", _ftyp(b"avif"), "image/avif"),
+    ("svg", b'<?xml version="1.0"?>\n<svg xmlns="a"></svg>', "image/svg+xml"),
+    # audio (8)
+    ("wav", _riff(b"WAVE"), "audio/wav"),
+    ("ogg", b"OggS" + b"\x00" * 16, "audio/ogg"),
+    ("mp3-id3", b"ID3\x03" + b"\x00" * 16, "audio/mpeg"),
+    ("mp3-frame", b"\xff\xfb\x90" + b"\x00" * 16, "audio/mpeg"),
+    ("flac", b"fLaC" + b"\x00" * 16, "audio/x-flac"),
+    ("midi", b"MThd" + b"\x00" * 16, "audio/midi"),
+    ("amr", b"#!AMR\n" + b"\x00" * 8, "audio/amr"),
+    ("m4a", _ftyp(b"M4A "), "audio/mp4"),
+    # video (8)
+    ("mp4", _ftyp(b"isom"), "video/mp4"),
+    ("mov", _ftyp(b"qt  "), "video/quicktime"),
+    ("3gp", _ftyp(b"3gp5"), "video/3gpp"),
+    ("mkv", b"\x1aE\xdf\xa3" + b"\x00" * 16, "video/x-matroska"),
+    ("avi", _riff(b"AVI "), "video/x-msvideo"),
+    ("flv", b"FLV\x01" + b"\x00" * 12, "video/x-flv"),
+    ("mpeg", b"\x00\x00\x01\xba" + b"\x00" * 12, "video/mpeg"),
+    ("asf", b"0&\xb2u\x8ef\xcf\x11" + b"\x00" * 8, "video/x-ms-asf"),
+    # archives (10)
+    ("zip", _zip_with(["a.txt"]), "application/zip"),
+    ("gzip", b"\x1f\x8b\x08" + b"\x00" * 12, "application/gzip"),
+    ("bzip2", b"BZh9" + b"\x00" * 12, "application/x-bzip2"),
+    ("xz", b"\xfd7zXZ\x00" + b"\x00" * 10, "application/x-xz"),
+    ("7z", b"7z\xbc\xaf\x27\x1c" + b"\x00" * 10,
+     "application/x-7z-compressed"),
+    ("rar", b"Rar!\x1a\x07\x00" + b"\x00" * 10,
+     "application/x-rar-compressed"),
+    ("zstd", b"\x28\xb5\x2f\xfd" + b"\x00" * 10, "application/zstd"),
+    ("cab", b"MSCF\x00\x00" + b"\x00" * 10,
+     "application/vnd.ms-cab-compressed"),
+    ("lz4", b"\x04\x22\x4d\x18" + b"\x00" * 10, "application/x-lz4"),
+    ("tar", _tar(), "application/x-tar"),
+    # documents (9)
+    ("pdf", b"%PDF-1.7\n" + b"\x00" * 8, "application/pdf"),
+    ("postscript", b"%!PS-Adobe-3.0\n", "application/postscript"),
+    ("rtf", b"{\\rtf1\\ansi hello}", "application/rtf"),
+    ("docx", _ooxml("word"),
+     "application/vnd.openxmlformats-officedocument.wordprocessingml.document"),
+    ("xlsx", _ooxml("xl"),
+     "application/vnd.openxmlformats-officedocument.spreadsheetml.sheet"),
+    ("pptx", _ooxml("ppt"),
+     "application/vnd.openxmlformats-officedocument.presentationml.presentation"),
+    ("odt", _zip_with(["content.xml"],
+                      "application/vnd.oasis.opendocument.text"),
+     "application/vnd.oasis.opendocument.text"),
+    ("epub", _zip_with(["OEBPS/content.opf"], "application/epub+zip"),
+     "application/epub+zip"),
+    ("ole2", b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1" + b"\x00" * 16,
+     "application/x-ole-storage"),
+    # fonts (4)
+    ("ttf", b"\x00\x01\x00\x00\x00\x0c" + b"\x00" * 10, "font/ttf"),
+    ("otf", b"OTTO\x00\x0c" + b"\x00" * 10, "font/otf"),
+    ("woff", b"wOFF\x00\x01" + b"\x00" * 10, "font/woff"),
+    ("woff2", b"wOF2\x00\x01" + b"\x00" * 10, "font/woff2"),
+    # executables (5)
+    ("elf", b"\x7fELF\x02\x01" + b"\x00" * 10, "application/x-executable"),
+    ("pe", b"MZ\x90\x00" + b"\x00" * 12, "application/x-msdownload"),
+    ("class", b"\xca\xfe\xba\xbe\x00\x00\x00\x34" + b"\x00" * 8,
+     "application/java-vm"),
+    ("wasm", b"\x00asm\x01\x00\x00\x00", "application/wasm"),
+    ("macho", b"\xcf\xfa\xed\xfe" + b"\x00" * 12, "application/x-mach-binary"),
+    # data / text (7)
+    ("sqlite", b"SQLite format 3\x00" + b"\x00" * 8,
+     "application/x-sqlite3"),
+    ("parquet", b"PAR1" + b"\x00" * 12, "application/x-parquet"),
+    ("avro", b"Obj\x01" + b"\x00" * 12, "application/avro"),
+    ("xml", b'<?xml version="1.0"?><root/>', "application/xml"),
+    ("html", b"<!DOCTYPE html><html></html>", "text/html"),
+    ("json", b'{"a": 1}', "application/json"),
+    ("shellscript", b"#!/bin/sh\necho hi\n", "text/x-shellscript"),
+    ("text", b"plain old prose, nothing else", "text/plain"),
+]
+
+
+class TestMimeFixture:
+    def test_fixture_has_50_plus_files(self):
+        assert len(FIXTURE) >= 50
+
+    @pytest.mark.parametrize("label,data,expected",
+                             FIXTURE, ids=[f[0] for f in FIXTURE])
+    def test_detects(self, label, data, expected):
+        assert detect_mime_type(_b64(data)) == expected, label
+
+    def test_invalid_and_empty(self):
+        assert detect_mime_type(None) is None
+        assert detect_mime_type("") is None
+        assert detect_mime_type("!!!notbase64!!!") is None
+
+    def test_binary_fallback(self):
+        assert detect_mime_type(_b64(b"\x01\x02\x03\xfe\xff" * 4)) == \
+            "application/octet-stream"
+
+    def test_plain_zip_with_ooxml_like_names_stays_zip(self):
+        """Entry names merely CONTAINING 'word/' etc. must not flip a plain
+        zip to an Office type (code-review r3: anchored name parsing)."""
+        for name in ("crossword/clues.txt", "pixxl/data.bin",
+                     "apppt/notes.md"):
+            assert detect_mime_type(_b64(_zip_with([name]))) == \
+                "application/zip", name
