@@ -31,6 +31,18 @@ from .prediction import PredictionColumn
 MAX_ITER_DEFAULT = 30
 
 
+def _mxu_dtype():
+    """MXU input dtype for the Hessian matmul: bf16 on TPU (f32 accumulation),
+    f32 elsewhere so CPU tests stay exact.
+
+    Safe because only the HESSIAN goes through bf16 — the gradient stays f32,
+    so Newton's fixed point (g(beta*) = 0) is bit-identical; bf16 curvature
+    error only perturbs the convergence path (quasi-Newton), not the solution
+    a converged fit returns.  Same rationale as the tree kernels' _hist_dtype.
+    """
+    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
+
 @partial(jax.jit, static_argnames=("max_iter", "has_intercept"))
 def _irls_core(x: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, reg: jnp.ndarray,
                max_iter: int, has_intercept: bool = True) -> jnp.ndarray:
@@ -39,19 +51,44 @@ def _irls_core(x: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, reg: jnp.ndarray,
     x: (n, d[+1]) — trailing ones column when ``has_intercept``; returns beta.
     Objective: (1/sum_w) Σ w_i logloss_i + reg/2 ||beta_penalized||²
     (Spark-style averaged loss; the intercept slot is never penalized).
+
+    TPU-first Hessian: with an intercept the augmented design is (n, d+1) and
+    an odd d+1 (129 for the canonical post-transmogrify d=128) pads to two
+    128-lane MXU tiles with half the lanes idle.  Instead the Hessian is
+    assembled as a BORDERED system — the O(n·d²) matmul runs on the clean
+    (n, d) feature block (full tiles, bf16-in/f32-accum on TPU), and the
+    intercept row/column are O(n·d) matvec borders:
+
+        H = [[Xᵀ S X,  Xᵀ s],
+             [sᵀ X,    Σ s ]] / sw + diag(reg·mask)
     """
     n, d1 = x.shape
     sw = jnp.maximum(w.sum(), 1e-12)
     reg_mask = jnp.ones(d1)
     if has_intercept:
         reg_mask = reg_mask.at[-1].set(0.0)  # don't regularize intercept
+    xf = x[:, :-1] if has_intercept else x   # (n, d) MXU-friendly block
+    md = _mxu_dtype()
 
     def step(_, beta):
         z = x @ beta
         p = jax.nn.sigmoid(z)
         g = x.T @ (w * (p - y)) / sw + reg * reg_mask * beta
         s = jnp.maximum(w * p * (1.0 - p), 1e-10)
-        h = (x.T * s) @ x / sw + jnp.diag(reg * reg_mask + 1e-8)
+        sx = xf * s[:, None]
+        hxx = jax.lax.dot_general(                      # (d, d) f32-accum
+            xf.T.astype(md), sx.astype(md), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if has_intercept:
+            hxb = sx.sum(axis=0)                        # Xᵀ S 1 border
+            hbb = s.sum()[None]
+            h = jnp.concatenate([
+                jnp.concatenate([hxx, hxb[:, None]], axis=1),
+                jnp.concatenate([hxb, hbb])[None, :],
+            ], axis=0)
+        else:
+            h = hxx
+        h = h / sw + jnp.diag(reg * reg_mask + 1e-8)
         return beta - jnp.linalg.solve(h, g)
 
     beta0 = jnp.zeros(d1, dtype=x.dtype)
