@@ -229,12 +229,13 @@ def bench_tree_hist(n_rows: int, device_kind: str):
             jnp.float32(1.0), jnp.float32(0.3), jnp.float32(0.0))
         return tree.value.sum() + node.sum()
 
-    grow(binned, grad, hess).block_until_ready()  # compile + warm
+    np.asarray(grow(binned, grad, hess))  # compile + warm (full host sync —
+    # block_until_ready does not reliably drain the remote-transport queue)
     reps = 3
     t0 = time.perf_counter()
     for _ in range(reps):
         out = grow(binned, grad, hess)
-    out.block_until_ready()
+    np.asarray(out)  # one sync for the whole in-order queue
     dt = (time.perf_counter() - t0) / reps
 
     bytes_moved = 2.0 * max_depth * n_rows * D * 4 + 3.0 * n_rows * 2 * K * 4

@@ -1,0 +1,54 @@
+"""Run the secondary benchmarks and record their JSON lines as a
+driver-checkable artifact (VERDICT r2 #7): BENCH_extras_r{N}.json.
+
+Usage:  python benchmarks/run_extras.py [round_number]
+Writes BENCH_extras_r{NN}.json at the repo root with one entry per script.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+SCRIPTS = ["full_pipeline_1m.py", "wide_sparse_10k.py",
+           "local_scoring_latency.py"]
+
+
+def main() -> int:
+    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    out = {}
+    for script in SCRIPTS:
+        t0 = time.time()
+        r = subprocess.run([sys.executable, os.path.join(HERE, script)],
+                           capture_output=True, text=True, timeout=3600,
+                           cwd=ROOT)
+        line = None
+        for ln in reversed(r.stdout.strip().splitlines()):
+            try:
+                line = json.loads(ln)
+                break
+            except json.JSONDecodeError:
+                continue
+        out[script] = {
+            "rc": r.returncode,
+            "seconds": round(time.time() - t0, 1),
+            "result": line,
+            **({} if r.returncode == 0 else
+               {"stderr_tail": r.stderr[-1500:]}),
+        }
+        print(f"{script}: rc={r.returncode} {line}")
+    path = os.path.join(ROOT, f"BENCH_extras_r{rnd:02d}.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=2)
+    print("wrote", path)
+    return 0 if all(v["rc"] == 0 for v in out.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -165,13 +165,40 @@ def _fista_sweep(x, y, train_w, l1s, l2s, max_iter, has_intercept: bool = True):
     return fit_grid(l1s, l2s)
 
 
-def _standardize(x: np.ndarray, w: np.ndarray):
-    sw = max(float(w.sum()), 1e-12)
-    mean = (w[:, None] * x).sum(axis=0) / sw
-    var = (w[:, None] * (x - mean) ** 2).sum(axis=0) / sw
-    std = np.sqrt(var)
-    std = np.where(std < 1e-12, 1.0, std)
-    return mean.astype(np.float32), std.astype(np.float32)
+@partial(jax.jit, static_argnames=("has_intercept", "standardize"))
+def _device_prepare_fit(x, w, has_intercept: bool, standardize: bool):
+    """WEIGHTED standardize + ones-append for a final fit, on device from the
+    shared raw placement (padded rows carry w=0, so the moments are exact).
+    Returns (xs, mean, std) — mean/std come back to host only as (d,) vectors,
+    instead of shipping a fresh standardized (n, d) block up the transport.
+    """
+    sw = jnp.maximum(w.sum(), 1e-12)
+    if standardize:
+        mean = (w[:, None] * x).sum(axis=0) / sw
+        var = (w[:, None] * (x - mean) ** 2).sum(axis=0) / sw
+        std = jnp.sqrt(var)
+        std = jnp.where(std < 1e-12, 1.0, std)
+    else:
+        mean = jnp.zeros(x.shape[1], x.dtype)
+        std = jnp.ones(x.shape[1], x.dtype)
+    xs = (x - mean) / std
+    if has_intercept:
+        xs = jnp.concatenate([xs, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
+    return xs, mean, std
+
+
+def place_fit_arrays(x, y, w):
+    """(xd, yd, wd) for a final fit: raw block through the shared placement
+    cache (a refit after CV hits the block the sweep already transferred),
+    labels/weights zero-padded to match."""
+    from ..parallel.mesh import place_rows_bucketed_cached
+
+    x32 = np.asarray(x, np.float32)
+    xd, n0 = place_rows_bucketed_cached(x32)
+    pad = int(xd.shape[0]) - n0
+    yd = jnp.asarray(np.pad(np.asarray(y, np.float32), (0, pad)))
+    wd = jnp.asarray(np.pad(np.asarray(w, np.float32), (0, pad)))
+    return xd, yd, wd
 
 
 @partial(jax.jit, static_argnames=("has_intercept", "standardize"))
@@ -179,7 +206,7 @@ def _device_prepare(x, n_valid, has_intercept: bool, standardize: bool):
     """Standardize + ones-append ON DEVICE from the shared raw placement.
 
     ``x`` is zero-row-padded past ``n_valid``; the explicit row mask keeps the
-    moments exact (matches the host _standardize with unit weights).  Padded
+    moments exact (unit-weight standardization, row-mask form).  Padded
     rows end up at (-mean/std) but always carry zero fold weights downstream.
     """
     n = x.shape[0]
@@ -214,17 +241,6 @@ class LogisticRegression(PredictionEstimatorBase):
         en = self.elastic_net if elastic_net is None else elastic_net
         return float(rp) * (1.0 - float(en))
 
-    def _prepare(self, x: np.ndarray, w: np.ndarray):
-        if self.standardize:
-            mean, std = _standardize(x, w)
-        else:
-            mean = np.zeros(x.shape[1], dtype=np.float32)
-            std = np.ones(x.shape[1], dtype=np.float32)
-        xs = (x - mean) / std
-        if self.fit_intercept:
-            xs = np.hstack([xs, np.ones((x.shape[0], 1), dtype=np.float32)])
-        return xs.astype(np.float32), mean, std
-
     def _finalize_beta(self, beta: np.ndarray, mean: np.ndarray, std: np.ndarray):
         """Fold standardization back into raw-space coefficients + intercept."""
         if self.fit_intercept:
@@ -236,25 +252,26 @@ class LogisticRegression(PredictionEstimatorBase):
         return coef.astype(np.float64), intercept
 
     def _fit_arrays(self, x, y, w):
-        from ..parallel.mesh import pad_rows_to_bucket
-
-        xs, mean, std = self._prepare(x, w)
-        xs_b, y_b, w_b = pad_rows_to_bucket(xs.shape[0], xs, y, w)
+        xd, yd, wd = place_fit_arrays(x, y, w)
+        xs, mean_d, std_d = _device_prepare_fit(
+            xd, wd, has_intercept=bool(self.fit_intercept),
+            standardize=bool(self.standardize))
         l1 = float(self.reg_param) * float(self.elastic_net)
         if l1 > 0.0:
             # exact composite objective (Spark OWL-QN role): FISTA prox loop
             l2 = float(self.reg_param) * (1.0 - float(self.elastic_net))
             beta = np.asarray(_fista_elastic(
-                jnp.asarray(xs_b), jnp.asarray(y_b), jnp.asarray(w_b),
+                xs, yd, wd,
                 jnp.float32(l1), jnp.float32(l2), max(10 * self.max_iter, 300),
                 has_intercept=bool(self.fit_intercept)))
         else:
             beta = np.asarray(_irls_core(
-                jnp.asarray(xs_b), jnp.asarray(y_b), jnp.asarray(w_b),
+                xs, yd, wd,
                 jnp.float32(self._effective_reg()), self.max_iter,
                 has_intercept=bool(self.fit_intercept),
             ))
-        coef, intercept = self._finalize_beta(beta, mean, std)
+        coef, intercept = self._finalize_beta(
+            beta, np.asarray(mean_d), np.asarray(std_d))
         return LogisticRegressionModel(coef=coef, intercept=intercept)
 
     # --- device CV sweep ------------------------------------------------------

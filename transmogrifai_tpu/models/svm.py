@@ -21,7 +21,7 @@ import numpy as np
 from ..data.dataset import Column
 from ..stages.base import Param
 from .base import PredictionEstimatorBase, PredictionModelBase
-from .logistic import _standardize
+from .logistic import _device_prepare_fit, place_fit_arrays  # noqa: F401
 from .prediction import PredictionColumn
 
 
@@ -96,20 +96,16 @@ class LinearSVC(PredictionEstimatorBase):
     sweepable_params = ("reg_param",)
 
     def _fit_arrays(self, x, y, w):
-        x = np.asarray(x, dtype=np.float32)
-        if self.standardize:
-            mean, std = _standardize(x, w)
-        else:
-            mean = np.zeros(x.shape[1], dtype=np.float32)
-            std = np.ones(x.shape[1], dtype=np.float32)
-        xs = (x - mean) / std
-        if self.fit_intercept:
-            xs = np.hstack([xs, np.ones((x.shape[0], 1), dtype=np.float32)])
-        y_pm = np.where(y > 0.5, 1.0, -1.0).astype(np.float32)
+        xd, yd, wd = place_fit_arrays(x, y, w)
+        xs, mean_d, std_d = _device_prepare_fit(
+            xd, wd, has_intercept=bool(self.fit_intercept),
+            standardize=bool(self.standardize))
+        y_pm = jnp.where(yd > 0.5, 1.0, -1.0).astype(jnp.float32)
         beta = np.asarray(_svc_core(
-            jnp.asarray(xs.astype(np.float32)), jnp.asarray(y_pm), jnp.asarray(w),
+            xs, y_pm, wd,
             jnp.float32(self.reg_param), int(self.max_iter),
             has_intercept=bool(self.fit_intercept)))
+        mean, std = np.asarray(mean_d), np.asarray(std_d)
         if self.fit_intercept:
             coef_s, b0 = beta[:-1], beta[-1]
         else:

@@ -45,21 +45,27 @@ class JsonLinesReader(DataFrameReader):
 
 
 class AvroReader(Reader):
-    """Avro container files.  Gated: needs ``fastavro`` (not in the base image)."""
+    """Avro object-container files via the vendored codec (readers/avro.py)
+    — runnable with zero dependencies, null + deflate codecs.
+
+    Reference: AvroReaders.scala:1-134 (AvroReaders.Simple).
+    """
 
     def __init__(self, path: str, key_fn=None):
         super().__init__(key_fn)
         self.path = path
 
+    @property
+    def schema(self):
+        from .avro import read_schema
+
+        return read_schema(self.path)  # header-only read, no data blocks
+
     def read_records(self):
-        try:
-            import fastavro
-        except ImportError as e:  # pragma: no cover
-            raise ImportError(
-                "Avro reading requires the optional 'fastavro' package"
-            ) from e
-        with open(self.path, "rb") as fh:  # pragma: no cover
-            yield from fastavro.reader(fh)
+        from .avro import read_container
+
+        _, records = read_container(self.path)
+        yield from records
 
 
 class StreamingReader:
