@@ -176,8 +176,16 @@ def bench_irls_mfu(n_rows: int, device_kind: str):
     folds = rng.integers(0, FOLDS, n_rows)
     train_w = np.stack([(folds != f).astype(np.float32) for f in range(FOLDS)])
     regs = np.logspace(-4, 0, 8).astype(np.float32)
-    xd, yd = jnp.asarray(x), jnp.asarray(y.astype(np.float32))
-    twd, rd = jnp.asarray(train_w), jnp.asarray(regs)
+    # bucket-pad rows exactly like the real sweep placement: the production
+    # kernel only ever sees power-of-two row blocks (odd row counts measured
+    # ~2x slower — a tiling artifact the sweeps never pay)
+    from transmogrifai_tpu.parallel.mesh import pad_rows_to_bucket
+
+    x, y32, train_w = pad_rows_to_bucket(
+        n_rows, x, y.astype(np.float32), train_w.T)
+    n_rows = x.shape[0]
+    xd, yd = jnp.asarray(x), jnp.asarray(y32)
+    twd, rd = jnp.asarray(train_w.T), jnp.asarray(regs)
 
     np.asarray(_irls_sweep(xd, yd, twd, rd, iters))  # compile + warm
     reps = 5

@@ -77,3 +77,58 @@ class TestUseMesh:
     def test_no_mesh_is_noop(self):
         a, n_valid = pad_rows_for_mesh(np.ones((10, 2)))
         assert n_valid == 10 and a.shape == (10, 2)
+
+
+class TestTwoDimensionalMesh:
+    """(data x model) mesh: rows shard over `data`, the hyperparameter grid /
+    fold / tree batches shard over `model` (SURVEY §2.10 item 3).  Results
+    must be identical to the unmeshed fit — sharding is layout, not math."""
+
+    def test_selector_under_4x2_mesh_matches_unmeshed(self):
+        from transmogrifai_tpu.models.trees import (
+            GradientBoostedTreesClassifier, RandomForestClassifier)
+
+        rng = np.random.default_rng(11)
+        n = 217
+        cols = {f"x{i}": rng.normal(size=n).tolist() for i in range(4)}
+        z = sum((i + 1) * 0.5 * np.asarray(cols[f"x{i}"]) for i in range(4))
+        cols["label"] = (rng.random(n) < 1 / (1 + np.exp(-z))
+                         ).astype(float).tolist()
+        ds = Dataset.from_features(
+            cols, {**{f"x{i}": Real for i in range(4)}, "label": RealNN})
+        label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+        fs = [FeatureBuilder.of(f"x{i}", Real).extract_field().as_predictor()
+              for i in range(4)]
+        # LR exercises the grid model-axis sharding; RF the per-tree batch;
+        # GBT the fold-axis sharding
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2,
+            models=[(LogisticRegression(),
+                     [{"reg_param": r} for r in (0.0, 0.01, 0.1, 1.0)]),
+                    (RandomForestClassifier(num_trees=6, max_depth=3), [{}]),
+                    (GradientBoostedTreesClassifier(num_rounds=4, max_depth=2),
+                     [{}])])
+        p = label.transform_with(sel, transmogrify(fs))
+
+        m1 = (Workflow().set_input_dataset(ds)
+              .set_result_features(label, p).train())
+        s1 = np.asarray(m1.score(ds)[p.name].score)
+        with use_mesh(make_mesh(n_data=4, n_model=2)):
+            m2 = (Workflow().set_input_dataset(ds)
+                  .set_result_features(label, p).train())
+        s2 = np.asarray(m2.score(ds)[p.name].score)
+        sm1, sm2 = m1.summary(), m2.summary()
+        assert sm1.best_model_name == sm2.best_model_name
+        assert sm1.failed_models == [] and sm2.failed_models == []
+        np.testing.assert_allclose(s1, s2, atol=1e-5)
+
+    def test_place_grid_shards_model_axis(self):
+        from transmogrifai_tpu.models.base import place_grid
+
+        with use_mesh(make_mesh(n_data=4, n_model=2)):
+            g = place_grid(np.arange(8, dtype=np.float32))
+            spec = g.sharding.spec
+            assert spec[0] == "model", spec
+        # no mesh: plain array
+        g2 = place_grid(np.arange(8, dtype=np.float32))
+        assert np.asarray(g2).shape == (8,)

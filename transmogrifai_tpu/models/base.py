@@ -56,6 +56,30 @@ def sweep_placements(x32: np.ndarray, extras, train_w, val_w):
     return xd, extra_devs, tw, vw, n0
 
 
+def place_spec(arr, axes):
+    """Place (or re-shard in place, for on-device arrays) with a
+    PartitionSpec over the ambient mesh — ``mesh.place`` with its graceful
+    unknown-axis / non-divisible degradation (see parallel/mesh.py)."""
+    from ..parallel.mesh import place
+
+    return place(arr, tuple(axes))
+
+
+def place_grid(arr):
+    """Place a per-grid parameter vector sharded over the mesh's MODEL axis.
+
+    This is what makes a CV sweep two-dimensionally parallel (SURVEY §2.10):
+    rows reduce over the ``data`` axis (psum) while the hyperparameter grid
+    partitions over ``model`` — each model-axis slice fits its grid points on
+    its own row shard, with no collective between grid points.  No-op without
+    an ambient mesh; a 1-sized model axis degenerates to replication.
+    """
+    from ..parallel.mesh import MODEL_AXIS
+
+    arr = np.asarray(arr)
+    return place_spec(arr, (MODEL_AXIS,) + (None,) * (arr.ndim - 1))
+
+
 def gather_scores(pending) -> np.ndarray:
     """Host-fetch a pending sweep result: a (g, k) device array or a list of
     per-grid (k,) device arrays (one async fetch either way)."""

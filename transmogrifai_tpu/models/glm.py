@@ -156,9 +156,11 @@ class GeneralizedLinearRegression(PredictionEstimatorBase):
             if family in ("poisson", "gamma"):
                 y_fam = jnp.maximum(yd, 1e-8)
             iters = 1 if family == "gaussian" else int(self.max_iter)
-            regs = jnp.asarray(
+            from .base import place_grid
+
+            regs = place_grid(np.asarray(
                 [float(grids[i].get("reg_param", self.reg_param))
-                 for i in idxs], dtype=jnp.float32)
+                 for i in idxs], dtype=np.float32))
             part = _glm_cv_program(
                 xd, y_fam, twd, vwd, regs, family, iters,
                 bool(self.fit_intercept), metric_fn)

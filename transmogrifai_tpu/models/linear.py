@@ -50,32 +50,31 @@ class LinearRegression(PredictionEstimatorBase):
 
     sweepable_params = ("reg_param",)
 
-    def _with_ones(self, x: np.ndarray) -> np.ndarray:
-        if self.fit_intercept:
-            return np.hstack([x, np.ones((x.shape[0], 1), dtype=x.dtype)]).astype(np.float32)
-        return x.astype(np.float32)
-
     def _split_beta(self, beta: np.ndarray):
         if self.fit_intercept:
             return beta[:-1].astype(np.float64), float(beta[-1])
         return beta.astype(np.float64), 0.0
 
     def _fit_arrays(self, x, y, w):
-        xs = self._with_ones(x)
+        from .logistic import _device_prepare_fit, place_fit_arrays
+
+        xd, yd, wd = place_fit_arrays(x, y, w)
+        xs, _, _ = _device_prepare_fit(
+            xd, wd, has_intercept=bool(self.fit_intercept), standardize=False)
         reg = jnp.float32(float(self.reg_param) * (1.0 - float(self.elastic_net)))
         beta = np.asarray(_ridge_core(
-            jnp.asarray(xs), jnp.asarray(y), jnp.asarray(w), reg,
-            has_intercept=bool(self.fit_intercept)))
+            xs, yd, wd, reg, has_intercept=bool(self.fit_intercept)))
         coef, intercept = self._split_beta(beta)
         return LinearRegressionModel(coef=coef, intercept=intercept)
 
     def _cv_sweep_device(self, x, y, train_w, val_w,
                          grids: List[Dict[str, Any]], metric_fn):
-        regs = jnp.asarray(
+        from .base import eval_linear_sweep, place_grid, sweep_placements
+
+        regs = place_grid(np.asarray(
             [float(g.get("reg_param", self.reg_param))
              * (1.0 - float(g.get("elastic_net", self.elastic_net))) for g in grids],
-            dtype=jnp.float32)
-        from .base import eval_linear_sweep, sweep_placements
+            dtype=np.float32))
         from .logistic import _device_prepare
 
         has_icpt = bool(self.fit_intercept)

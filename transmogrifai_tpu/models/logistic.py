@@ -309,13 +309,18 @@ class LogisticRegression(PredictionEstimatorBase):
         k, d1 = train_w.shape[0], int(xd.shape[1])
         has_icpt = bool(self.fit_intercept)
         parts = []
+        from .base import place_grid
+
         if l2_idx:
-            regs = jnp.asarray([l1l2[i][1] for i in l2_idx], dtype=jnp.float32)
+            regs = place_grid(np.asarray([l1l2[i][1] for i in l2_idx],
+                                         dtype=np.float32))
             parts.append((l2_idx, _irls_sweep(xd, yd, train_w, regs, self.max_iter,
                                               has_intercept=has_icpt)))
         if en_idx:
-            l1s = jnp.asarray([l1l2[i][0] for i in en_idx], dtype=jnp.float32)
-            l2s = jnp.asarray([l1l2[i][1] for i in en_idx], dtype=jnp.float32)
+            l1s = place_grid(np.asarray([l1l2[i][0] for i in en_idx],
+                                        dtype=np.float32))
+            l2s = place_grid(np.asarray([l1l2[i][1] for i in en_idx],
+                                        dtype=np.float32))
             parts.append((en_idx, _fista_sweep(
                 xd, yd, train_w, l1s, l2s, max(10 * self.max_iter, 300),
                 has_intercept=has_icpt)))

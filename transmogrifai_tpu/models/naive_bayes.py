@@ -95,11 +95,11 @@ class NaiveBayes(PredictionEstimatorBase):
             # non-contiguous class labels or exotic grids: generic path keeps
             # exact per-grid set_params semantics
             return None
-        from .base import sweep_placements
+        from .base import place_grid, sweep_placements
 
-        smoothings = jnp.asarray(
+        smoothings = place_grid(np.asarray(
             [float(g.get("smoothing", self.smoothing)) for g in grids],
-            dtype=jnp.float32)
+            dtype=np.float32))
         x32 = np.asarray(x, np.float32)
         y32 = np.asarray(y, np.float32)
         y_oh = (y32[:, None] == classes[None, :].astype(np.float32)

@@ -68,20 +68,19 @@ class MultinomialLogisticRegression(PredictionEstimatorBase):
 
     sweepable_params = ("reg_param",)
 
-    def _with_ones(self, x):
-        if self.fit_intercept:
-            return np.hstack([x, np.ones((x.shape[0], 1), dtype=x.dtype)]).astype(np.float32)
-        return x.astype(np.float32)
-
     def _n_classes(self, y: np.ndarray) -> int:
         return int(self.n_classes) if self.n_classes else int(y.max()) + 1
 
     def _fit_arrays(self, x, y, w):
+        from .logistic import _device_prepare_fit, place_fit_arrays
+
         c = self._n_classes(y)
-        y_onehot = np.eye(c, dtype=np.float32)[y.astype(np.int32)]
-        xs = self._with_ones(x)
+        xd, yd, wd = place_fit_arrays(x, y, w)
+        y_onehot = jax.nn.one_hot(yd.astype(jnp.int32), c, dtype=jnp.float32)
+        xs, _, _ = _device_prepare_fit(
+            xd, wd, has_intercept=bool(self.fit_intercept), standardize=False)
         reg = jnp.float32(float(self.reg_param) * (1.0 - float(self.elastic_net)))
-        b = np.asarray(_softmax_core(jnp.asarray(xs), jnp.asarray(y_onehot), jnp.asarray(w),
+        b = np.asarray(_softmax_core(xs, y_onehot, wd,
                                      reg, c, int(self.max_iter),
                                      has_intercept=bool(self.fit_intercept)))
         if self.fit_intercept:
@@ -94,11 +93,12 @@ class MultinomialLogisticRegression(PredictionEstimatorBase):
                          grids: List[Dict[str, Any]], metric_fn):
         c = self._n_classes(y)
         y_onehot = np.eye(c, dtype=np.float32)[y.astype(np.int32)]
-        regs = jnp.asarray(
+        from .base import eval_softmax_sweep, place_grid, sweep_placements
+
+        regs = place_grid(np.asarray(
             [float(g.get("reg_param", self.reg_param))
              * (1.0 - float(g.get("elastic_net", self.elastic_net))) for g in grids],
-            dtype=jnp.float32)
-        from .base import eval_softmax_sweep, sweep_placements
+            dtype=np.float32))
         from .logistic import _device_prepare
 
         has_icpt = bool(self.fit_intercept)

@@ -125,11 +125,11 @@ class LinearSVC(PredictionEstimatorBase):
         if (not self.standardize
                 or any(set(g) - {"reg_param"} for g in grids)):
             return None
-        from .base import sweep_placements
+        from .base import place_grid, sweep_placements
 
-        regs = jnp.asarray(
+        regs = place_grid(np.asarray(
             [float(g.get("reg_param", self.reg_param)) for g in grids],
-            dtype=jnp.float32)
+            dtype=np.float32))
         x32 = np.asarray(x, np.float32)
         y32 = np.asarray(y, np.float32)
         y_pm = np.where(y32 > 0.5, 1.0, -1.0).astype(np.float32)

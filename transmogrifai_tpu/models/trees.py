@@ -837,6 +837,10 @@ class _TreeEstimatorBase(PredictionEstimatorBase):
         binned, _ = _shared_binned(x32, xd, int(self.n_bins))
         pad = int(xd.shape[0]) - n0
         y_p = np.pad(np.asarray(y, np.float64), (0, pad))
+        # family-specific model-axis resharding happens ONCE here, not per
+        # grid point (GBT shards the fold axis; forests shard their per-tree
+        # batch inside _sweep_folds instead and keep folds as-placed)
+        tw, vw = self._reshard_fold_weights(tw, vw)
         pending = []
         for grid in grids:
             est = self.copy().set_params(**grid)
@@ -845,6 +849,10 @@ class _TreeEstimatorBase(PredictionEstimatorBase):
                 _shared_binned(x32, xd, int(est.n_bins))[0]
             pending.append(est._sweep_folds(b, x, y_p, tw, vw, metric_fn))
         return pending
+
+    def _reshard_fold_weights(self, tw, vw):
+        """Family-specific model-axis layout for the fold weight matrices."""
+        return tw, vw
 
     def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn):
         raise NotImplementedError
@@ -904,6 +912,16 @@ class _GBTBase(_TreeEstimatorBase):
             else GBTClassifierModel
         return cls(trees=trees, edges=edges, max_depth=self.max_depth,
                    n_bins=self.n_bins, base_score=base)
+
+    def _reshard_fold_weights(self, tw, vw):
+        # folds shard over the model axis: each model-axis slice boosts its
+        # folds on its own row shard, histogram psums ride the data axis only
+        # (degrades to replication when folds don't divide the model axis)
+        from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+        from .base import place_spec
+
+        return (place_spec(tw, (MODEL_AXIS, DATA_AXIS)),
+                place_spec(vw, (MODEL_AXIS, DATA_AXIS)))
 
     def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn):
         objective, num_class, _ = self._resolved(y, np.ones_like(y))
@@ -1030,6 +1048,9 @@ class _ForestBase(_TreeEstimatorBase):
         return trees, edges
 
     def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn):
+        from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+        from .base import place_spec
+
         # bootstrap weights draw at the ORIGINAL row count so the PRNG stream
         # (and thus every tree) matches _fit_arrays exactly; bucket-padded
         # rows get zero weight
@@ -1037,9 +1058,14 @@ class _ForestBase(_TreeEstimatorBase):
         pad = int(binned.shape[0]) - int(x.shape[0])
         if pad:
             boot = jnp.pad(jnp.asarray(boot), ((0, 0), (0, pad)))
+        # the per-tree batch shards over the model axis (SURVEY §2.10): each
+        # model slice grows its trees against the shared row-sharded codes
+        masks = place_spec(np.asarray(self._masks(x.shape[1])),
+                           (MODEL_AXIS, None))
+        boot = place_spec(boot, (MODEL_AXIS, DATA_AXIS))
         return _forest_cv_program(
             binned, jnp.asarray(y, jnp.float32), jnp.asarray(self._y_cols(y)),
-            train_w, val_w, self._masks(x.shape[1]), boot,
+            train_w, val_w, masks, boot,
             int(self.max_depth), int(self.n_bins), jnp.float32(self.reg_lambda),
             jnp.float32(self.min_child_weight), classification=self.classification,
             metric_fn=metric_fn,

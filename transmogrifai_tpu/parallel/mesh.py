@@ -137,14 +137,27 @@ def place_rows(arr, mesh: Optional[Mesh] = None):
 
 def place(arr, axes: Tuple[Optional[str], ...], mesh: Optional[Mesh] = None):
     """Device-put with an explicit PartitionSpec over the ambient (or given)
-    mesh; plain jnp.asarray when no mesh is active."""
+    mesh; plain jnp.asarray when no mesh is active.
+
+    Robust by construction: axes the mesh doesn't know, or whose dimension
+    size doesn't divide the mesh axis, degrade to replication (device_put
+    enforces divisibility eagerly, and sharding is a layout hint, never
+    semantics — a 1-point grid over a 2-way model axis must still run).
+    Arrays already on device reshard in place (no host round-trip).
+    """
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec
 
     mesh = mesh if mesh is not None else current_mesh()
     if mesh is None:
         return jnp.asarray(arr)
-    return jax.device_put(np.asarray(arr), NamedSharding(mesh, PartitionSpec(*axes)))
+    if not isinstance(arr, jax.Array):
+        arr = np.asarray(arr)
+    eff = tuple(
+        a if (a in mesh.axis_names and arr.shape[i] % mesh.shape[a] == 0)
+        else None
+        for i, a in enumerate(axes))
+    return jax.device_put(arr, NamedSharding(mesh, PartitionSpec(*eff)))
 
 
 def pad_rows_for_mesh(*arrays, mesh: Optional[Mesh] = None):
