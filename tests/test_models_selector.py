@@ -470,3 +470,29 @@ class TestHoldoutEvaluation:
         kept = (~cut.holdout_mask) & (yc != 9.0)
         assert (wc[kept] == 1.0).all()
         assert csum.details["holdoutRows"] == int(cut.holdout_mask.sum())
+
+
+class TestAllFamiliesFailed:
+    def test_all_failing_families_raise(self):
+        """Zero surviving families must be a hard error, not an arbitrary
+        selection among all-NaN metrics (robustness wart found in r3)."""
+        from transmogrifai_tpu.types import OPVector
+
+        class Exploding(LogisticRegression):
+            def cv_sweep(self, *a, **k):
+                raise RuntimeError("boom")
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(80, 3)).astype(np.float32)
+        y = (rng.random(80) < 0.5).astype(np.float64)
+        sel = ModelSelector(
+            models=[(Exploding(), [{}])],
+            validator=CrossValidator(BinaryClassificationEvaluator(),
+                                     num_folds=2))
+        label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+        vec = FeatureBuilder.of("v", OPVector).extract_field().as_predictor()
+        label.transform_with(sel, vec)
+        ds = Dataset({"label": Column.from_values(RealNN, y.tolist()),
+                      "v": Column.vector(x)})
+        with pytest.raises(RuntimeError, match="no candidate"):
+            sel.fit(ds)

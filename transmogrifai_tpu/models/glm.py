@@ -107,16 +107,17 @@ class GeneralizedLinearRegression(PredictionEstimatorBase):
     sweepable_params = ("reg_param",)
 
     def _fit_arrays(self, x, y, w):
-        x = np.asarray(x, dtype=np.float32)
-        xs = np.hstack([x, np.ones((x.shape[0], 1), dtype=np.float32)]) \
-            if self.fit_intercept else x
-        y32 = np.asarray(y, dtype=np.float32)
+        from .logistic import _device_prepare_fit, place_fit_arrays
+
+        xd, yd, wd = place_fit_arrays(x, y, w)
+        xs, _, _ = _device_prepare_fit(
+            xd, wd, has_intercept=bool(self.fit_intercept), standardize=False)
         if self.family in ("poisson", "gamma"):
-            y32 = np.maximum(y32, 1e-8)  # support constraint
+            yd = jnp.maximum(yd, 1e-8)  # support constraint
         # gaussian/identity IRLS converges in one solve — skip the redundant iterations
         iters = 1 if self.family == "gaussian" else int(self.max_iter)
         beta = np.asarray(_glm_core(
-            jnp.asarray(xs), jnp.asarray(y32), jnp.asarray(w),
+            xs, yd, wd,
             jnp.float32(self.reg_param), str(self.family), iters,
             has_intercept=bool(self.fit_intercept)))
         if self.fit_intercept:

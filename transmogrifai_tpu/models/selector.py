@@ -143,6 +143,20 @@ class ModelSelector(PredictionEstimatorBase):
         # the BestEstimator from OpWorkflow.fitStages the same way)
         result: ValidationResult = getattr(self, "_preselected", None) \
             or self.validator.validate(self.models, x, y, base_w)
+        # EVERY candidate failed: there is no meaningful winner — selecting
+        # among all-NaN metrics and silently refitting would ship an
+        # arbitrary model (reference: robust-to-failing-models stops at
+        # surviving models; zero survivors is a hard error).  Derived from
+        # metric finiteness, not failed_models, so the workflow-CV path
+        # (which builds ValidationResult itself) is covered too.
+        if result.evaluations and not any(
+                np.isfinite(v) for ev in result.evaluations
+                for v in ev.metric_values):
+            names = result.failed_models or sorted(
+                {ev.model_name for ev in result.evaluations})
+            raise RuntimeError(
+                "model selection failed: no candidate produced a finite "
+                f"CV metric (failed: {', '.join(names)})")
         best_eval = result.best
         best_est = next(e for e, _ in self.models if e.uid == best_eval.model_uid)
         final_est = best_est.copy().set_params(**best_eval.grid)
