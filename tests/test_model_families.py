@@ -382,3 +382,43 @@ class TestMLPSweep:
         fast = est.cv_sweep(x, y, tw, vw, grids, metric)
         slow = PredictionEstimatorBase._cv_sweep_generic(est, x, y, tw, vw, grids, metric)
         np.testing.assert_allclose(fast, slow, rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_hessian_drift_bound(monkeypatch):
+    """r3 advisor: _irls_core runs a FIXED iteration count, so with bf16
+    Hessians an unconverged fit is path-dependent.  Force the bf16 path on an
+    ill-conditioned design (cond ~1e4) and bound the coefficient drift vs the
+    f32 path — pins the TPU-vs-CPU tolerance the docstring promises."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models import logistic as lg
+
+    rng = np.random.default_rng(7)
+    n, d = 2000, 8
+    base = rng.normal(size=(n, d))
+    # ill-condition: scale columns over 4 orders of magnitude, add collinearity
+    scales = np.logspace(-2, 2, d)
+    x = (base * scales).astype(np.float32)
+    x[:, -1] = x[:, 0] * 0.999 + rng.normal(scale=1e-3, size=n)
+    logit = 0.8 * base[:, 0] - 0.5 * base[:, 1]
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    xa = np.concatenate([x, np.ones((n, 1), np.float32)], axis=1)
+    w = np.ones(n, np.float32)
+
+    def run():
+        lg._irls_core.clear_cache()
+        return np.asarray(lg._irls_core(
+            jnp.asarray(xa), jnp.asarray(y), jnp.asarray(w),
+            jnp.float32(1e-3), max_iter=30))
+
+    # pin the baseline to f32 explicitly — on a TPU backend the real
+    # _mxu_dtype already returns bf16, which would make this vacuous
+    monkeypatch.setattr(lg, "_mxu_dtype", lambda: jnp.float32)
+    beta_f32 = run()
+    monkeypatch.setattr(lg, "_mxu_dtype", lambda: jnp.bfloat16)
+    beta_bf16 = run()
+    lg._irls_core.clear_cache()  # don't leak the forced-bf16 trace
+
+    denom = np.maximum(np.abs(beta_f32), 1e-2)
+    drift = np.max(np.abs(beta_bf16 - beta_f32) / denom)
+    assert drift < 0.05, f"bf16 Hessian drift {drift:.4f} exceeds 5% bound"

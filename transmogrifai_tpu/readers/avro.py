@@ -212,9 +212,16 @@ def _zigzag(n: int) -> bytes:
             return bytes(out)
 
 
-def _union_branch(schema: list, value: Any) -> Tuple[int, Schema]:
-    """Pick the union branch for a python value (null-aware, type-matched)."""
+def _union_branch(schema: list, value: Any,
+                  names: Dict[str, Schema]) -> Tuple[int, Schema]:
+    """Pick the union branch for a python value (null-aware, type-matched).
+
+    Branches that are named-type references (e.g. ["null", "SomeRecord"]) are
+    resolved through the ``names`` registry before matching, so nullable
+    records/enums/fixed encode the same way they decode.
+    """
     def matches(branch: Schema) -> bool:
+        branch = _resolve(branch, names)
         b = branch["type"] if isinstance(branch, dict) else branch
         if value is None:
             return b == "null"
@@ -225,9 +232,15 @@ def _union_branch(schema: list, value: Any) -> Tuple[int, Schema]:
         if isinstance(value, float):
             return b in ("double", "float")
         if isinstance(value, str):
-            return b in ("string", "enum")
+            if b == "enum":  # only claim the enum branch for actual symbols
+                return isinstance(branch, dict) \
+                    and value in branch.get("symbols", ())
+            return b == "string"
         if isinstance(value, bytes):
-            return b in ("bytes", "fixed")
+            if b == "fixed":  # wrong-size bytes must fall through to "bytes"
+                return isinstance(branch, dict) \
+                    and len(value) == branch.get("size", -1)
+            return b == "bytes"
         if isinstance(value, dict):
             return b in ("record", "map")
         if isinstance(value, list):
@@ -236,7 +249,7 @@ def _union_branch(schema: list, value: Any) -> Tuple[int, Schema]:
 
     for i, branch in enumerate(schema):
         if matches(branch):
-            return i, branch
+            return i, _resolve(branch, names)
     raise AvroError(f"no union branch in {schema!r} for {type(value)}")
 
 
@@ -244,7 +257,7 @@ def _encode(schema: Schema, value: Any, out: io.BytesIO,
             names: Dict[str, Schema]) -> None:
     schema = _resolve(schema, names)
     if isinstance(schema, list):
-        idx, branch = _union_branch(schema, value)
+        idx, branch = _union_branch(schema, value, names)
         out.write(_zigzag(idx))
         _encode(branch, value, out, names)
         return
