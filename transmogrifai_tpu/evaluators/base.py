@@ -64,16 +64,27 @@ class BinaryClassificationEvaluator(Evaluator):
     def evaluate_arrays(self, y, pred, w=None):
         w = np.ones_like(y) if w is None else w
         # zero-weight pad to a power-of-two bucket: the sort-based AUC kernels
-        # then compile once per bucket instead of once per dataset size
-        from ..parallel.mesh import pad_rows_to_bucket
+        # then compile once per bucket instead of once per dataset size.
+        # Transfers go out as float32 through the content cache — the four
+        # float64 copies of a 1M-row eval are ~32 MB, seconds over remote
+        # transports, and every summary metric is reported at float32-grade
+        # precision anyway (sort order of f32-rounded scores decides AUC
+        # ties differently at most at the 1e-7 level).
+        from ..parallel.mesh import DATA_AXIS, pad_rows_to_bucket, \
+            place, place_cached
 
         score_p, pred_p, y_p, w_p = pad_rows_to_bucket(
-            len(y), pred.score, pred.pred, y, w)
-        s = jnp.asarray(score_p)
+            len(y), np.asarray(pred.score, np.float32),
+            np.asarray(pred.pred, np.float32), np.asarray(y, np.float32),
+            np.asarray(w, np.float32))
+        # scores/predictions are single-use per model: plain placement (a
+        # cache entry would only churn the LRU that protects fold weights)
+        s = place(score_p, (DATA_AXIS,))
         # threshold metrics use the model's OWN predictions (reference evaluates the
         # prediction column) — scores may be margins (LinearSVC), not probabilities
-        p = jnp.asarray(pred_p)
-        yj, wj = jnp.asarray(y_p), jnp.asarray(w_p)
+        p = place(pred_p, (DATA_AXIS,))
+        # labels/weights recur across evaluators and selector phases: cached
+        yj, wj = place_cached(y_p, (DATA_AXIS,)), place_cached(w_p, (DATA_AXIS,))
         # one jitted program + one host fetch for all ten point metrics
         vals = np.asarray(M.binary_summary(s, p, yj, wj))
         out = dict(zip(("auROC", "auPR", "precision", "recall", "f1", "error",

@@ -40,19 +40,25 @@ def sweep_placements(x32: np.ndarray, extras, train_w, val_w):
     Returns (xd, [extra_devs...], tw_dev, vw_dev, n_valid).
     """
     from ..parallel.mesh import (
-        DATA_AXIS, pad_rows_bucketed_for_mesh, place,
-        place_rows_bucketed_cached, place_rows)
+        DATA_AXIS, pad_rows_bucketed_for_mesh, place_cached,
+        place_rows_bucketed_cached)
 
     xd, n0 = place_rows_bucketed_cached(x32)
     pad = int(xd.shape[0]) - n0
+    # extras and fold weights are content-cached: families re-derive the same
+    # padded labels/targets/weights per fit, and over remote transports the
+    # repeated multi-MB transfers dominate the actual sweep dispatch
     extra_devs = [
-        place_rows(pad_rows_bucketed_for_mesh(np.asarray(e), n=n0)[0])
+        place_cached(pad_rows_bucketed_for_mesh(np.asarray(e), n=n0)[0],
+                     (DATA_AXIS,))
         for e in extras
     ]
-    tw = place(np.pad(np.asarray(train_w, np.float32), [(0, 0), (0, pad)]),
-               (None, DATA_AXIS))
-    vw = place(np.pad(np.asarray(val_w, np.float32), [(0, 0), (0, pad)]),
-               (None, DATA_AXIS))
+    # content-cached: every family pads the validator's identical fold
+    # weights, so the (k, n) transfers happen once per fit, not per family
+    tw = place_cached(np.pad(np.asarray(train_w, np.float32),
+                             [(0, 0), (0, pad)]), (None, DATA_AXIS))
+    vw = place_cached(np.pad(np.asarray(val_w, np.float32),
+                             [(0, 0), (0, pad)]), (None, DATA_AXIS))
     return xd, extra_devs, tw, vw, n0
 
 

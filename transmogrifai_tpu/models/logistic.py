@@ -199,13 +199,16 @@ def place_fit_arrays(x, y, w):
     """(xd, yd, wd) for a final fit: raw block through the shared placement
     cache (a refit after CV hits the block the sweep already transferred),
     labels/weights zero-padded to match."""
-    from ..parallel.mesh import place_rows_bucketed_cached
+    from ..parallel.mesh import DATA_AXIS, place_cached, \
+        place_rows_bucketed_cached
 
     x32 = np.asarray(x, np.float32)
     xd, n0 = place_rows_bucketed_cached(x32)
     pad = int(xd.shape[0]) - n0
-    yd = jnp.asarray(np.pad(np.asarray(y, np.float32), (0, pad)))
-    wd = jnp.asarray(np.pad(np.asarray(w, np.float32), (0, pad)))
+    yd = place_cached(np.pad(np.asarray(y, np.float32), (0, pad)),
+                      (DATA_AXIS,))
+    wd = place_cached(np.pad(np.asarray(w, np.float32), (0, pad)),
+                      (DATA_AXIS,))
     return xd, yd, wd
 
 

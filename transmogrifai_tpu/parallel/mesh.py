@@ -213,13 +213,42 @@ def pad_rows_bucketed_for_mesh(*arrays, n: Optional[int] = None):
 # without sharing, every family pays its own host->device transfer of the
 # padded (n, d) matrix (tens of seconds each on slow transports).  The cache
 # keys on a CONTENT fingerprint (shape + dtype + full-buffer checksum), so a
-# family that re-materialises an identical float32 copy still hits, and an
-# in-place mutation of the source changes the stamp and misses instead of
-# serving stale device data.  Bounded strong-ref FIFO: entries survive their
+# family that re-materialises an identical float32 copy still hits.  An
+# in-place mutation of a DIFFERENT object with equal old content misses as
+# soon as bytes change; mutating the one memoized source object in place can
+# serve a stale stamp until the memo rolls over (_content_stamp docstring) —
+# placement sources are frozen by convention.  Bounded strong-ref FIFO: entries survive their
 # source array (a family's temporary copy dying must not evict the shared
 # transfer) but old blocks roll off so device memory stays bounded.
 _PLACED_ROWS_CACHE: dict = {}
 _PLACED_ROWS_CACHE_MAX = 3
+
+
+_STAMP_MEMO: dict = {}
+_STAMP_MEMO_MAX = 16
+
+
+def _quick_sig(a: np.ndarray) -> bytes:
+    """Cheap strided sub-sample hash used ONLY to validate memo hits — the
+    authoritative stamp is the full hash.
+
+    64 evenly-strided 4 KB windows (256 KB hashed, ~0.2 ms on a 512 MB
+    block): any contiguous in-place mutation spanning at least
+    ceil(n/64) + 4 KB bytes is GUARANTEED to intersect a window (at 1M x
+    128 f32 that is ~2% of the rows); narrower edits may escape until the
+    memo entry rolls off."""
+    import hashlib
+
+    flat = np.frombuffer(memoryview(a).cast("B"), dtype=np.uint8)
+    n = flat.shape[0]
+    h = hashlib.blake2b(digest_size=8)
+    win = 4096
+    k = 64
+    stride = max(n // k, 1)
+    for start in range(0, n, stride):
+        h.update(memoryview(flat[start:start + win]))
+    h.update(memoryview(flat[max(n - win, 0):]))  # tail window
+    return h.digest()
 
 
 def _content_stamp(a: np.ndarray) -> bytes:
@@ -229,30 +258,96 @@ def _content_stamp(a: np.ndarray) -> bytes:
     sampled checksum it covers every byte, and at 128 bits the collision
     probability between distinct blocks is negligible (a 32-bit crc here
     would silently serve another dataset's placement at ~2^-32 per pair —
-    r3 advisor finding)."""
-    import hashlib
+    r3 advisor finding).
 
-    raw = a if a.flags["C_CONTIGUOUS"] else np.ascontiguousarray(a)
-    return hashlib.blake2b(memoryview(raw).cast("B"),
-                           digest_size=16).digest()
+    Memoized per source object: hashing a 512 MB block costs ~0.5 s, and one
+    selector fit stamps the same feature matrix once per family plus once
+    per predict.  The memo holds only a WEAK reference (no host-memory
+    pinning; a recycled id after the array dies invalidates the entry), and
+    a hit re-verifies (shape, dtype) plus a strided sub-sample signature, so
+    in-place mutations that touch any sampled window re-hash in full.  A
+    mutation confined to unsampled interior bytes of the same object would
+    serve a stale stamp until the entry rolls off — placement sources are
+    frozen by convention, and the signature makes violations loud in
+    practice rather than guaranteed-caught."""
+    import hashlib
+    import weakref
+
+    contiguous = a.flags["C_CONTIGUOUS"]
+    if contiguous:  # the memo (and _quick_sig) need zero-copy byte views
+        memo_key = id(a)
+        hit = _STAMP_MEMO.get(memo_key)
+        if hit is not None and hit[0]() is a \
+                and hit[1] == (a.shape, a.dtype.str) \
+                and hit[2] == _quick_sig(a):
+            return hit[3]
+    raw = a if contiguous else np.ascontiguousarray(a)
+    stamp = hashlib.blake2b(memoryview(raw).cast("B"),
+                            digest_size=16).digest()
+    if contiguous:
+        try:
+            _STAMP_MEMO[memo_key] = (weakref.ref(a), (a.shape, a.dtype.str),
+                                     _quick_sig(a), stamp)
+        except TypeError:
+            pass  # some array subclasses refuse weakrefs; skip memoization
+        while len(_STAMP_MEMO) > _STAMP_MEMO_MAX:
+            _STAMP_MEMO.pop(next(iter(_STAMP_MEMO)))
+    return stamp
+
+
+def place_cached(arr: np.ndarray, axes: tuple,
+                 mesh: Optional[Mesh] = None):
+    """``place`` with the same content-keyed dedup as the row cache.
+
+    For mid-sized row-aligned blocks that several consumers re-derive
+    identically per selector fit — fold weight matrices especially: every
+    family pads the validator's (k, n) train/val weights to the same content,
+    and without the cache each family pays its own multi-second transfer of
+    ~24 MB over remote transports.  Keyed on (shape, dtype, blake2b, axes,
+    mesh); bounded FIFO shared with the row cache budget."""
+    mesh = mesh if mesh is not None else current_mesh()
+    arr = np.asarray(arr)
+    key = (arr.shape, str(arr.dtype), _content_stamp(arr), tuple(axes), mesh)
+    hit = _PLACED_AUX_CACHE.pop(key, None)
+    if hit is not None:
+        _PLACED_AUX_CACHE[key] = hit  # LRU: a hit re-inserts at the back
+        return hit
+    placed = place(arr, tuple(axes), mesh=mesh)
+    _PLACED_AUX_CACHE[key] = placed
+    while len(_PLACED_AUX_CACHE) > _PLACED_AUX_CACHE_MAX:
+        _PLACED_AUX_CACHE.pop(next(iter(_PLACED_AUX_CACHE)))
+    return placed
+
+
+_PLACED_AUX_CACHE: dict = {}
+_PLACED_AUX_CACHE_MAX = 8
 
 
 def place_rows_bucketed_cached(arr: np.ndarray,
-                               mesh: Optional[Mesh] = None):
+                               mesh: Optional[Mesh] = None,
+                               insert: bool = True):
     """(device_array, n_valid) for bucket+mesh padded ``arr``, cached on a
     content fingerprint of the source block so repeated placements of the
-    same data (even via a fresh equal-valued copy) are free."""
+    same data (even via a fresh equal-valued copy) are free.
+
+    ``insert=False`` is the serving-path mode: it HITS the cache (a predict
+    on the block a model was just fit on reuses the fit transfer) but a
+    miss places without inserting — chunked scoring of a large table must
+    not churn distinct per-chunk entries through the small FIFO and evict
+    the fit block it exists to protect."""
     mesh = mesh if mesh is not None else current_mesh()
     arr = np.asarray(arr)
     # key on the Mesh OBJECT (hashable), not id(mesh): a recycled id after GC
     # could otherwise serve arrays sharded under a dead mesh (r3 advisor)
     key = (arr.shape, str(arr.dtype), _content_stamp(arr), mesh)
-    hit = _PLACED_ROWS_CACHE.get(key)
+    hit = _PLACED_ROWS_CACHE.pop(key, None)
     if hit is not None:
+        _PLACED_ROWS_CACHE[key] = hit  # LRU: a hit re-inserts at the back
         return hit
     padded, n_valid = pad_rows_bucketed_for_mesh(arr)[0], arr.shape[0]
     placed = place_rows(padded, mesh)
-    _PLACED_ROWS_CACHE[key] = (placed, n_valid)
-    while len(_PLACED_ROWS_CACHE) > _PLACED_ROWS_CACHE_MAX:
-        _PLACED_ROWS_CACHE.pop(next(iter(_PLACED_ROWS_CACHE)))
+    if insert:
+        _PLACED_ROWS_CACHE[key] = (placed, n_valid)
+        while len(_PLACED_ROWS_CACHE) > _PLACED_ROWS_CACHE_MAX:
+            _PLACED_ROWS_CACHE.pop(next(iter(_PLACED_ROWS_CACHE)))
     return placed, n_valid
