@@ -132,3 +132,66 @@ class TestTwoDimensionalMesh:
         # no mesh: plain array
         g2 = place_grid(np.arange(8, dtype=np.float32))
         assert np.asarray(g2).shape == (8,)
+
+
+class TestPlacementContentCache:
+    """The content-keyed placement caches (r4: stamp memo + freeze semantics)."""
+
+    def test_equal_content_fresh_copy_hits(self):
+        from transmogrifai_tpu.parallel.mesh import place_rows_bucketed_cached
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(300, 4)).astype(np.float32)
+        a1, n1 = place_rows_bucketed_cached(x)
+        a2, n2 = place_rows_bucketed_cached(x.copy())
+        assert a1 is a2 and n1 == n2 == 300
+
+    def test_changed_content_misses(self):
+        from transmogrifai_tpu.parallel.mesh import place_rows_bucketed_cached
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(300, 4)).astype(np.float32)
+        a1, _ = place_rows_bucketed_cached(x)
+        x2 = x.copy()
+        x2[7, 1] += 1.0
+        a2, _ = place_rows_bucketed_cached(x2)
+        assert a2 is not a1
+        np.testing.assert_allclose(np.asarray(a2)[7, 1], x2[7, 1])
+
+    def test_memoized_block_is_frozen_and_mutation_raises(self, monkeypatch):
+        from transmogrifai_tpu.parallel import mesh as M
+
+        monkeypatch.setattr(M, "_STAMP_MEMO_MIN_BYTES", 1024)
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(256, 8)).astype(np.float32)  # 8 KB >= threshold
+        M.place_rows_bucketed_cached(x)
+        # the memoized source is frozen: in-place mutation is LOUD, not silent
+        assert not x.flags.writeable
+        with pytest.raises(ValueError):
+            x[0, 0] = 99.0
+        # a hit on the frozen object returns the cached placement
+        a1, _ = M.place_rows_bucketed_cached(x)
+        a2, _ = M.place_rows_bucketed_cached(x)
+        assert a1 is a2
+
+    def test_unfrozen_then_mutated_rehashes(self, monkeypatch):
+        from transmogrifai_tpu.parallel import mesh as M
+
+        monkeypatch.setattr(M, "_STAMP_MEMO_MIN_BYTES", 1024)
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(256, 8)).astype(np.float32)
+        a1, _ = M.place_rows_bucketed_cached(x)
+        x.flags.writeable = True  # deliberate two-step override
+        x[10, 2] += 5.0
+        a2, _ = M.place_rows_bucketed_cached(x)
+        assert a2 is not a1  # writeable hit is rejected -> full re-hash
+        np.testing.assert_allclose(np.asarray(a2)[10, 2], x[10, 2])
+
+    def test_lookup_only_mode_does_not_insert(self):
+        from transmogrifai_tpu.parallel import mesh as M
+
+        rng = np.random.default_rng(4)
+        before = dict(M._PLACED_ROWS_CACHE)
+        x = rng.normal(size=(700, 3)).astype(np.float32)
+        M.place_rows_bucketed_cached(x, insert=False)
+        assert dict(M._PLACED_ROWS_CACHE) == before

@@ -226,6 +226,9 @@ _PLACED_ROWS_CACHE_MAX = 3
 
 _STAMP_MEMO: dict = {}
 _STAMP_MEMO_MAX = 16
+#: only blocks whose full hash is expensive are worth a memo slot; smaller
+#: arrays (fold weights, labels) hash in single-digit ms
+_STAMP_MEMO_MIN_BYTES = 64 * 1024 * 1024
 
 
 def _quick_sig(a: np.ndarray) -> bytes:
@@ -260,36 +263,40 @@ def _content_stamp(a: np.ndarray) -> bytes:
     would silently serve another dataset's placement at ~2^-32 per pair —
     r3 advisor finding).
 
-    Memoized per source object: hashing a 512 MB block costs ~0.5 s, and one
-    selector fit stamps the same feature matrix once per family plus once
-    per predict.  The memo holds only a WEAK reference (no host-memory
-    pinning; a recycled id after the array dies invalidates the entry), and
-    a hit re-verifies (shape, dtype) plus a strided sub-sample signature, so
-    in-place mutations that touch any sampled window re-hash in full.  A
-    mutation confined to unsampled interior bytes of the same object would
-    serve a stale stamp until the entry rolls off — placement sources are
-    frozen by convention, and the signature makes violations loud in
-    practice rather than guaranteed-caught."""
+    Memoized per source object for LARGE blocks only (hashing a 512 MB block
+    costs ~0.5 s; small arrays hash in ms and would churn the bounded memo).
+    The memo holds a WEAK reference (no host-memory pinning; a recycled id
+    after the array dies invalidates the entry).  Memoized arrays are FROZEN
+    (``writeable=False``): an in-place mutation of a cached placement source
+    raises in the caller's code instead of silently serving stale device
+    data.  A hit requires the array to still be non-writeable and to match
+    the stored (shape, dtype) and a strided sub-sample signature
+    (belt-and-braces); anything else re-hashes in full."""
     import hashlib
     import weakref
 
     contiguous = a.flags["C_CONTIGUOUS"]
-    if contiguous:  # the memo (and _quick_sig) need zero-copy byte views
+    memoizable = contiguous and a.nbytes >= _STAMP_MEMO_MIN_BYTES
+    if memoizable:  # the memo (and _quick_sig) need zero-copy byte views
         memo_key = id(a)
         hit = _STAMP_MEMO.get(memo_key)
-        if hit is not None and hit[0]() is a \
+        if hit is not None and hit[0]() is a and not a.flags.writeable \
                 and hit[1] == (a.shape, a.dtype.str) \
                 and hit[2] == _quick_sig(a):
             return hit[3]
     raw = a if contiguous else np.ascontiguousarray(a)
     stamp = hashlib.blake2b(memoryview(raw).cast("B"),
                             digest_size=16).digest()
-    if contiguous:
+    if memoizable:
         try:
-            _STAMP_MEMO[memo_key] = (weakref.ref(a), (a.shape, a.dtype.str),
-                                     _quick_sig(a), stamp)
-        except TypeError:
-            pass  # some array subclasses refuse weakrefs; skip memoization
+            entry = (weakref.ref(a), (a.shape, a.dtype.str),
+                     _quick_sig(a), stamp)
+            a.flags.writeable = False  # mutations now raise, loudly
+            _STAMP_MEMO[memo_key] = entry
+        except (TypeError, ValueError):
+            pass  # weakref-refusing subclass / flag-locked view: no memo
+        for k in [k for k, v in _STAMP_MEMO.items() if v[0]() is None]:
+            _STAMP_MEMO.pop(k)  # prune entries whose array died
         while len(_STAMP_MEMO) > _STAMP_MEMO_MAX:
             _STAMP_MEMO.pop(next(iter(_STAMP_MEMO)))
     return stamp

@@ -56,10 +56,20 @@ def pad_cols(arr: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:
     return pad_axis(arr, 1, multiple)
 
 
-def shard_cols(arr: np.ndarray, mesh: Mesh):
-    """Place (n, d) on device with columns sharded; returns (device_array, d_valid)."""
+def shard_cols(arr, mesh: Mesh):
+    """Place (n, d) on device with columns sharded; returns (device_array, d_valid).
+
+    Accepts host ndarrays AND device jax.Arrays — a device input reshards
+    device-to-device (the SanityChecker's wide path derives its correlation
+    block from the already-placed feature block; coercing through numpy here
+    would re-pay a multi-hundred-MB host transfer)."""
     k = mesh.shape[DATA_AXIS]
-    padded, d_valid = pad_cols(np.asarray(arr), k)
+    if isinstance(arr, jax.Array):
+        d_valid = int(arr.shape[1])
+        pad_c = (-d_valid) % k
+        padded = jnp.pad(arr, ((0, 0), (0, pad_c))) if pad_c else arr
+    else:
+        padded, d_valid = pad_cols(np.asarray(arr), k)
     return jax.device_put(padded, col_sharding(mesh)), d_valid
 
 

@@ -51,6 +51,11 @@ class _Encoder:
             return v.item()
         if isinstance(v, np.ndarray):
             return self._store(v)
+        if hasattr(v, "__array__") and hasattr(v, "dtype") \
+                and hasattr(v, "sharding"):
+            # device jax.Array (e.g. a lazily-materialized wide correlation
+            # block) — pull to host only here, at serialization time
+            return self._store(np.asarray(v))
         if isinstance(v, (list, tuple)):
             return {"__list__": [self.encode(x) for x in v],
                     "__tuple__": isinstance(v, tuple)}
@@ -152,6 +157,7 @@ def _restore_dataclass(name: str, data: dict):
             sample_size=data.get("sample_size", 0),
             correlation_type=data.get("correlation_type", "pearson"),
             correlations_feature=data.get("correlations_feature"),
+            correlation_indices=data.get("correlation_indices"),
         )
     if name == "ColumnStats":
         from ..checkers.sanity import ColumnStats
