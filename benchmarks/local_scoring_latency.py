@@ -88,12 +88,13 @@ def main():
     min3_times = []
     for r in records:
         best = float("inf")
-        for _ in range(3):
+        for attempt in range(3):
             t0 = time.perf_counter()
             scorer(r)
             dt = time.perf_counter() - t0
             best = min(best, dt)
-        raw_times.append(dt)
+            if attempt == 0:
+                raw_times.append(dt)  # FIRST attempt = honest raw figure
         min3_times.append(best)
     raw_times.sort()
     min3_times.sort()
@@ -105,10 +106,8 @@ def main():
     scorer.batch(records)
     batch_rps = len(records) / (time.perf_counter() - t0)
 
-    assert p99 < 1.0, (
-        f"scorer p99 {p99:.3f} ms breached the 1 ms serving bound "
-        f"(env control p99 {env_p99:.3f} ms)")
-
+    # print BEFORE gating: a breach on a noisy host must not destroy the
+    # measurements (incl. the env control that would explain it)
     print(json.dumps({
         "metric": "local_scoring_p50_ms",
         "value": round(p50, 3),
@@ -118,6 +117,9 @@ def main():
         "env_scheduler_noise_p99_ms": round(env_p99, 3),
         "batch_records_per_sec": round(batch_rps, 1),
     }))
+    assert p99 < 1.0, (
+        f"scorer p99 {p99:.3f} ms breached the 1 ms serving bound "
+        f"(env control p99 {env_p99:.3f} ms)")
 
 
 if __name__ == "__main__":

@@ -194,3 +194,44 @@ class TestSmartTextLanguageAware:
         model, block = self._features(rows)
         restored = _roundtrip(model)
         assert restored.languages == model.languages
+
+
+class TestRealStringAccuracy:
+    """Real-text language-ID accuracy (VERDICT r3 #5): hand-written casual
+    short strings per language (tests/langid_real_fixture.py), disjoint
+    from the SEED_TEXTS profiles.  PARITY.md carries the measured table."""
+
+    def test_overall_accuracy(self):
+        import sys as _sys, os as _os
+        _sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+        from langid_real_fixture import REAL_STRINGS
+
+        from transmogrifai_tpu.utils.lang import LANGUAGES, detect_language
+
+        assert set(REAL_STRINGS) == set(LANGUAGES)  # full coverage
+        total = correct = 0
+        per_lang = {}
+        for lang, strings in REAL_STRINGS.items():
+            ok = sum(detect_language(s) == lang for s in strings)
+            per_lang[lang] = ok
+            total += len(strings)
+            correct += ok
+        acc = correct / total
+        assert acc >= 0.90, f"real-string accuracy {acc:.3f} < 0.90"
+        # every language must be at least half-right on real strings; the
+        # known-hard pairs (no/da, cs/sk) may miss individual strings
+        bad = {k: v for k, v in per_lang.items() if v < 4}
+        assert not bad, f"languages below 4/8 on real strings: {bad}"
+
+    def test_script_languages_are_reliable(self):
+        """Non-Latin-script languages must be near-perfect (script prior)."""
+        import sys as _sys, os as _os
+        _sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+        from langid_real_fixture import REAL_STRINGS
+
+        from transmogrifai_tpu.utils.lang import detect_language
+
+        for lang in ("ar", "he", "el", "ru", "uk", "hi", "bn", "th", "zh",
+                     "ja", "ko", "fa"):
+            ok = sum(detect_language(s) == lang for s in REAL_STRINGS[lang])
+            assert ok == len(REAL_STRINGS[lang]), (lang, ok)

@@ -212,10 +212,13 @@ class TestLearnedTagger:
 
 
 class TestRealTextFixture:
-    """Real-prose evaluation (VERDICT r2 #4): 50 hand-labeled news/fiction
-    sentences (tests/ner_real_fixture.py), disjoint from the training
-    templates.  The shipped learned artifact must beat the gazetteer tagger
-    here — the reference's bar is OpenNLP models trained on real corpora."""
+    """Real-prose evaluation (VERDICT r2 #4, expanded r3 #5): 200+
+    hand-labeled sentences across news, fiction, reviews, fragments, email,
+    sports, weather, finance, forum, and biographical registers
+    (tests/ner_real_fixture.py), disjoint from the training templates.  The
+    shipped learned artifact must beat the gazetteer tagger and hold
+    F1 >= 0.8 — the reference's bar is OpenNLP models trained on real
+    corpora."""
 
     @staticmethod
     def _score(tagfn):
@@ -252,7 +255,8 @@ class TestRealTextFixture:
         assert f1_learned > f1_rules, (
             f"learned F1 {f1_learned:.3f} must beat gazetteer {f1_rules:.3f} "
             "on real prose")
-        assert f1_learned >= 0.75, f"learned F1 too low: {f1_learned:.3f}"
+        # r4 bar (VERDICT r3 #5): >= 0.8 on the full 200+ sentence corpus
+        assert f1_learned >= 0.80, f"learned F1 too low: {f1_learned:.3f}"
 
     def test_fixture_spans_all_entity_classes(self):
         import sys, os
@@ -262,4 +266,4 @@ class TestRealTextFixture:
         classes = {e for _, gold in REAL_TEXT for e in gold.values()}
         assert {"Person", "Location", "Organization", "Date", "Time",
                 "Money", "Percentage"} <= classes
-        assert len(REAL_TEXT) >= 50
+        assert len(REAL_TEXT) >= 200

@@ -87,11 +87,23 @@ ORG_NAMES = [
     "Vantage", "Westbrook", "Yellowtail", "Zephyrix", "Arcelia", "Bancorp",
     "Covantis",
 ]
+# synthetic river / sports-venue names (never the eval fixture's):
+# the model learns the CONTEXT ("along the X", "play at X"), not the names
+RIVERS = ["Marendel", "Ostrava", "Kellwater", "Brenith", "Soutane",
+          "Vargen", "Talvik", "Ouerre"]
+VENUES = ["Ashford Park", "Ravenscourt", "Elmsgate", "Holmwood",
+          "Carrickfield", "Windmere Oval", "Strathmoor", "Petersgate"]
 # O-tagged sentence scaffolding so capitalized sentence starts, lowercase
 # clauses, and frequent function words are well represented
 FILLER_OPENERS = ["When", "Although", "Nobody", "According", "Meanwhile",
                   "Yesterday", "Earlier", "Later", "Afterwards", "By",
-                  "The", "Their", "His", "Her", "It", "That", "These"]
+                  "The", "Their", "His", "Her", "It", "That", "These",
+                  # casual/review register: sentences that OPEN with a
+                  # capitalized verb or adjective (no entity implied)
+                  "Ordered", "Bought", "Stayed", "Returned", "Great",
+                  "Honestly", "Lost", "Found", "Tried", "Loved", "Arrived",
+                  "Cancelled", "Booked", "Visited", "Do", "At", "Once",
+                  "Young", "Old"]
 
 # templates: {slot} fills below; every filled token is labeled with the slot's
 # tag, all other tokens are O
@@ -255,12 +267,215 @@ TEMPLATES = [
      {"last": "Person", "money": "Money"}),
     ("The curtain rose at {time} sharp, and {role} {last} missed the cue.",
      {"time": "Time", "last": "Person"}),
+    # --- review / fragment registers (r4: consumer prose, casual notes) ---
+    # consumer-brand organizations with no suffix, in shopping contexts
+    ("Ordered the machine from {orgname} on {weekday} and it arrived "
+     "broken.",
+     {"orgname": "Organization", "weekday": "Date"}),
+    ("Returned the boots to {orgname} on {weekday} and the refund took "
+     "two days.",
+     {"orgname": "Organization", "weekday": "Date"}),
+    ("The mechanic at {orgname} quoted me {money} for an hour of work.",
+     {"orgname": "Organization", "money": "Money"}),
+    ("Customer service at {orgname} refunded me {percent} within a week.",
+     {"orgname": "Organization", "percent": "Percentage"}),
+    ("Bought two tickets for the {weekday} show at the theater in {city}.",
+     {"weekday": "Date", "city": "Location"}),
+    ("Stayed three nights at the {orghead} {orgsuf} near {city}.",
+     {"orghead": "Organization", "orgsuf": "Organization",
+      "city": "Location"}),
+    # persons introduced casually ("by X", "named X", bare first names)
+    ("Quarterly sync moved to {time}, room booked by {first}.",
+     {"time": "Time", "first": "Person"}),
+    ("The guide, {first}, waited for us even though we were late.",
+     {"first": "Person"}),
+    ("It died in a week and {first} from support never called back.",
+     {"first": "Person"}),
+    ("Package from {first} left with the neighbor at {time}.",
+     {"first": "Person", "time": "Time"}),
+    ("Reminder: call {hon} {last} about the lease before {weekday}.",
+     {"last": "Person", "weekday": "Date"}),
+    # travel fragments
+    ("Flight to {city} delayed until {time}, gate changed twice.",
+     {"city": "Location", "time": "Time"}),
+    ("The shuttle from {city} airport took until {time}.",
+     {"city": "Location", "time": "Time"}),
+    ("The ferry departed {city} at {ampm} carrying mail and passengers.",
+     {"city": "Location", "ampm": "Time"}),
+    ("Best meal I had in {city}, and I ate there twice before my {time} "
+     "train.",
+     {"city": "Location", "time": "Time"}),
+    # street and place-name locations
+    # capitalized street designators tag Location with the name (fixture
+    # convention: "Fulton Street" is two Location tokens); lowercase
+    # "street" below stays O
+    ("Two brothers opened a bakery on {last} {streetword} near the market.",
+     {"last": "Location", "streetword": "Location"}),
+    ("The shop on {last} street stayed open until {ampm} on holidays.",
+     {"last": "Location", "ampm": "Time"}),
+    # weather / nature register
+    ("Forecasters expect the storm to reach {city} by {weekday} evening.",
+     {"city": "Location", "weekday": "Date"}),
+    ("Humidity in {city} hit {percent} before the front moved through "
+     "at {ampm}.",
+     {"city": "Location", "percent": "Percentage", "ampm": "Time"}),
+    ("Drought cut the harvest in {country} by {percent} this season.",
+     {"country": "Location", "percent": "Percentage"}),
+    # sports register
+    ("Coach {last} benched the captain for the match in {city}.",
+     {"last": "Person", "city": "Location"}),
+    ("Referee {last} waved play on, and the stadium in {city} erupted.",
+     {"last": "Person", "city": "Location"}),
+    ("Ticket sales rose {percent} after {last} signed in {month}.",
+     {"percent": "Percentage", "last": "Person", "month": "Date"}),
+    # geographic prepositions beyond in/from: near, south of, along, off,
+    # toward, at the harbor/coast/plant
+    ("Storm warning for the coast south of {city}, winds up {percent}.",
+     {"city": "Location", "percent": "Percentage"}),
+    ("The glacier above {city} lost {percent} of its mass last summer.",
+     {"city": "Location", "percent": "Percentage"}),
+    ("Flood defences along the {river} held through the night.",
+     {"river": "Location"}),
+    ("By {time} the fog had lifted off the harbor at {city}.",
+     {"time": "Time", "city": "Location"}),
+    ("The bus wound down from {city} toward the valley below.",
+     {"city": "Location"}),
+    ("The recall affects cars built at the {city} plant since {year}.",
+     {"city": "Location", "year": "Date"}),
+    ("Rain stopped play at {venue} just before {ampm} on {weekday}.",
+     {"venue": "Location", "ampm": "Time", "weekday": "Date"}),
+    # agentive "by / led by / sponsored by / audit by" organizations
+    ("Conference dinner sponsored by {orgname}, options confirmed.",
+     {"orgname": "Organization"}),
+    ("The round was led by investors from {orgname} in {month}.",
+     {"orgname": "Organization", "month": "Date"}),
+    ("He resigned after the audit by {orgname} surfaced in {month}.",
+     {"orgname": "Organization", "month": "Date"}),
+    ("Next meeting at {time} with counsel from {orgname}.",
+     {"time": "Time", "orgname": "Organization"}),
+    ("Keynote by {role} {last} moved from noon to {ampm}.",
+     {"last": "Person", "ampm": "Time"}),
+    # subject-position organizations with plain verbs
+    ("{orgname} said listening grew {percent} year over year in {country}.",
+     {"orgname": "Organization", "percent": "Percentage",
+      "country": "Location"}),
+    ("{orgname} moved its division to a holding company registered in "
+     "{city}.",
+     {"orgname": "Organization", "city": "Location"}),
+    ("The outage took {orgname} engineers four hours to resolve.",
+     {"orgname": "Organization"}),
+    ("Payments firm {orgname} processed volumes up {percent} last week.",
+     {"orgname": "Organization", "percent": "Percentage"}),
+    # professional/role bigrams before surnames
+    ("Chief Executive {last} resigned on {weekday} morning.",
+     {"last": "Person", "weekday": "Date"}),
+    ("Founder {last} sold {percent} of his stake for {money}.",
+     {"last": "Person", "percent": "Percentage", "money": "Money"}),
+    ("Defender {last} limped off, and {orgname} never recovered.",
+     {"last": "Person", "orgname": "Organization"}),
+    ("Analyst {last} of {orgname} cut her target by {percent} on "
+     "{weekday}.",
+     {"last": "Person", "orgname": "Organization", "percent": "Percentage",
+      "weekday": "Date"}),
+    ("The final between {last} and {last2} lasted until midnight in "
+     "{city}.",
+     {"last": "Person", "last2": "Person", "city": "Location"}),
+    # month + day-number dates, and bare numbers that must stay O
+    ("Invoice {plainnum}: {money} due by {month} {daynum}.",
+     {"money": "Money", "month": "Date", "daynum": "Date"}),
+    ("The hearing was moved to {month} {daynum} at {time}.",
+     {"month": "Date", "daynum": "Date", "time": "Time"}),
+    ("We waited {plainnum} minutes at the gate before boarding.", {}),
+    ("The refund hit my card within {plainnum} hours, as promised.", {}),
+    ("The job took {plainnum} hours and cost {money} in parts.",
+     {"money": "Money"}),
+    # possessives and percent-adjacent O words
+    ("Rent rose {percent} effective {month}, per the landlord's letter.",
+     {"percent": "Percentage", "month": "Date"}),
+    # place-as-modifier: "the {city} office/branch/plant/airport/mine"
+    ("The {city} office still owes us the {month} numbers.",
+     {"city": "Location", "month": "Date"}),
+    ("The printers at the {city} branch have been down since {weekday}.",
+     {"city": "Location", "weekday": "Date"}),
+    ("Input costs at the {city} plant eased during {month}.",
+     {"city": "Location", "month": "Date"}),
+    ("Impairments at the {city} mine totaled {money} for fiscal {year}.",
+     {"city": "Location", "money": "Money", "year": "Date"}),
+    # movement / reach / transit contexts
+    ("The plague reached {city} in {histyear} aboard a merchant vessel.",
+     {"city": "Location", "histyear": "Date"}),
+    ("The salt route passed through {city} for two centuries.",
+     {"city": "Location"}),
+    ("The canal cut the journey from {city} to {city2} by a full day.",
+     {"city": "Location", "city2": "Location"}),
+    ("Has anyone taken the night bus from {city} to {city2}?",
+     {"city": "Location", "city2": "Location"}),
+    ("We are moving to {city} in {month}, send boxes.",
+     {"city": "Location", "month": "Date"}),
+    ("Her flight leaves {city} at {time}, so dinner is off.",
+     {"city": "Location", "time": "Time"}),
+    ("Forwarding the itinerary: arrive {city} {time}, depart for {city2} "
+     "at dawn.",
+     {"city": "Location", "time": "Time", "city2": "Location"}),
+    ("Passengers stranded at {city} slept under the departure boards.",
+     {"city": "Location"}),
+    ("The vineyard outside {city} exports {percent} of its vintage.",
+     {"city": "Location", "percent": "Percentage"}),
+    ("The telescope near {city} recorded the transit at {time}.",
+     {"city": "Location", "time": "Time"}),
+    ("The drought emptied the reservoir above {city} by {month}.",
+     {"city": "Location", "month": "Date"}),
+    ("Erosion claimed {percent} of the shoreline between {city} and the "
+     "estuary.",
+     {"percent": "Percentage", "city": "Location"}),
+    ("The {time} to {city} was cancelled, so we shared a taxi.",
+     {"time": "Time", "city": "Location"}),
+    ("A collector from {city} paid far too little for the boat.",
+     {"city": "Location"}),
+    ("Born in {city} in {histyear}, he apprenticed as a coppersmith.",
+     {"city": "Location", "histyear": "Date"}),
+    ("Clinics in {country} and {country2} enrolled thousands of "
+     "patients.",
+     {"country": "Location", "country2": "Location"}),
+    # profession appositives and sentence-initial bare names
+    ("The poet {last} drew a crowd even in the rain.",
+     {"last": "Person"}),
+    ("The co-founder, {first}, still answers support tickets herself.",
+     {"first": "Person"}),
+    ("Nurse {last} covered the night shift again on {holiday}.",
+     {"last": "Person", "holiday": "Date"}),
+    ("Can someone cover for {first} while she is in {city} next week?",
+     {"first": "Person", "city": "Location"}),
+    ("{first} got the scholarship, full ride plus a {money} stipend.",
+     {"first": "Person", "money": "Money"}),
+    ("{first} outlived three husbands and the bank that foreclosed on "
+     "her farm.",
+     {"first": "Person"}),
+    ("{first} photographed the murals in {city} before the repaint.",
+     {"first": "Person", "city": "Location"}),
+    ("Grandfather {first} never spoke of {city}, not even at the end.",
+     {"first": "Person", "city": "Location"}),
+    # holidays as dates
+    ("The shop stays shut from {holiday} until the new year.",
+     {"holiday": "Date"}),
+    ("Deliveries pause on {holiday} and resume the next {weekday}.",
+     {"holiday": "Date", "weekday": "Date"}),
+    # person-named two-token firms in corporate agent positions
+    ("Auditor {last} {last2} flagged related-party loans in the report.",
+     {"last": "Organization", "last2": "Organization"}),
+    ("Miners at {last} {last2} shipped {percent} more ore from {city}.",
+     {"last": "Organization", "last2": "Organization",
+      "percent": "Percentage", "city": "Location"}),
     # O-heavy filler sentences: capitalized openers and plain prose with no
     # entities at all, so capitalization alone never implies an entity
     ("{opener} the talks had already collapsed, and nothing more was said.",
      {}),
     ("{opener} the harvest was poor and the winter seemed endless.",
      {}),
+    ("{opener} it at the market for far less than it was worth.", {}),
+    ("{opener} value for the money, would absolutely book again.", {}),
+    ("{opener} the pool closes early, which nobody mentions when you "
+     "book.", {}),
     ("The old keeper had not left the island in many years.", {}),
     ("Nothing in the ledger explained where the money had gone.", {}),
     ("The orchestra rehearsed until midnight but was still not ready.", {}),
@@ -274,6 +489,14 @@ def _fill(rng):
         "hon": HONORIFICS[rng.integers(len(HONORIFICS))],
         "role": ROLE_TITLES[rng.integers(len(ROLE_TITLES))],
         "opener": FILLER_OPENERS[rng.integers(len(FILLER_OPENERS))],
+        "streetword": ["Street", "Avenue", "Road", "Lane"][rng.integers(4)],
+        "river": RIVERS[rng.integers(len(RIVERS))],
+        "venue": VENUES[rng.integers(len(VENUES))],
+        "plainnum": str(rng.integers(10, 9999)),
+        "daynum": str(rng.integers(1, 30)),
+        "histyear": str(rng.integers(1500, 1900)),
+        "holiday": ["Christmas", "Easter", "Thanksgiving", "Passover",
+                    "Ramadan", "Diwali"][rng.integers(6)],
         "first": FIRST_NAMES[rng.integers(len(FIRST_NAMES))],
         "first2": FIRST_NAMES[rng.integers(len(FIRST_NAMES))],
         "last": SURNAMES[rng.integers(len(SURNAMES))],
@@ -310,7 +533,7 @@ def _fill(rng):
     tokens, tags = [], []
     for part in tpl.split():
         if part.startswith("{"):
-            slot = part.strip("{}.,")
+            slot = part.strip("{}.,:;?!")
             toks = ner_tokenize(fills[slot])
             tag = slot_tags.get(slot, "O")
         else:

@@ -116,6 +116,8 @@ def token_features(tokens: Sequence[str], i: int, prev_tag: str) -> List[str]:
     low = w.lower()
     prev = tokens[i - 1] if i > 0 else "<s>"
     nxt = tokens[i + 1] if i + 1 < len(tokens) else "</s>"
+    prev2 = tokens[i - 2] if i > 1 else "<s>"
+    nxt2 = tokens[i + 2] if i + 2 < len(tokens) else "</s>"
     prev_low, nxt_low = prev.lower(), nxt.lower()
     feats = [
         f"w={low}",
@@ -123,6 +125,9 @@ def token_features(tokens: Sequence[str], i: int, prev_tag: str) -> List[str]:
         f"pre2={low[:2]}", f"pre3={low[:3]}",
         f"suf2={low[-2:]}", f"suf3={low[-3:]}",
         f"prev={prev_low}", f"next={nxt_low}",
+        # 2-away context: "Tunde Bakare works ..." — the FIRST name token
+        # only learns person-vs-org from the verb two tokens to its right
+        f"prev2={prev2.lower()}", f"next2={nxt2.lower()}",
         f"prevshape={word_shape(prev)}", f"nextshape={word_shape(nxt)}",
         f"prevtag={prev_tag}",
         f"prevtag+shape={prev_tag}|{word_shape(w)}",

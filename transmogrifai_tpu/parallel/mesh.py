@@ -269,9 +269,13 @@ def _content_stamp(a: np.ndarray) -> bytes:
     after the array dies invalidates the entry).  Memoized arrays are FROZEN
     (``writeable=False``): an in-place mutation of a cached placement source
     raises in the caller's code instead of silently serving stale device
-    data.  A hit requires the array to still be non-writeable and to match
-    the stored (shape, dtype) and a strided sub-sample signature
-    (belt-and-braces); anything else re-hashes in full."""
+    data.  Callers that intend to mutate can simply re-enable
+    ``a.flags.writeable = True`` — a writeable array never hits the memo, so
+    correctness is preserved (full re-hash).  The freeze is lifted when the
+    entry is evicted or its weakref dies.  Caveat: freezing a VIEW leaves
+    its base writeable; mutation through the base is then caught only by
+    the strided signature below.  A hit requires non-writeable + matching
+    (shape, dtype) + the sub-sample signature; anything else re-hashes."""
     import hashlib
     import weakref
 
@@ -289,8 +293,9 @@ def _content_stamp(a: np.ndarray) -> bytes:
                             digest_size=16).digest()
     if memoizable:
         try:
+            was_writeable = bool(a.flags.writeable)
             entry = (weakref.ref(a), (a.shape, a.dtype.str),
-                     _quick_sig(a), stamp)
+                     _quick_sig(a), stamp, was_writeable)
             a.flags.writeable = False  # mutations now raise, loudly
             _STAMP_MEMO[memo_key] = entry
         except (TypeError, ValueError):
@@ -298,8 +303,21 @@ def _content_stamp(a: np.ndarray) -> bytes:
         for k in [k for k, v in _STAMP_MEMO.items() if v[0]() is None]:
             _STAMP_MEMO.pop(k)  # prune entries whose array died
         while len(_STAMP_MEMO) > _STAMP_MEMO_MAX:
-            _STAMP_MEMO.pop(next(iter(_STAMP_MEMO)))
+            _evict_stamp(next(iter(_STAMP_MEMO)))
     return stamp
+
+
+def _evict_stamp(key) -> None:
+    """Drop a memo entry and lift its freeze (the caller owns the array
+    again once nothing vouches for its content)."""
+    entry = _STAMP_MEMO.pop(key, None)
+    if entry is not None:
+        arr = entry[0]()
+        if arr is not None and entry[4]:  # restore ONLY if we froze it
+            try:
+                arr.flags.writeable = True
+            except ValueError:
+                pass  # view of a non-writeable base: leave as-is
 
 
 def place_cached(arr: np.ndarray, axes: tuple,

@@ -78,19 +78,46 @@ SEED_TEXTS: Dict[str, str] = {
     "cs": ("rychlá hnědá liška skáče přes líného psa a potom běží zpátky "
            "domů protože večer už bylo pozdě když všechny děti už spaly a "
            "světla města zhasínala jedno po druhém zatímco déšť dál tiše "
-           "padal na střechy domů"),
+           "padal na střechy domů "
+           # everyday register (requests, work, errands) — the short-string
+           # case needs n-grams from common verbs and clitics, not just the
+           # narrative passage above
+           "dobrý den chtěl bych se zeptat jestli máte ještě volné místo "
+           "na zítřejší odpoledne musím totiž odvézt auto do servisu a "
+           "nevím kolik to bude stát děkuji moc za odpověď napište mi "
+           "prosím co nejdřív nebo zavolejte na moje číslo které jsem vám "
+           "dal minulý týden v obchodě jsme koupili nové boty ale jsou "
+           "nám malé takže je musíme vyměnit"),
     "sk": ("rýchla hnedá líška skáče cez lenivého psa a potom beží späť "
            "domov pretože večer už bolo neskoro keď všetky deti už spali a "
            "svetlá mesta zhasínali jedno po druhom zatiaľ čo dážď ďalej "
-           "ticho padal na strechy domov"),
+           "ticho padal na strechy domov "
+           "dobrý deň chcel by som sa opýtať či máte ešte voľné miesto na "
+           "zajtrajšie popoludnie musím totiž odviezť auto do servisu a "
+           "neviem koľko to bude stáť ďakujem pekne za odpoveď napíšte mi "
+           "prosím čo najskôr alebo zavolajte na moje číslo ktoré som vám "
+           "dal minulý týždeň v obchode sme kúpili nové topánky ale sú "
+           "nám malé takže ich musíme vymeniť"),
     "ro": ("vulpea maro rapidă sare peste câinele leneș și apoi aleargă "
            "înapoi acasă pentru că se făcea târziu seara când toți copiii "
            "dormeau deja și luminile orașului se stingeau una câte una în "
-           "timp ce ploaia continua să cadă încet pe acoperișuri"),
+           "timp ce ploaia continua să cadă încet pe acoperișuri "
+           "bună ziua aș vrea să întreb dacă mai aveți locuri libere "
+           "pentru mâine după amiază trebuie să duc mașina la service și "
+           "nu știu cât o să coste mulțumesc frumos pentru răspuns "
+           "scrieți-mi vă rog cât mai repede sau sunați-mă la numărul pe "
+           "care vi l-am dat săptămâna trecută am cumpărat pantofi noi "
+           "din magazin dar ne sunt mici așa că trebuie să îi schimbăm"),
     "hu": ("a gyors barna róka átugrik a lusta kutya fölött aztán "
            "hazaszalad mert este már későre járt amikor a gyerekek már mind "
            "aludtak és a város fényei egymás után aludtak ki miközben az "
-           "eső tovább hullott halkan a háztetőkre"),
+           "eső tovább hullott halkan a háztetőkre "
+           "jó napot kívánok szeretném megkérdezni hogy van-e még szabad "
+           "hely holnap délutánra ugyanis el kell vinnem az autót a "
+           "szervizbe és nem tudom mennyibe fog kerülni köszönöm szépen a "
+           "választ kérem írjon minél hamarabb vagy hívjon fel azon a "
+           "számon amit múlt héten adtam meg a boltban új cipőt vettünk "
+           "de kicsi lett ezért ki kell cserélnünk"),
     "fi": ("nopea ruskea kettu hyppää laiskan koiran yli ja juoksee sitten "
            "takaisin kotiin koska illalla alkoi jo olla myöhä kun kaikki "
            "lapset jo nukkuivat ja kaupungin valot sammuivat yksi "
@@ -99,15 +126,40 @@ SEED_TEXTS: Dict[str, str] = {
     "sv": ("den snabba bruna räven hoppar över den lata hunden och springer "
            "sedan tillbaka hem eftersom det redan började bli sent på "
            "kvällen när alla barnen redan sov och stadens ljus slocknade "
-           "ett efter ett medan regnet fortsatte att falla mjukt på taken"),
+           "ett efter ett medan regnet fortsatte att falla mjukt på taken "
+           "hej jag undrar om ni har en ledig tid i morgon eftermiddag "
+           "jag måste nämligen lämna in bilen på verkstaden och vet inte "
+           "vad det kommer att kosta tack för svaret skriv gärna så "
+           "snabbt som möjligt eller ring mig på numret jag gav er förra "
+           "veckan vi köpte nya skor i affären men de är för små så vi "
+           "måste byta dem"),
     "no": ("den raske brune reven hopper over den late hunden og løper så "
            "tilbake hjem fordi det allerede begynte å bli sent på kvelden "
            "da alle barna allerede sov og byens lys slukket ett etter ett "
-           "mens regnet fortsatte å falle stille på takene"),
+           "mens regnet fortsatte å falle stille på takene "
+           "hei jeg lurer på om dere har ledig time i morgen ettermiddag "
+           "jeg må nemlig levere bilen på verksted og vet ikke hva det "
+           "kommer til å koste takk for svaret skriv gjerne så fort som "
+           "mulig eller ring meg på nummeret jeg ga dere forrige uke vi "
+           "kjøpte nye sko i butikken men de er for små så vi må bytte "
+           "dem "
+           # distinctly norwegian orthography (hva/nå/uke/ikke noe/veldig)
+           "hva skjer nå spurte hun og så ut av vinduet det var ikke noe "
+           "særlig å se bare noen måker over brygga og en gammel båt som "
+           "lå og vugget vi hadde vært der en hel uke og det regnet "
+           "nesten hver eneste dag men det gjorde ikke så mye for vi "
+           "hadde det veldig hyggelig likevel og etterpå gikk vi opp på "
+           "fjellet da været endelig ble bedre"),
     "da": ("den hurtige brune ræv springer over den dovne hund og løber så "
            "tilbage hjem fordi det allerede var ved at blive sent om "
            "aftenen da alle børnene allerede sov og byens lys slukkede et "
-           "efter et mens regnen blev ved med at falde blidt på tagene"),
+           "efter et mens regnen blev ved med at falde blidt på tagene "
+           "hej jeg vil gerne høre om i har en ledig tid i morgen "
+           "eftermiddag jeg skal nemlig aflevere bilen på værksted og ved "
+           "ikke hvad det kommer til at koste tak for svaret skriv gerne "
+           "så hurtigt som muligt eller ring til mig på det nummer jeg "
+           "gav jer i sidste uge vi købte nye sko i butikken men de er "
+           "for små så vi bliver nødt til at bytte dem"),
     "tr": ("hızlı kahverengi tilki tembel köpeğin üzerinden atlar ve sonra "
            "eve geri koşar çünkü akşam artık geç oluyordu bütün çocuklar "
            "çoktan uyurken ve şehrin ışıkları birer birer sönerken yağmur "
