@@ -1,0 +1,73 @@
+"""Two-process jax.distributed worker (launched by test_distributed.py).
+
+Each process: bootstrap the group via the framework's ``initialize``, build
+``global_mesh``, ingest ONLY its ``host_local_rows`` slice, assemble the
+global row-sharded array, and run a jitted column-stats program whose row
+reductions become psums across processes — the driver/executor split the
+reference exercises with Spark local[2] (TestSparkContext.scala:47-61).
+
+argv: <process_id> <coordinator_port> <out_json_path>
+"""
+import json
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+out_path = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# the axon sitecustomize pre-imports jax and snapshots JAX_PLATFORMS, so the
+# env var alone cannot force CPU here (same trick as tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
+
+from transmogrifai_tpu.parallel import distributed  # noqa: E402
+
+distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                       num_processes=2, process_id=pid)
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()
+
+mesh = distributed.global_mesh()  # (data=4, model=1) over both processes
+n, d = 1024, 8
+rng = np.random.default_rng(0)
+x_full = rng.normal(size=(n, d)).astype(np.float32)
+y_full = (rng.random(n) < 0.5).astype(np.float32)
+
+# each process materializes ONLY its host-local slice (the readers' contract)
+sl = distributed.host_local_rows(n)
+x_local, y_local = x_full[sl], y_full[sl]
+
+sx = NamedSharding(mesh, P("data", None))
+sy = NamedSharding(mesh, P("data"))
+x = jax.make_array_from_process_local_data(sx, x_local)
+y = jax.make_array_from_process_local_data(sy, y_local)
+
+
+@jax.jit
+def col_stats(x, y):
+    mean = x.mean(axis=0)
+    var = x.var(axis=0)
+    xc = x - mean
+    yc = y - y.mean()
+    cov = (xc * yc[:, None]).mean(axis=0)
+    corr = cov / jnp.maximum(xc.std(axis=0) * yc.std(), 1e-12)
+    return mean, var, corr
+
+
+mean, var, corr = [np.asarray(v) for v in col_stats(x, y)]
+info = distributed.process_info()
+if pid == 0:
+    with open(out_path, "w") as fh:
+        json.dump({"mean": mean.tolist(), "var": var.tolist(),
+                   "corr": corr.tolist(), "info": info}, fh)
+print("WORKER_OK", pid, flush=True)

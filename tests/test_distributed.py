@@ -1,5 +1,7 @@
 """Multi-host bootstrap helpers (SURVEY §5.8) — single-host semantics."""
 
+import os
+
 import jax
 import numpy as np
 
@@ -111,3 +113,58 @@ class TestFailHardOnMultiWorkerMarkers:
 
         monkeypatch.setattr(jax.distributed, "initialize", boom)
         D.initialize()  # must not raise for a 1-host slice
+
+
+class TestTwoProcessExecution:
+    """REAL multi-process coverage (VERDICT r3 #8): two OS processes
+    bootstrap via jax.distributed (localhost coordinator, CPU backend, 2
+    virtual devices each), build the framework's global_mesh, ingest
+    host_local_rows slices, and the psum-backed column stats must match a
+    single-process numpy computation."""
+
+    def test_two_process_column_stats_match_single_process(self, tmp_path):
+        import json
+        import socket
+        import subprocess
+        import sys
+
+        worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "distributed_worker.py")
+        with socket.socket() as s:  # pick a free port
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        out = str(tmp_path / "stats.json")
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(i), str(port), out],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+            for i in range(2)]
+        outputs = []
+        for p in procs:
+            try:
+                stdout, _ = p.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outputs.append(stdout.decode())
+        for i, (p, text) in enumerate(zip(procs, outputs)):
+            assert p.returncode == 0, f"worker {i} failed:\n{text[-2000:]}"
+            assert f"WORKER_OK {i}" in text
+
+        got = json.load(open(out))
+        assert got["info"]["processCount"] == 2
+        assert got["info"]["globalDevices"] == 4
+
+        # single-process reference on the same data
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1024, 8)).astype(np.float32)
+        y = (rng.random(1024) < 0.5).astype(np.float32)
+        xc = x - x.mean(0)
+        yc = y - y.mean()
+        corr = (xc * yc[:, None]).mean(0) / np.maximum(
+            xc.std(0) * yc.std(), 1e-12)
+        np.testing.assert_allclose(got["mean"], x.mean(0), atol=1e-5)
+        np.testing.assert_allclose(got["var"], x.var(0), atol=1e-5)
+        np.testing.assert_allclose(got["corr"], corr, atol=1e-4)

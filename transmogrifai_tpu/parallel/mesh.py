@@ -284,7 +284,11 @@ def _content_stamp(a: np.ndarray) -> bytes:
     if memoizable:  # the memo (and _quick_sig) need zero-copy byte views
         memo_key = id(a)
         hit = _STAMP_MEMO.get(memo_key)
-        if hit is not None and hit[0]() is a and not a.flags.writeable \
+        # owners must still be frozen (a re-enabled writeable flag means the
+        # caller intends to mutate -> full re-hash); views were never frozen
+        # and are vouched for by the strided signature alone
+        frozen_ok = (not a.flags.writeable) or a.base is not None
+        if hit is not None and hit[0]() is a and frozen_ok \
                 and hit[1] == (a.shape, a.dtype.str) \
                 and hit[2] == _quick_sig(a):
             return hit[3]
@@ -293,13 +297,19 @@ def _content_stamp(a: np.ndarray) -> bytes:
                             digest_size=16).digest()
     if memoizable:
         try:
-            was_writeable = bool(a.flags.writeable)
+            # only FREEZE arrays that own their buffer: freezing a view can
+            # become irreversible when the base is itself frozen (restore
+            # raises), and mutation through the base bypasses the view flag
+            # anyway — views rely on the quick_sig belt alone
+            owns = a.base is None
+            was_writeable = bool(a.flags.writeable) and owns
             entry = (weakref.ref(a), (a.shape, a.dtype.str),
                      _quick_sig(a), stamp, was_writeable)
-            a.flags.writeable = False  # mutations now raise, loudly
+            if owns:
+                a.flags.writeable = False  # mutations now raise, loudly
             _STAMP_MEMO[memo_key] = entry
         except (TypeError, ValueError):
-            pass  # weakref-refusing subclass / flag-locked view: no memo
+            pass  # weakref-refusing subclass / flag-locked array: no memo
         for k in [k for k, v in _STAMP_MEMO.items() if v[0]() is None]:
             _STAMP_MEMO.pop(k)  # prune entries whose array died
         while len(_STAMP_MEMO) > _STAMP_MEMO_MAX:

@@ -456,6 +456,26 @@ STOPWORDS: Dict[str, FrozenSet[str]] = {
         tidak dalam akan ada juga saya kamu dia kami mereka atau tetapi
         karena sudah telah bisa harus oleh sebagai lebih sangat satu
         dua""".split()),
+    "cs": frozenset("""a i v na je se že s z o do pro ale jako by bylo být
+        jsem jsi jsou byl byla ten ta to tento tato toto který která
+        které kde když už jen také ještě nebo při od po za před mezi bez
+        co jak tak jeho její jejich nás vás""".split()),
+    "sk": frozenset("""a aj v na je sa že s z o do pre ale ako by bolo byť
+        som si sú bol bola ten tá to tento táto toto ktorý ktorá ktoré
+        kde keď už len tiež ešte alebo pri od po za pred medzi bez čo
+        ako tak jeho jej ich nás vás""".split()),
+    "ro": frozenset("""și în de la cu pe un o a al ai ale că nu este sunt
+        era fi fost mai dar sau dacă când unde care cine ce cum pentru
+        prin după între fără sub peste acest această acestei lui ei lor
+        noi voi se își""".split()),
+    "hu": frozenset("""a az és hogy nem is egy ez az volt van lesz már
+        csak meg de ha mint még el ki be fel le mert vagy pedig én te ő
+        mi ti ők ezt azt ezek azok mind minden nagyon itt ott ahol
+        amikor aki ami""".split()),
+    "el": frozenset("""ο η το οι τα του της των τον την και να με σε για
+        από που δεν θα είναι ήταν έχει είχε αυτό αυτή αυτός ως κατά μετά
+        πριν χωρίς πάνω κάτω μέσα έξω ένα μια πολύ πιο όπως όταν αλλά ή
+        αν τι πως""".split()),
 }
 
 
@@ -613,6 +633,73 @@ _STEMMERS = {
         ("ksi", ""), ("iin", ""), ("een", ""), ("ina", ""), ("inä", ""),
         ("ien", ""), ("jen", ""), ("en", ""), ("in", ""), ("t", ""),
         ("n", ""), ("a", ""), ("ä", "")]),
+    # --- r4 breadth (VERDICT r3 #7): ten more of the reference's Lucene
+    # analyzer languages, same ordered longest-suffix-first design ---
+    "da": _suffix_stemmer([
+        ("hederne", "hed"), ("heden", "hed"), ("heder", "hed"),
+        ("erne", ""), ("ene", ""), ("erede", ""), ("ende", ""),
+        ("ede", ""), ("er", ""), ("en", ""), ("et", ""),
+        ("e", ""), ("s", "")], min_stem=3),
+    "no": _suffix_stemmer([
+        ("hetene", "het"), ("heten", "het"), ("heter", "het"),
+        ("ene", ""), ("ane", ""), ("ende", ""), ("ede", ""),
+        ("ert", ""), ("este", ""), ("er", ""), ("en", ""), ("et", ""),
+        ("a", ""), ("e", ""), ("s", "")], min_stem=3),
+    "pl": _suffix_stemmer([
+        ("ościami", "ość"), ("ościach", "ość"), ("ością", "ość"),
+        ("ości", "ość"), ("owania", ""), ("owanie", ""), ("ego", ""),
+        ("emu", ""), ("ach", ""), ("ami", ""), ("ych", ""), ("ymi", ""),
+        ("iej", ""), ("ej", ""), ("ów", ""), ("om", ""), ("ie", ""),
+        ("ia", ""), ("ą", ""), ("ę", ""), ("y", ""), ("i", ""),
+        ("e", ""), ("a", ""), ("o", ""), ("u", "")], min_stem=3),
+    "tr": _suffix_stemmer([
+        ("larının", ""), ("lerinin", ""), ("larında", ""),
+        ("lerinde", ""), ("lardan", ""), ("lerden", ""), ("ların", ""),
+        ("lerin", ""), ("ları", ""), ("leri", ""), ("ında", ""),
+        ("inde", ""), ("unda", ""), ("ünde", ""), ("ından", ""),
+        ("inden", ""), ("lar", ""), ("ler", ""), ("dan", ""),
+        ("den", ""), ("tan", ""), ("ten", ""), ("da", ""), ("de", ""),
+        ("ta", ""), ("te", ""), ("ın", ""), ("in", ""), ("un", ""),
+        ("ün", ""), ("ı", ""), ("i", ""), ("u", ""), ("ü", "")],
+        min_stem=3),
+    "id": _suffix_stemmer([
+        ("kannya", ""), ("annya", ""), ("kan", ""), ("nya", ""),
+        ("lah", ""), ("kah", ""), ("an", ""), ("i", "")], min_stem=4),
+    "cs": _suffix_stemmer([
+        ("ostech", "ost"), ("ostem", "ost"), ("ostmi", "ost"),
+        ("osti", "ost"), ("ování", ""), ("ech", ""), ("ích", ""),
+        ("ami", ""), ("emi", ""), ("ého", ""), ("ému", ""), ("ých", ""),
+        ("ým", ""), ("ům", ""), ("ou", ""), ("ů", ""), ("é", ""),
+        ("ý", ""), ("á", ""), ("í", ""), ("y", ""), ("i", ""),
+        ("e", ""), ("a", ""), ("o", ""), ("u", "")], min_stem=3),
+    "sk": _suffix_stemmer([
+        ("ostiach", "ost"), ("ostiam", "ost"), ("osťami", "ost"),
+        ("osti", "ost"), ("osť", "ost"), ("ovanie", ""), ("och", ""),
+        ("iach", ""), ("ách", ""), ("ám", ""), ("ami", ""), ("ého", ""), ("ému", ""),
+        ("ých", ""), ("ým", ""), ("ov", ""), ("ou", ""), ("é", ""),
+        ("ý", ""), ("á", ""), ("í", ""), ("y", ""), ("i", ""),
+        ("e", ""), ("a", ""), ("o", ""), ("u", "")], min_stem=3),
+    "ro": _suffix_stemmer([
+        ("urilor", ""), ("urile", ""), ("elor", ""), ("ilor", ""),
+        ("ului", ""),
+        ("ează", ""), ("ească", ""), ("ele", ""), ("ile", ""),
+        ("are", ""), ("ere", ""), ("ire", ""), ("ii", ""), ("ul", ""),
+        ("ă", ""), ("a", ""), ("e", ""), ("i", "")], min_stem=3),
+    "hu": _suffix_stemmer([
+        ("okból", ""), ("ekből", ""), ("okban", ""), ("ekben", ""),
+        ("ában", ""), ("ében", ""), ("ságok", "ság"), ("ségek", "ség"),
+        ("ból", ""), ("ből", ""), ("ban", ""), ("ben", ""),
+        ("nak", ""), ("nek", ""), ("val", ""), ("vel", ""),
+        ("ról", ""), ("ről", ""), ("hoz", ""), ("hez", ""),
+        ("ság", ""), ("ség", ""), ("ok", ""), ("ek", ""), ("ak", ""),
+        ("át", ""), ("et", ""), ("ot", ""), ("t", ""), ("k", "")],
+        min_stem=3),
+    "el": _suffix_stemmer([
+        ("ότητας", ""), ("ότητα", ""), ("ματος", "μα"), ("ματα", "μα"),
+        ("ικός", ""), ("ικής", ""), ("ική", ""), ("ικό", ""),
+        ("ους", ""), ("ων", ""), ("ες", ""), ("ος", ""), ("ου", ""),
+        ("ας", ""), ("ης", ""), ("α", ""), ("η", ""), ("ο", ""),
+        ("ι", "")], min_stem=3),
 }
 
 STEMMED_LANGUAGES: Tuple[str, ...] = tuple(sorted(_STEMMERS))

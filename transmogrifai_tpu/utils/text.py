@@ -13,6 +13,37 @@ from typing import List, Optional, Sequence
 
 _TOKEN_RE = re.compile(r"[^\W\d_]+|\d+", re.UNICODE)
 
+# CJK scripts that carry no word delimiters: Han (incl. extension A and
+# compatibility ideographs), Hiragana, Katakana (incl. phonetic extensions).
+# Hangul is space-delimited in modern Korean and keeps whole-word tokens.
+_CJK_RUN_RE = re.compile(
+    "[㐀-䶿一-鿿豈-﫿"
+    "぀-ゟ゠-ヿㇰ-ㇿ]+")
+
+
+def _cjk_bigrams(run: str) -> List[str]:
+    """Overlapping character bigrams of one CJK run (unigram for singletons)
+    — the Lucene CJKAnalyzer recipe (LuceneTextAnalyzer.scala routes zh/ja
+    to bigram analyzers): no dictionary, stable hash features, and two-char
+    units approximate real word boundaries well for Chinese and Japanese."""
+    if len(run) < 2:
+        return [run]
+    return [run[i:i + 2] for i in range(len(run) - 1)]
+
+
+def _segment_cjk(token: str) -> List[str]:
+    """Split a mixed token into CJK bigrams + non-CJK remainder pieces."""
+    out: List[str] = []
+    pos = 0
+    for m in _CJK_RUN_RE.finditer(token):
+        if m.start() > pos:
+            out.append(token[pos:m.start()])
+        out.extend(_cjk_bigrams(m.group()))
+        pos = m.end()
+    if pos < len(token):
+        out.append(token[pos:])
+    return out
+
 # minimal English stop set (reference uses Lucene per-language analyzers)
 STOP_WORDS = frozenset(
     """a an and are as at be but by for if in into is it no not of on or such that the
@@ -33,9 +64,21 @@ def tokenize(
         return []
     if to_lowercase:
         text = text.lower()
+    # ONE scan of the raw string decides the CJK path (CJK chars always
+    # survive _TOKEN_RE, so this is equivalent to scanning every token —
+    # and keeps the pure-Latin hashing hot path at a single regex pass)
+    has_cjk = _CJK_RUN_RE.search(text) is not None
     tokens = _TOKEN_RE.findall(text)
+    # undelimited CJK runs segment into overlapping character bigrams so
+    # zh/ja free text feeds the hashing trick with word-like units instead
+    # of one giant token per clause (the Lucene CJKAnalyzer role)
+    if has_cjk:
+        tokens = [piece for t in tokens for piece in _segment_cjk(t)]
     if min_token_length > 1:
-        tokens = [t for t in tokens if len(t) >= min_token_length]
+        # CJK bigrams are 2 chars by construction and survive any sane
+        # min length; latin filtering applies unchanged
+        tokens = [t for t in tokens if len(t) >= min_token_length
+                  or _CJK_RUN_RE.search(t)]
     if remove_stop_words:
         tokens = [t for t in tokens if t not in STOP_WORDS]
     return tokens
