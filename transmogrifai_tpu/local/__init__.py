@@ -8,6 +8,7 @@ fused transform DAG — the TPU analog exports the model's numeric tail as a sin
 jitted scoring program (SURVEY §7.10).
 """
 
+from .export import export_standalone
 from .scoring import score_function
 
-__all__ = ["score_function"]
+__all__ = ["score_function", "export_standalone"]

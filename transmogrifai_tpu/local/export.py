@@ -1,0 +1,356 @@
+"""Standalone (numpy-only) scoring export — the MLeap-bundle role.
+
+Reference: MLeap serialization gives the reference a serving artifact
+loadable OUTSIDE the training stack (OpWorkflowModelLocal.scala:93-200 runs
+scoring with no Spark session).  ``export_standalone(model, out_dir)`` plays
+that role natively: it compiles a fitted linear/tree pipeline into
+
+    out_dir/
+      scorer.py       self-contained numpy interpreter (no jax, no
+                      transmogrifai_tpu import — stdlib + numpy only)
+      program.json    the op program (stage semantics, column wiring)
+      arrays.npz      fitted parameters (fills, vocabs sidecar, coefs, trees)
+
+Supported stages — exactly the linear+tree serving surface: field-extract
+feature generators, Numeric/RealNN vectorizers, one-hot with
+other/null tracking, VectorsCombiner, SanityChecker column selection, and
+LogisticRegression / LinearRegression / LinearSVC / GBT / RandomForest
+models.  Anything else raises at export time with the stage named.
+
+The generated scorer reproduces the framework's HOST prediction paths
+(float64 matvecs; the trees' vectorized numpy traversal), so
+``scorer.score(records)`` round-trips the in-process ``score_function``
+within 1e-6.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..features.feature import _NamedExtract
+from ..workflow.fit import _resolve
+from .scoring import LocalScorer
+
+#: op kinds that terminate the program with a prediction payload
+_MODEL_OPS = frozenset({"logistic", "linear", "svc", "trees"})
+
+
+def export_standalone(model, out_dir: str) -> str:
+    """Compile ``model`` (a fitted WorkflowModel) into a numpy-only scoring
+    directory; returns the path to the generated ``scorer.py``."""
+    scorer = LocalScorer(model)
+    arrays: Dict[str, np.ndarray] = {}
+    ops: List[dict] = []
+
+    def store(name: str, arr) -> str:
+        arrays[name] = np.asarray(arr)
+        return name
+
+    raw_inputs: List[dict] = []
+    for g in scorer._generators:
+        if g.is_response:
+            continue  # labels are absent at serving time
+        if not isinstance(g.extract_fn, _NamedExtract):
+            raise ValueError(
+                f"standalone export requires field-extract raw features; "
+                f"{g.raw_name!r} uses a custom extract function")
+        kind = "numeric" if _is_numeric_ftype(g.ftype) else "string"
+        raw_inputs.append({"name": g.raw_name,
+                           "key": g.extract_fn.key, "kind": kind})
+
+    for i, stage in enumerate(scorer._plan):
+        runner = _resolve(stage, scorer._fitted)
+        ops.append(_compile_stage(i, stage, runner, store))
+    if not ops or ops[-1]["op"] not in _MODEL_OPS:
+        raise ValueError(
+            "standalone export requires the pipeline to END in a "
+            "linear/tree model stage (the scorer's output contract); got "
+            f"{ops[-1]['op'] if ops else 'an empty plan'}")
+
+    os.makedirs(out_dir, exist_ok=True)
+    program = {"raw_inputs": raw_inputs, "ops": ops}
+    with open(os.path.join(out_dir, "program.json"), "w") as fh:
+        json.dump(program, fh, indent=1)
+    np.savez_compressed(os.path.join(out_dir, "arrays.npz"), **arrays)
+    scorer_path = os.path.join(out_dir, "scorer.py")
+    with open(scorer_path, "w") as fh:
+        fh.write(_SCORER_TEMPLATE)
+    return scorer_path
+
+
+def _is_numeric_ftype(ftype) -> bool:
+    from ..types import OPNumeric
+
+    return issubclass(ftype, OPNumeric)
+
+
+def _compile_stage(i: int, stage, runner, store) -> dict:
+    from ..checkers.sanity import SanityCheckerModel
+    from ..models.linear import LinearRegressionModel
+    from ..models.logistic import LogisticRegressionModel
+    from ..models.selector import SelectedModel
+    from ..models.svm import LinearSVCModel
+    from ..models.trees import (ForestClassifierModel, ForestRegressorModel,
+                                GBTClassifierModel, GBTRegressorModel)
+    from ..ops.combiner import VectorsCombiner
+    from ..ops.numeric import NumericVectorizerModel, RealNNVectorizer
+    from ..ops.onehot import OneHotVectorizerModel
+
+    name = type(runner).__name__
+    inputs = [f.name for f in stage.inputs]
+    out = stage.output_name
+
+    if isinstance(runner, NumericVectorizerModel):
+        return {"op": "numeric_vectorize", "inputs": inputs, "out": out,
+                "fills": store(f"op{i}_fills", runner.fills),
+                "track_nulls": bool(runner.track_nulls)}
+    if isinstance(runner, RealNNVectorizer):
+        return {"op": "numeric_vectorize", "inputs": inputs, "out": out,
+                "fills": store(f"op{i}_fills",
+                               np.zeros(len(inputs))),
+                "track_nulls": False}
+    if isinstance(runner, OneHotVectorizerModel):
+        from ..ops.onehot import MultiPickListVectorizerModel
+
+        multi = isinstance(runner, MultiPickListVectorizerModel)
+        return {"op": "multihot" if multi else "onehot",
+                "inputs": inputs, "out": out,
+                "vocabs": [[str(x) for x in v] for v in runner.vocabs],
+                "clean_text": bool(runner.clean_text),
+                "track_nulls": bool(runner.track_nulls)}
+    if isinstance(runner, VectorsCombiner):
+        return {"op": "concat", "inputs": inputs, "out": out}
+    if isinstance(runner, SanityCheckerModel):
+        return {"op": "select", "inputs": inputs[1:], "out": out,
+                "indices": store(f"op{i}_kept",
+                                 np.asarray(runner.kept_indices, np.int64))}
+    if isinstance(runner, SelectedModel):
+        runner = runner.model
+        name = type(runner).__name__
+        inputs = inputs[1:]  # drop the label slot
+    if isinstance(runner, (LogisticRegressionModel, LinearRegressionModel,
+                           LinearSVCModel)):
+        kind = {"LogisticRegressionModel": "logistic",
+                "LinearRegressionModel": "linear",
+                "LinearSVCModel": "svc"}[type(runner).__name__]
+        return {"op": kind, "inputs": inputs, "out": out,
+                "coef": store(f"op{i}_coef", runner.coef),
+                "intercept": float(runner.intercept)}
+    if isinstance(runner, (GBTClassifierModel, GBTRegressorModel,
+                           ForestClassifierModel, ForestRegressorModel)):
+        spec = {"op": "trees", "inputs": inputs, "out": out,
+                "flavor": {"GBTClassifierModel": "gbt_cls",
+                           "GBTRegressorModel": "gbt_reg",
+                           "ForestClassifierModel": "rf_cls",
+                           "ForestRegressorModel": "rf_reg"}[name],
+                "max_depth": int(runner.max_depth),
+                "n_bins": int(runner.n_bins),
+                "edges": store(f"op{i}_edges", runner.edges),
+                "base_score": store(f"op{i}_base", runner.base_score)}
+        for k, v in runner.trees.items():
+            spec[f"t_{k}"] = store(f"op{i}_t_{k}", v)
+        return spec
+    raise ValueError(
+        f"standalone export supports linear+tree pipelines; stage "
+        f"{stage.uid} resolved to unsupported {name}")
+
+
+_SCORER_TEMPLATE = '''"""GENERATED standalone scorer — numpy + stdlib only (MLeap-bundle role).
+
+Usage:
+    from scorer import Scorer
+    s = Scorer(__file__rooted_dir)   # or Scorer() for the file's own dir
+    out = s.score([{"x1": 0.3, "color": "red"}, ...])
+    # -> [{"prediction": 1.0, "probability": [..], "score": ..}, ...]
+"""
+import json
+import os
+
+import numpy as np
+
+# intentionally no jax / framework imports anywhere in this module — the
+# round-trip test asserts sys.modules stays clean after scoring
+
+
+class Scorer:
+    def __init__(self, base_dir=None):
+        base = base_dir or os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(base, "program.json")) as fh:
+            self.program = json.load(fh)
+        self.arrays = dict(np.load(os.path.join(base, "arrays.npz"),
+                                   allow_pickle=False))
+
+    # -- raw extraction ----------------------------------------------------
+    def _extract(self, records):
+        cols = {}
+        for spec in self.program["raw_inputs"]:
+            key = spec["key"]
+            if spec["kind"] == "numeric":
+                vals = np.array(
+                    [self._num(r.get(key)) for r in records], np.float64)
+            else:
+                vals = [r.get(key) for r in records]
+            cols[spec["name"]] = vals
+        return cols
+
+    @staticmethod
+    def _num(v):
+        if v is None or v == "":
+            return np.nan
+        return float(v)
+
+    @staticmethod
+    def _clean(v):
+        return "".join(ch for ch in str(v).strip()
+                       if ch.isalnum() or ch == " ")
+
+    # -- ops ---------------------------------------------------------------
+    def score(self, records):
+        cols = self._extract(records)
+        n = len(records)
+        out_col = None
+        for op in self.program["ops"]:
+            kind = op["op"]
+            if kind == "numeric_vectorize":
+                x = np.column_stack([cols[c] for c in op["inputs"]])
+                nan = np.isnan(x)
+                filled = np.where(nan, self.arrays[op["fills"]][None, :], x)
+                if op["track_nulls"]:
+                    # interleaved [value, null] per feature, f32 emit —
+                    # exactly the framework vectorizer's block layout
+                    nn, d = filled.shape
+                    block = np.empty((nn, 2 * d), np.float32)
+                    block[:, 0::2] = filled
+                    block[:, 1::2] = nan
+                else:
+                    block = filled.astype(np.float32)
+                cols[op["out"]] = block.astype(np.float64)
+            elif kind == "onehot":
+                blocks = []
+                for cname, vocab in zip(op["inputs"], op["vocabs"]):
+                    vals = cols[cname]
+                    k = len(vocab)
+                    width = k + 1 + (1 if op["track_nulls"] else 0)
+                    block = np.zeros((n, width), np.float64)
+                    index = {v: i for i, v in enumerate(vocab)}
+                    for i, v in enumerate(vals):
+                        if v is None or v == "":
+                            if op["track_nulls"]:
+                                block[i, k + 1] = 1.0
+                            continue
+                        key = self._clean(v) if op["clean_text"] else v
+                        j = index.get(key)
+                        block[i, k if j is None else j] = 1.0
+                    blocks.append(block)
+                cols[op["out"]] = np.hstack(blocks)
+            elif kind == "multihot":
+                blocks = []
+                for cname, vocab in zip(op["inputs"], op["vocabs"]):
+                    vals = cols[cname]
+                    k = len(vocab)
+                    width = k + 1 + (1 if op["track_nulls"] else 0)
+                    block = np.zeros((n, width), np.float64)
+                    index = {v: i for i, v in enumerate(vocab)}
+                    for i, members in enumerate(vals):
+                        if not members:
+                            if op["track_nulls"]:
+                                block[i, k + 1] = 1.0
+                            continue
+                        for v in members:
+                            key = self._clean(v) if op["clean_text"] else v
+                            j = index.get(key)
+                            block[i, k if j is None else j] = 1.0
+                    blocks.append(block)
+                cols[op["out"]] = np.hstack(blocks)
+            elif kind == "concat":
+                cols[op["out"]] = np.hstack(
+                    [cols[c] for c in op["inputs"]])
+            elif kind == "select":
+                cols[op["out"]] = \
+                    cols[op["inputs"][0]][:, self.arrays[op["indices"]]]
+            elif kind in ("logistic", "linear", "svc"):
+                x = cols[op["inputs"][0]]
+                z = x @ self.arrays[op["coef"]] + op["intercept"]
+                if kind == "logistic":
+                    p1 = 1.0 / (1.0 + np.exp(-z))
+                    res = {"prediction": (p1 > 0.5).astype(np.float64),
+                           "probability": np.column_stack([1 - p1, p1]),
+                           "score": z}
+                elif kind == "svc":
+                    res = {"prediction": (z > 0).astype(np.float64),
+                           "probability": None, "score": z}
+                else:
+                    res = {"prediction": z, "probability": None, "score": z}
+                out_col = res
+                cols[op["out"]] = z
+            elif kind == "trees":
+                out_col = self._trees(op, cols[op["inputs"][0]])
+                cols[op["out"]] = out_col["score"]
+            else:
+                raise ValueError(f"unknown op {kind}")
+        rows = []
+        for i in range(n):
+            row = {"prediction": float(out_col["prediction"][i]),
+                   "score": float(np.asarray(out_col["score"][i]).ravel()[0])}
+            if out_col["probability"] is not None:
+                row["probability"] = [float(v)
+                                      for v in out_col["probability"][i]]
+            rows.append(row)
+        return rows
+
+    def _trees(self, op, x):
+        a = self.arrays
+        edges = a[op["edges"]]
+        n_bins = op["n_bins"]
+        x = x.astype(np.float32)  # bin-edge compares mirror the f32 fit path
+        n, d = x.shape
+        binned = np.empty((n, d), np.int32)
+        for j in range(d):
+            binned[:, j] = np.searchsorted(edges[j], x[:, j], side="right")
+        binned[~np.isfinite(x)] = n_bins
+        feat, thr = a[op["t_feat"]], a[op["t_thr_bin"]]
+        miss, leaf = a[op["t_miss_left"]], a[op["t_is_leaf"]]
+        value = a[op["t_value"]]
+        T = feat.shape[0]
+        node = np.zeros((T, n), np.int32)
+        rows = np.arange(n)
+        for _ in range(op["max_depth"]):
+            nf = np.take_along_axis(feat, node, 1)
+            nb = binned[rows[None, :], nf]
+            nmiss = np.take_along_axis(miss, node, 1)
+            nthr = np.take_along_axis(thr, node, 1)
+            go_left = np.where(nb == n_bins, nmiss, nb <= nthr)
+            child = np.where(go_left, 2 * node + 1, 2 * node + 2)
+            node = np.where(np.take_along_axis(leaf, node, 1), node, child)
+        margin = value[np.arange(T)[:, None], node].sum(axis=0) \
+            .astype(np.float64) + a[op["base_score"]][None, :]
+        flavor = op["flavor"]
+        if flavor == "gbt_cls":
+            if margin.shape[1] == 1:
+                z = margin[:, 0]
+                p1 = 1.0 / (1.0 + np.exp(-z))
+                return {"prediction": (p1 > 0.5).astype(np.float64),
+                        "probability": np.column_stack([1 - p1, p1]),
+                        "score": z}
+            e = np.exp(margin - margin.max(axis=1, keepdims=True))
+            prob = e / e.sum(axis=1, keepdims=True)
+            return {"prediction": prob.argmax(1).astype(np.float64),
+                    "probability": prob, "score": prob.max(1)}
+        if flavor == "rf_cls":
+            mean = margin / T
+            if mean.shape[1] == 1:
+                p1 = np.clip(mean[:, 0], 0.0, 1.0)
+                return {"prediction": (p1 > 0.5).astype(np.float64),
+                        "probability": np.column_stack([1 - p1, p1]),
+                        "score": p1}
+            prob = np.clip(mean, 0.0, 1.0)
+            prob = prob / np.maximum(prob.sum(1, keepdims=True), 1e-12)
+            return {"prediction": prob.argmax(1).astype(np.float64),
+                    "probability": prob, "score": prob.max(1)}
+        pred = margin[:, 0] / (T if flavor == "rf_reg" else 1.0)
+        return {"prediction": pred, "probability": None, "score": pred}
+'''
