@@ -272,3 +272,180 @@ class TestJoinedAggregateReader:
         assert by_name["ann"] == 15.0
         assert by_name["bob"] == 7.0
         assert by_name["cat"] is None
+
+
+class TestMicroBatchStreaming:
+    """DStream-role streaming (VERDICT r3 missing #4): micro-batch clock,
+    checkpointed offsets with at-least-once replay, and backpressure."""
+
+    @staticmethod
+    def _raws():
+        from transmogrifai_tpu import FeatureBuilder
+        from transmogrifai_tpu.types import Real
+
+        return [FeatureBuilder.of("v", Real).extract_field().as_predictor()]
+
+    @staticmethod
+    def _reader(source, ckpt=None, **kw):
+        from transmogrifai_tpu.readers import MicroBatchStreamingReader
+
+        # virtual clock: no real sleeping in tests
+        t = [0.0]
+        kw.setdefault("clock", lambda: t[0])
+        kw.setdefault("sleep", lambda s: t.__setitem__(0, t[0] + s))
+        kw.setdefault("batch_interval", 1.0)
+        kw.setdefault("max_empty_polls", 1)
+        return MicroBatchStreamingReader(source, checkpoint=ckpt, **kw), t
+
+    def test_offsets_resume_after_commit(self, tmp_path):
+        from transmogrifai_tpu.readers import ListSource, OffsetCheckpoint
+
+        ckpt = OffsetCheckpoint(str(tmp_path / "offsets.json"))
+        records = [{"v": float(i)} for i in range(10)]
+        reader, _ = self._reader(ListSource(records, "s1"), ckpt,
+                                 max_batch_records=4)
+        seen = []
+        for ds in reader.stream_datasets(self._raws()):
+            seen.extend(np.asarray(ds["v"].data).tolist())
+            reader.commit()
+            if len(seen) >= 4:
+                break  # "crash" after the first committed batch
+        assert seen == [0.0, 1.0, 2.0, 3.0]
+
+        # restart from the checkpoint: continues at offset 4, no replay
+        reader2, _ = self._reader(ListSource(records, "s1"), ckpt,
+                                  max_batch_records=4)
+        rest = []
+        for ds in reader2.stream_datasets(self._raws()):
+            rest.extend(np.asarray(ds["v"].data).tolist())
+            reader2.commit()
+        assert rest == [4.0, 5.0, 6.0, 7.0, 8.0, 9.0]
+
+    def test_uncommitted_batch_replays(self, tmp_path):
+        from transmogrifai_tpu.readers import ListSource, OffsetCheckpoint
+
+        ckpt = OffsetCheckpoint(str(tmp_path / "offsets.json"))
+        records = [{"v": float(i)} for i in range(6)]
+        reader, _ = self._reader(ListSource(records, "s2"), ckpt,
+                                 max_batch_records=3)
+        it = reader.stream_datasets(self._raws())
+        next(it)  # first batch yielded but NEVER committed -> crash
+        reader2, _ = self._reader(ListSource(records, "s2"), ckpt,
+                                  max_batch_records=3)
+        ds = next(reader2.stream_datasets(self._raws()))
+        # at-least-once: the uncommitted batch is delivered again
+        assert np.asarray(ds["v"].data).tolist() == [0.0, 1.0, 2.0]
+
+    def test_backpressure_shrinks_then_recovers(self):
+        from transmogrifai_tpu.readers import ListSource
+
+        records = [{"v": float(i)} for i in range(4000)]
+        reader, t = self._reader(ListSource(records, "s3"),
+                                 max_batch_records=1024,
+                                 min_batch_records=8)
+        targets = []
+        slow = [True, True, True, False, False, False]
+        for i, ds in enumerate(reader.stream_datasets(self._raws())):
+            if i < len(slow) and slow[i]:
+                t[0] += 4.0  # consumer took 4x the batch interval
+            targets.append(reader.progress["target_records"])
+            reader.commit()
+            if i >= 5:
+                break
+        # targets[i] is read BEFORE batch i resumes the generator, so it
+        # reflects batch i-1's adjustment: slow batches shrink the target
+        # geometrically, fast ones recover it
+        assert targets[0] == 1024  # initial
+        assert targets[1] < targets[0]
+        assert targets[2] < targets[1]
+        assert max(targets[4:]) > min(targets[1:4])
+
+    def test_jsonl_tail_source_resumes_mid_file(self, tmp_path):
+        import json
+
+        from transmogrifai_tpu.readers import JsonlTailSource
+
+        p = str(tmp_path / "events.jsonl")
+        with open(p, "w") as fh:
+            for i in range(5):
+                fh.write(json.dumps({"v": i}) + "\n")
+            fh.write('{"v": 99')  # partial trailing line (writer mid-append)
+        src = JsonlTailSource(p)
+        recs, off = src.poll(10)
+        assert [r["v"] for r in recs] == [0, 1, 2, 3, 4]
+        # the partial line was NOT consumed; complete it and poll again
+        with open(p, "a") as fh:
+            fh.write(', "w": 1}\n')
+        src2 = JsonlTailSource(p)
+        src2.seek(off)
+        recs2, _ = src2.poll(10)
+        assert recs2 == [{"v": 99, "w": 1}]
+
+    def test_runner_streaming_commits_offsets(self, tmp_path):
+        """End-to-end: the runner's streaming_score run commits offsets
+        after each written batch (restart scores only new records)."""
+        from transmogrifai_tpu import FeatureBuilder, Workflow
+        from transmogrifai_tpu.data.dataset import Column, Dataset
+        from transmogrifai_tpu.readers import (ListSource,
+                                               MicroBatchStreamingReader,
+                                               OffsetCheckpoint)
+        from transmogrifai_tpu.types import Real, RealNN
+        from transmogrifai_tpu.workflow.runner import (RunType,
+                                                       WorkflowRunner)
+        from transmogrifai_tpu.params import OpParams
+
+        rng = np.random.default_rng(3)
+        n = 300
+        ds = Dataset({
+            "v": Column.from_values(Real, rng.normal(size=n).tolist()),
+            "label": Column.from_values(
+                RealNN, (rng.random(n) > 0.5).astype(float).tolist())})
+        label = FeatureBuilder.of("label", RealNN).extract_field() \
+            .as_response()
+        v = FeatureBuilder.of("v", Real).extract_field().as_predictor()
+        pred = v.fill_missing_with_mean().z_normalize()
+        model = Workflow().set_input_dataset(ds) \
+            .set_result_features(pred).train()
+        mdir = str(tmp_path / "model")
+        model.save(mdir)
+
+        ckpt = OffsetCheckpoint(str(tmp_path / "off.json"))
+        stream_records = [{"v": float(i)} for i in range(7)]
+        reader = MicroBatchStreamingReader(
+            ListSource(stream_records, "run"), checkpoint=ckpt,
+            batch_interval=0.0, max_batch_records=3, max_empty_polls=1)
+        wf = Workflow().set_input_dataset(ds).set_result_features(pred)
+        runner = WorkflowRunner(workflow=wf, streaming_reader=reader)
+        result = runner.run(RunType.STREAMING_SCORE, OpParams(
+            model_location=mdir,
+            write_location=str(tmp_path / "scored")))
+        assert result.metrics["batches"] == 3  # 3 + 3 + 1
+        assert ckpt.load("run") == 7  # all offsets committed
+
+    def test_jsonl_rotation_resets_and_bad_line_is_loud(self, tmp_path):
+        import json
+
+        from transmogrifai_tpu.readers import JsonlTailSource
+
+        p = str(tmp_path / "rot.jsonl")
+        with open(p, "w") as fh:
+            for i in range(20):
+                fh.write(json.dumps({"v": i}) + "\n")
+        src = JsonlTailSource(p)
+        _, off = src.poll(100)
+        # rotation: the file is truncated and restarted smaller
+        with open(p, "w") as fh:
+            fh.write(json.dumps({"v": 100}) + "\n")
+        src.seek(off)
+        recs, _ = src.poll(10)
+        assert [r["v"] for r in recs] == [100]  # reset to head, not stalled
+
+        # malformed line: good prefix delivered, then the poison pill raises
+        with open(p, "a") as fh:
+            fh.write(json.dumps({"v": 101}) + "\n")
+            fh.write("{not json}\n")
+        recs2, off2 = src.poll(10)
+        assert [r["v"] for r in recs2] == [101]
+        src.seek(off2)
+        with pytest.raises(ValueError, match="malformed JSONL"):
+            src.poll(10)

@@ -1,0 +1,6 @@
+from .streaming import (JsonlTailSource, ListSource,
+                        MicroBatchStreamingReader, OffsetCheckpoint,
+                        RecordSource)
+
+__all__ = ["RecordSource", "ListSource", "JsonlTailSource",
+           "OffsetCheckpoint", "MicroBatchStreamingReader"]

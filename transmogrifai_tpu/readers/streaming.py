@@ -1,0 +1,228 @@
+"""Micro-batch streaming with offsets, checkpointed resume, and backpressure.
+
+Reference: StreamingReaders ride Spark DStreams
+(readers/src/main/scala/com/salesforce/op/readers/StreamingReaders.scala:1-67):
+a micro-batch clock, source offsets checkpointed by the streaming context,
+and PID-rate backpressure.  The plain ``StreamingReader`` in files.py wraps
+any batch iterator for ad-hoc scoring; this module is the durable
+equivalent of the DStream machinery, TPU-native shape: batches stay
+columnar host datasets (strings never reach the device), scoring programs
+re-use the jit cache because batch sizes bucket to powers of two upstream.
+
+Pieces:
+- ``RecordSource``: offset-addressable pull source (``seek``/``poll``).
+  ``JsonlTailSource`` tails a JSON-lines file by BYTE offset (resume lands
+  mid-file exactly); ``ListSource`` is the in-memory test double.
+- ``OffsetCheckpoint``: atomic (tmp+rename) JSON offset store.
+- ``MicroBatchStreamingReader``: the DStream role.  Yields one dataset per
+  tick; ``commit()`` AFTER the consumer persists its output gives
+  at-least-once delivery (an uncommitted batch is re-polled after a crash,
+  exactly Spark's WAL-less receiverless semantics).  Backpressure is a
+  rate estimator: the per-batch record target shrinks when processing
+  time exceeds the batch interval and recovers geometrically otherwise
+  (the PID-estimator role, proportional term only).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
+
+from .base import rows_to_dataset
+
+
+class RecordSource:
+    """Offset-addressable record source."""
+
+    #: stable id inside a checkpoint file (override per source)
+    source_id: str = "source"
+
+    def seek(self, offset: int) -> None:
+        raise NotImplementedError
+
+    def poll(self, max_records: int) -> Tuple[List[Any], int]:
+        """Up to ``max_records`` new records and the offset AFTER them."""
+        raise NotImplementedError
+
+
+class ListSource(RecordSource):
+    """In-memory source; offset = index into the list (tests, replays)."""
+
+    def __init__(self, records: Sequence[Any], source_id: str = "list"):
+        self.records = list(records)
+        self.source_id = source_id
+        self._pos = 0
+
+    def seek(self, offset: int) -> None:
+        self._pos = min(int(offset), len(self.records))
+
+    def poll(self, max_records: int):
+        chunk = self.records[self._pos:self._pos + max_records]
+        self._pos += len(chunk)
+        return chunk, self._pos
+
+    def append(self, records: Sequence[Any]) -> None:
+        self.records.extend(records)
+
+
+class JsonlTailSource(RecordSource):
+    """Tails a JSON-lines file; offset = byte position of the next unread
+    line, so a resume lands exactly where the last commit left off even
+    mid-file.  A trailing partial line (a writer mid-append) is left for
+    the next poll."""
+
+    def __init__(self, path: str, source_id: Optional[str] = None):
+        self.path = path
+        self.source_id = source_id or f"jsonl:{os.path.basename(path)}"
+        self._offset = 0
+
+    def seek(self, offset: int) -> None:
+        self._offset = int(offset)
+
+    def poll(self, max_records: int):
+        records: List[Any] = []
+        if not os.path.exists(self.path):
+            return records, self._offset
+        if os.path.getsize(self.path) < self._offset:
+            # truncation / rotation: the committed offset points past the
+            # new EOF — restart from the head (standard tail -F behavior)
+            self._offset = 0
+        with open(self.path, "rb") as fh:
+            fh.seek(self._offset)
+            for _ in range(max_records):
+                line = fh.readline()
+                if not line or not line.endswith(b"\n"):
+                    break  # EOF or partial trailing line: retry next tick
+                text = line.decode("utf-8").strip()
+                if text:
+                    # decode BEFORE advancing: a malformed line must not
+                    # strand the records before it past the offset
+                    try:
+                        parsed = json.loads(text)
+                    except ValueError:
+                        if records:
+                            return records, self._offset  # deliver the good prefix
+                        raise ValueError(
+                            f"malformed JSONL at byte {self._offset} of "
+                            f"{self.path}: {text[:80]!r}")
+                    records.append(parsed)
+                self._offset = fh.tell()
+        return records, self._offset
+
+
+class OffsetCheckpoint:
+    """Atomic JSON offset store (the streaming-context checkpoint role)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self, source_id: str, default: int = 0) -> int:
+        try:
+            with open(self.path) as fh:
+                return int(json.load(fh).get(source_id, default))
+        except (OSError, ValueError):
+            return default
+
+    def commit(self, source_id: str, offset: int) -> None:
+        state = {}
+        try:
+            with open(self.path) as fh:
+                state = json.load(fh)
+        except (OSError, ValueError):
+            pass
+        state[source_id] = int(offset)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(state, fh)
+        os.replace(tmp, self.path)  # atomic on POSIX
+
+
+class MicroBatchStreamingReader:
+    """DStream-role reader: micro-batch clock + offsets + backpressure.
+
+    Drop-in for the runner's ``streaming_reader`` slot — ``stream_datasets``
+    yields one Dataset per tick; the runner calls ``commit()`` after each
+    batch's output is written (at-least-once).  Without a checkpoint the
+    reader still rate-limits but restarts from offset 0.
+    """
+
+    def __init__(self, source: RecordSource,
+                 checkpoint: Optional[OffsetCheckpoint] = None,
+                 batch_interval: float = 1.0,
+                 max_batch_records: int = 8192,
+                 min_batch_records: int = 32,
+                 max_empty_polls: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        """``max_empty_polls=None`` (default) tails FOREVER — a quiet
+        producer never ends a live stream.  Bounded runs (drain-a-backlog
+        jobs, tests) pass a small count to stop after that many
+        consecutive empty ticks."""
+        self.source = source
+        self.checkpoint = checkpoint
+        self.batch_interval = float(batch_interval)
+        self.max_batch_records = int(max_batch_records)
+        # the floor can never exceed the ceiling (otherwise the shrink
+        # branch would GROW the target past max on tiny configurations)
+        self.min_batch_records = min(int(min_batch_records),
+                                     self.max_batch_records)
+        self.max_empty_polls = (None if max_empty_polls is None
+                                else int(max_empty_polls))
+        self._clock = clock
+        self._sleep = sleep
+        self._target = self.max_batch_records
+        self._pending_offset: Optional[int] = None
+        self._committed = self.checkpoint.load(source.source_id) \
+            if self.checkpoint else 0
+        #: batches yielded / records seen / current rate target (metrics)
+        self.progress = {"batches": 0, "records": 0,
+                         "target_records": self._target}
+
+    # -- offsets -----------------------------------------------------------
+    def commit(self) -> None:
+        """Persist the offset of the last yielded batch — call AFTER its
+        output is durable.  Skipping it replays the batch on restart."""
+        if self._pending_offset is None:
+            return
+        self._committed = self._pending_offset
+        if self.checkpoint is not None:
+            self.checkpoint.commit(self.source.source_id, self._committed)
+        self._pending_offset = None
+
+    # -- the micro-batch clock --------------------------------------------
+    def stream_datasets(self, raw_features) -> Iterator:
+        self.source.seek(self._committed)
+        empty = 0
+        while True:
+            tick_start = self._clock()
+            records, next_offset = self.source.poll(self._target)
+            if not records:
+                empty += 1
+                if self.max_empty_polls is not None \
+                        and empty > self.max_empty_polls:
+                    return  # bounded run: source drained
+                self._sleep(self.batch_interval)
+                continue
+            empty = 0
+            # pending offset is staged BEFORE the yield: the generator
+            # suspends there, and the consumer calls commit() while we
+            # are suspended
+            self._pending_offset = next_offset
+            yield rows_to_dataset(records, raw_features)
+            self.progress["batches"] += 1
+            self.progress["records"] += len(records)
+            # backpressure: if the consumer used more than the interval,
+            # shrink the next target proportionally; otherwise recover
+            elapsed = self._clock() - tick_start
+            if elapsed > self.batch_interval:
+                ratio = self.batch_interval / max(elapsed, 1e-9)
+                self._target = max(self.min_batch_records,
+                                   int(self._target * ratio))
+            else:
+                self._target = min(self.max_batch_records,
+                                   max(self._target * 2,
+                                       self.min_batch_records))
+                self._sleep(max(0.0, self.batch_interval - elapsed))
+            self.progress["target_records"] = self._target

@@ -154,9 +154,17 @@ class WorkflowRunner:
         for i, batch in enumerate(self.streaming_reader.stream_datasets(raws)):
             scored = model.score(batch)
             outs.append(scored)
+            # offset-checkpointing readers (MicroBatchStreamingReader) commit
+            # only AFTER the batch output is DURABLE — at-least-once
+            # delivery.  Without a write_location the scores live only in
+            # this process, so offsets are NOT committed (a crash replays;
+            # committing would silently drop the in-memory batches).
+            commit = getattr(self.streaming_reader, "commit", None)
             if params.write_location:
                 _write_dataset(
                     _indexed_path(params.write_location, i), scored)
+                if commit is not None:
+                    commit()
         return RunResult(RunType.STREAMING_SCORE,
                          metrics={"batches": len(outs)},
                          model_location=params.model_location, scores=outs)
