@@ -689,3 +689,26 @@ class TestExternalReferenceParity:
         sk = SkRF(n_estimators=30, max_depth=6, random_state=0).fit(x, y)
         acc_sk = (sk.predict(x) == y).mean()
         assert acc_ours >= acc_sk - 0.05, (acc_ours, acc_sk)
+
+
+def test_high_resolution_bins_capability():
+    """XGBoost max_bin-style resolution stays available per-estimator
+    (DEFAULT_BINS is 32 for Spark-default parity; the capability surface
+    reaches 256): a signal with a narrow decision boundary that 8 coarse
+    bins cannot localize is recovered at n_bins=128."""
+    rng = np.random.default_rng(41)
+    n = 4000
+    x = rng.uniform(0, 1, size=(n, 3)).astype(np.float32)
+    # boundary at 0.505 inside a uniform feature: needs fine quantile edges
+    y = ((x[:, 0] > 0.505) ^ (rng.random(n) < 0.02)).astype(np.float64)
+    w = np.ones(n, np.float32)
+
+    accs = {}
+    for bins in (8, 128):
+        est = GradientBoostedTreesClassifier(num_rounds=20, max_depth=3,
+                                             n_bins=bins)
+        model = est._fit_arrays(x, y, w)
+        p = np.asarray(model.predict_column(Column.vector(x)).prob[:, 1])
+        accs[bins] = ((p > 0.5) == y).mean()
+    assert accs[128] > 0.97, accs
+    assert accs[128] >= accs[8], accs
