@@ -74,3 +74,12 @@ class TestExamples:
         metrics = text_reviews.main()
         # hashed sentiment words are fully predictive on this synthetic set
         assert metrics["auPR"] > 0.9
+
+
+def test_serving_streaming_example():
+    """Serving surfaces example: in-process scorer, standalone bundle, and
+    checkpointed streaming must agree and complete (examples/serving_streaming.py)."""
+    import serving_streaming
+
+    result = serving_streaming.main()
+    assert result.metrics["batches"] >= 3

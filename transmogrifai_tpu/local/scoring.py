@@ -51,17 +51,13 @@ class LocalScorer:
 
     # -- columnar batch ------------------------------------------------------
     def batch(self, records: Sequence[Mapping[str, Any]]) -> List[Dict[str, Any]]:
-        cols: Dict[str, Column] = {}
-        for g in self._generators:
-            try:
-                values = [g.extract(r).value for r in records]
-                cols[g.raw_name] = Column.from_values(g.ftype, values)
-            except Exception:
-                if not g.is_response:
-                    raise
-                # label may legitimately be absent at inference time — the model
-                # stages never read it (engine parity: scoring without a label)
-        ds = Dataset(cols)
+        from ..readers.base import extract_columns
+
+        # label may legitimately be absent at inference time — the model
+        # stages never read it (engine parity: scoring without a label)
+        ds = Dataset(extract_columns(
+            records, [(g.raw_name, g) for g in self._generators],
+            allow_missing_response=True))
         for stage in self._plan:
             runner = _resolve(stage, self._fitted)
             if runner is None:

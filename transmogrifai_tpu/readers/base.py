@@ -46,14 +46,34 @@ class Reader:
         return rows_to_dataset(records, raw_features)
 
 
-def rows_to_dataset(records: Sequence[Any], raw_features: Sequence[Feature]) -> Dataset:
+def extract_columns(records: Sequence[Any], named_gens,
+                    allow_missing_response: bool = False) -> Dict[str, Column]:
+    """Extract (name, generator) pairs over records into columns.
+
+    ``allow_missing_response=True`` is the SCORING-time contract (streaming /
+    serving batches legitimately carry no label): a response whose extraction
+    fails is skipped — the model stages never read it.  Predictor failures
+    always raise, and on training/evaluate paths (the default) response
+    failures raise too, so a typo'd label key surfaces at ingest instead of
+    as an opaque missing-column error downstream."""
+    cols: Dict[str, Column] = {}
+    for name, g in named_gens:
+        try:
+            values = [g.extract(r).value for r in records]
+            cols[name] = Column.from_values(g.ftype, values)
+        except Exception:
+            if not (allow_missing_response and g.is_response):
+                raise
+    return cols
+
+
+def rows_to_dataset(records: Sequence[Any], raw_features: Sequence[Feature],
+                    allow_missing_response: bool = False) -> Dataset:
     """Run every raw feature's extract over the records (DataReader.generateRow path)."""
     gens = _generators(raw_features)
-    cols: Dict[str, Column] = {}
-    for f, g in zip(raw_features, gens):
-        values = [g.extract(r).value for r in records]
-        cols[f.name] = Column.from_values(g.ftype, values)
-    return Dataset(cols)
+    return Dataset(extract_columns(
+        records, [(f.name, g) for f, g in zip(raw_features, gens)],
+        allow_missing_response=allow_missing_response))
 
 
 class DataFrameReader(Reader):

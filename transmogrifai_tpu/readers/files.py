@@ -89,7 +89,8 @@ class StreamingReader:
                 # pandas DataFrame: columnar fast path, not iteration over col names
                 yield DataFrameReader(batch).generate_dataset(raw_features)
             else:
-                yield rows_to_dataset(list(batch), raw_features)
+                yield rows_to_dataset(list(batch), raw_features,
+                                      allow_missing_response=True)
 
 
 class DataReaders:

@@ -210,7 +210,9 @@ class MicroBatchStreamingReader:
             # suspends there, and the consumer calls commit() while we
             # are suspended
             self._pending_offset = next_offset
-            yield rows_to_dataset(records, raw_features)
+            # scoring-time batches carry no label (allow_missing_response)
+            yield rows_to_dataset(records, raw_features,
+                                  allow_missing_response=True)
             self.progress["batches"] += 1
             self.progress["records"] += len(records)
             # backpressure: if the consumer used more than the interval,
