@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -78,13 +79,21 @@ def train_model(workdir: str):
 
 def main():
     workdir = tempfile.mkdtemp(prefix="tmog_serving_")
+    try:
+        return _run(workdir)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _run(workdir):
     wf, model = train_model(workdir)
     print("best model:", model.summary().best_model_name)
 
     # (a) in-process engine-free scorer (OpWorkflowModelLocal role)
     scorer = score_function(model)
     record = {"amount": 55.0, "tenure": 0.5, "plan": "basic"}
-    print("in-process:", scorer(record))
+    in_process = scorer(record)
+    print("in-process:", in_process)
 
     # (b) STANDALONE bundle: numpy + stdlib only, no jax, no framework
     bundle = os.path.join(workdir, "bundle")
@@ -95,7 +104,11 @@ def main():
               f"[{json.dumps(record)}])[0]))")
     out = subprocess.run([sys.executable, "-c", driver], cwd=bundle,
                          capture_output=True, text=True, check=True)
-    print("standalone:", out.stdout.strip())
+    standalone = json.loads(out.stdout.strip())
+    print("standalone:", standalone)
+    # the two serving paths must AGREE (the export contract: 1e-6 parity)
+    pmap = next(v for v in in_process.values() if isinstance(v, dict))
+    assert abs(standalone["probability"][1] - pmap["probability_1"]) < 1e-6
 
     # (c) micro-batch streaming with checkpointed offsets
     events = os.path.join(workdir, "events.jsonl")
@@ -116,7 +129,7 @@ def main():
         write_location=os.path.join(workdir, "scored")))
     print(f"streamed {result.metrics['batches']} micro-batches; offsets "
           f"committed to {os.path.join(workdir, 'offsets.json')}")
-    return result
+    return {"result": result, "in_process": pmap, "standalone": standalone}
 
 
 if __name__ == "__main__":

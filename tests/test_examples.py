@@ -81,5 +81,8 @@ def test_serving_streaming_example():
     checkpointed streaming must agree and complete (examples/serving_streaming.py)."""
     import serving_streaming
 
-    result = serving_streaming.main()
-    assert result.metrics["batches"] >= 3
+    out = serving_streaming.main()
+    assert out["result"].metrics["batches"] >= 3
+    # in-process vs standalone-bundle agreement (export contract: 1e-6)
+    assert abs(out["standalone"]["probability"][1]
+               - out["in_process"]["probability_1"]) < 1e-6

@@ -449,3 +449,46 @@ class TestMicroBatchStreaming:
         src.seek(off2)
         with pytest.raises(ValueError, match="malformed JSONL"):
             src.poll(10)
+
+    def test_dataframe_batch_without_label_scores(self, tmp_path):
+        """Columnar (DataFrame) micro-batches may omit the response column at
+        scoring time, same as record-iterator batches; a PRESENT-but-malformed
+        label still raises (data-quality bugs stay loud)."""
+        import pandas as pd
+
+        from transmogrifai_tpu import FeatureBuilder, Workflow
+        from transmogrifai_tpu.data.dataset import Column, Dataset
+        from transmogrifai_tpu.readers.base import rows_to_dataset
+        from transmogrifai_tpu.readers.files import StreamingReader
+        from transmogrifai_tpu.types import Real, RealNN
+        from transmogrifai_tpu.params import OpParams
+        from transmogrifai_tpu.workflow.runner import RunType, WorkflowRunner
+
+        rng = np.random.default_rng(5)
+        n = 200
+        ds = Dataset({
+            "v": Column.from_values(Real, rng.normal(size=n).tolist()),
+            "label": Column.from_values(
+                RealNN, (rng.random(n) > 0.5).astype(float).tolist())})
+        label = FeatureBuilder.of("label", RealNN).extract_field() \
+            .as_response()
+        v = FeatureBuilder.of("v", Real).extract_field().as_predictor()
+        pred = v.fill_missing_with_mean().z_normalize()
+        wf = Workflow().set_input_dataset(ds).set_result_features(label, pred)
+        model = wf.train()
+        mdir = str(tmp_path / "m")
+        model.save(mdir)
+
+        df_no_label = pd.DataFrame({"v": rng.normal(size=7)})
+        runner = WorkflowRunner(
+            workflow=wf, streaming_reader=StreamingReader([df_no_label]))
+        res = runner.run(RunType.STREAMING_SCORE,
+                         OpParams(model_location=mdir))
+        assert res.metrics["batches"] == 1
+        assert len(np.asarray(res.scores[0][pred.name].data)) == 7
+
+        # malformed PRESENT label in a record batch must still raise
+        raws = [label, v]
+        with pytest.raises(Exception):
+            rows_to_dataset([{"v": 1.0, "label": "not-a-number"}], raws,
+                            allow_missing_response=True)

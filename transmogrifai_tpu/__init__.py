@@ -24,9 +24,13 @@ from .models.selector import (
     ModelSelector,
 )
 from .evaluators.base import Evaluators
+from .local import export_standalone, score_function  # noqa: F401
 from .readers.files import DataReaders
 from .readers.joined import (  # noqa: F401
     JoinedReader, JoinType, TimeColumn, TimeBasedFilter,
+)
+from .readers.streaming import (  # noqa: F401
+    JsonlTailSource, MicroBatchStreamingReader, OffsetCheckpoint,
 )
 from .ops import bucketizers  # noqa: F401 — registers decision-tree bucketizer stages
 from .ops import misc  # noqa: F401 — registers misc value transformers + scalers
@@ -41,4 +45,6 @@ __all__ = [
     "Workflow", "WorkflowModel", "transmogrify", "SanityChecker",
     "BinaryClassificationModelSelector", "MultiClassificationModelSelector",
     "RegressionModelSelector", "ModelSelector", "Evaluators", "DataReaders",
+    "score_function", "export_standalone", "MicroBatchStreamingReader",
+    "OffsetCheckpoint", "JsonlTailSource",
 ]

@@ -51,11 +51,14 @@ def extract_columns(records: Sequence[Any], named_gens,
     """Extract (name, generator) pairs over records into columns.
 
     ``allow_missing_response=True`` is the SCORING-time contract (streaming /
-    serving batches legitimately carry no label): a response whose extraction
-    fails is skipped — the model stages never read it.  Predictor failures
-    always raise, and on training/evaluate paths (the default) response
-    failures raise too, so a typo'd label key surfaces at ingest instead of
-    as an opaque missing-column error downstream."""
+    serving batches legitimately carry no label): a response whose source is
+    genuinely ABSENT from the records is skipped — the model stages never
+    read it.  A response that is PRESENT but malformed still raises (a
+    data-quality bug must not silently drop the label column), as do all
+    predictor failures; on training/evaluate paths (the default) every
+    response failure raises, so a typo'd label key surfaces at ingest."""
+    from ..features.feature import _NamedExtract
+
     cols: Dict[str, Column] = {}
     for name, g in named_gens:
         try:
@@ -64,6 +67,14 @@ def extract_columns(records: Sequence[Any], named_gens,
         except Exception:
             if not (allow_missing_response and g.is_response):
                 raise
+            fn = getattr(g, "extract_fn", None)
+            if isinstance(fn, _NamedExtract):
+                present = any(
+                    isinstance(r, dict) and r.get(fn.key) is not None
+                    for r in records)
+                if present:
+                    raise  # label supplied but malformed: surface the bug
+            # absent label (or non-introspectable extract): tolerated
     return cols
 
 

@@ -84,13 +84,35 @@ class StreamingReader:
             if isinstance(batch, Dataset):
                 yield batch
             elif isinstance(batch, Reader):
-                yield batch.generate_dataset(raw_features)
+                yield batch.generate_dataset(
+                    _scoring_features(raw_features, batch))
             elif hasattr(batch, "columns") and hasattr(batch, "iloc"):
                 # pandas DataFrame: columnar fast path, not iteration over col names
-                yield DataFrameReader(batch).generate_dataset(raw_features)
+                yield DataFrameReader(batch).generate_dataset(
+                    _scoring_features(raw_features, batch))
             else:
                 yield rows_to_dataset(list(batch), raw_features,
                                       allow_missing_response=True)
+
+
+def _scoring_features(raw_features: Sequence[Feature], batch):
+    """The scoring-time contract for columnar batches: response features whose
+    source column is ABSENT from the batch are dropped (labels are optional at
+    scoring time — same tolerance the record-iterator path gets from
+    ``allow_missing_response``); predictors always stay, so a missing
+    predictor column still fails loudly downstream."""
+    from ..features.feature import _NamedExtract
+
+    cols = set(batch.columns) if hasattr(batch, "columns") else None
+    out = []
+    for f in raw_features:
+        st = f.origin_stage
+        if getattr(st, "is_response", False) and cols is not None \
+                and isinstance(getattr(st, "extract_fn", None), _NamedExtract) \
+                and st.extract_fn.key not in cols:
+            continue
+        out.append(f)
+    return out
 
 
 class DataReaders:
