@@ -66,9 +66,11 @@ DEFAULT_BINS = 32
 
 #: histogram-accumulation row-chunk size (see _grow_tree); module-level so
 #: tests can shrink it to exercise the chunked path on small data.
-#: 2048 measured 3.8x faster than 8192 on v5e at 1M x 128: the per-step
-#: (chunk, B*d) bin one-hot operand is small enough for XLA to keep the
-#: one-hot -> matmul pipeline on-chip instead of spilling it through HBM
+#: 2048 measured 3.8x faster than 8192 on v5e at 1M x 128 (64 bins): the
+#: per-step (chunk, B*d) bin one-hot operand is small enough for XLA to keep
+#: the one-hot -> matmul pipeline on-chip instead of spilling through HBM.
+#: Re-measured at the 32-bin default (r4): 2048 and 4096 tie (RF cv 3.4s,
+#: GBT cv 2.4s) while 8192 still regresses GBT 3.4x — 2048 stands.
 _HIST_CHUNK = 2048
 
 
