@@ -187,6 +187,26 @@ class TestPlacementContentCache:
         assert a2 is not a1  # writeable hit is rejected -> full re-hash
         np.testing.assert_allclose(np.asarray(a2)[10, 2], x[10, 2])
 
+    def test_view_never_memoized_narrow_mutation_rehashes(self, monkeypatch):
+        # r4 advisor (medium): a writeable VIEW used to hit the memo guarded
+        # only by the 64-window sampled signature, so a mutation narrower
+        # than ~nbytes/64 through the view could serve a stale placement.
+        # Views must always take the full re-hash path.
+        from transmogrifai_tpu.parallel import mesh as M
+
+        monkeypatch.setattr(M, "_STAMP_MEMO_MIN_BYTES", 1024)
+        rng = np.random.default_rng(5)
+        base = rng.normal(size=(512, 8)).astype(np.float32)
+        view = base[:]  # full-extent contiguous view, view.base is base
+        assert view.base is not None
+        a1, _ = M.place_rows_bucketed_cached(view)
+        assert base.flags.writeable  # views are never frozen
+        # single-element edit: far narrower than any quick-sig window stride
+        base[300, 5] += 7.0
+        a2, _ = M.place_rows_bucketed_cached(view)
+        assert a2 is not a1
+        np.testing.assert_allclose(np.asarray(a2)[300, 5], base[300, 5])
+
     def test_lookup_only_mode_does_not_insert(self):
         from transmogrifai_tpu.parallel import mesh as M
 

@@ -272,10 +272,16 @@ def _content_stamp(a: np.ndarray) -> bytes:
     data.  Callers that intend to mutate can simply re-enable
     ``a.flags.writeable = True`` — a writeable array never hits the memo, so
     correctness is preserved (full re-hash).  The freeze is lifted when the
-    entry is evicted or its weakref dies.  Caveat: freezing a VIEW leaves
-    its base writeable; mutation through the base is then caught only by
-    the strided signature below.  A hit requires non-writeable + matching
-    (shape, dtype) + the sub-sample signature; anything else re-hashes."""
+    entry is evicted or its weakref dies.  VIEWS are never memoized or
+    frozen — they take the full re-hash path every time (r4 advisor: a
+    view hit guarded only by the sampled signature could serve a stale
+    placement after a narrow mutation).  Residual caveat: a writeable view
+    of the OWNER taken BEFORE memoization keeps its own writeable flag
+    (numpy snapshots flags at view creation), so mutation through such a
+    pre-existing view bypasses the freeze and is caught only by the
+    strided signature below until the entry rolls off.  A hit requires an
+    owner that is still non-writeable + matching (shape, dtype) + the
+    sub-sample signature; anything else re-hashes."""
     import hashlib
     import weakref
 
@@ -284,10 +290,12 @@ def _content_stamp(a: np.ndarray) -> bytes:
     if memoizable:  # the memo (and _quick_sig) need zero-copy byte views
         memo_key = id(a)
         hit = _STAMP_MEMO.get(memo_key)
-        # owners must still be frozen (a re-enabled writeable flag means the
-        # caller intends to mutate -> full re-hash); views were never frozen
-        # and are vouched for by the strided signature alone
-        frozen_ok = (not a.flags.writeable) or a.base is not None
+        # a hit requires an OWNER array that is still frozen: a re-enabled
+        # writeable flag means the caller intends to mutate -> full re-hash.
+        # Views never qualify — a mutation through the view or its base
+        # narrower than the strided-signature windows would otherwise serve
+        # a stale placement silently (r4 advisor finding).
+        frozen_ok = a.base is None and not a.flags.writeable
         if hit is not None and hit[0]() is a and frozen_ok \
                 and hit[1] == (a.shape, a.dtype.str) \
                 and hit[2] == _quick_sig(a):
@@ -295,19 +303,20 @@ def _content_stamp(a: np.ndarray) -> bytes:
     raw = a if contiguous else np.ascontiguousarray(a)
     stamp = hashlib.blake2b(memoryview(raw).cast("B"),
                             digest_size=16).digest()
-    if memoizable:
+    if memoizable and a.base is None:
+        # only OWNER arrays are memoized, and only when the freeze sticks:
+        # a memo hit is vouched for by writeable=False on the owner buffer,
+        # so any entry whose array cannot be frozen would be guarded by the
+        # sampled quick_sig alone — exactly the stale-placement hazard the
+        # r4 advisor flagged.  Views always take the full re-hash path.
         try:
-            # only FREEZE arrays that own their buffer: freezing a view can
-            # become irreversible when the base is itself frozen (restore
-            # raises), and mutation through the base bypasses the view flag
-            # anyway — views rely on the quick_sig belt alone
-            owns = a.base is None
-            was_writeable = bool(a.flags.writeable) and owns
-            entry = (weakref.ref(a), (a.shape, a.dtype.str),
-                     _quick_sig(a), stamp, was_writeable)
-            if owns:
-                a.flags.writeable = False  # mutations now raise, loudly
-            _STAMP_MEMO[memo_key] = entry
+            ref = weakref.ref(a)  # before the freeze: a weakref-refusing
+            # subclass must not leave the array frozen with no memo entry
+            # whose eviction would restore it
+            was_writeable = bool(a.flags.writeable)
+            a.flags.writeable = False  # mutations now raise, loudly
+            _STAMP_MEMO[memo_key] = (ref, (a.shape, a.dtype.str),
+                                     _quick_sig(a), stamp, was_writeable)
         except (TypeError, ValueError):
             pass  # weakref-refusing subclass / flag-locked array: no memo
         for k in [k for k, v in _STAMP_MEMO.items() if v[0]() is None]:
