@@ -93,8 +93,9 @@ def _selector(seed=7):
         num_folds=FOLDS, seed=seed, models=models)
 
 
-def bench_selector(n_rows: int):
-    """(models/sec normalized to 1M rows, fit seconds at n_rows, summary)."""
+def bench_selector(n_rows: int, breakdown: bool = False):
+    """(models/sec normalized to 1M rows, fit seconds at n_rows, summary,
+    phase breakdown dict or None)."""
     from transmogrifai_tpu import Dataset, FeatureBuilder
     from transmogrifai_tpu.data.dataset import Column
     from transmogrifai_tpu.types import OPVector, RealNN
@@ -125,7 +126,39 @@ def bench_selector(n_rows: int):
     summary = model.summary
     n_models = sum(len(r.metric_values) for r in summary.validation_results)
     models_per_sec = (n_models / dt) * (n_rows / TARGET_ROWS)
-    return models_per_sec, dt, summary
+    phases = _selector_breakdown(sel, ds, dt) if breakdown else None
+    return models_per_sec, dt, summary, phases
+
+
+def _selector_breakdown(sel, ds, full_fit_secs: float):
+    """Warm per-family and per-phase timings of the selector fit (VERDICT r4
+    #1: where do the seconds go).  Families are timed dispatch->gather in
+    ISOLATION (sequential device work); in the production fit all families
+    dispatch before any gather, so wall time ~= max-queue depth, not the sum.
+    ``tail_refit_eval`` = full fit minus the validate phase (final best-model
+    refit + device train-eval + summary assembly)."""
+    import numpy as np
+
+    vec, lbl = ds["v"], ds["label"]
+    x32 = np.asarray(vec.data, np.float32)
+    y32 = np.asarray(lbl.data, np.float32)
+    base_w = np.ones_like(y32)
+    tw, vw = sel.validator.fold_weights(y32, base_w)
+    metric_fn = sel.validator.evaluator.metric_fn()
+    fams = {}
+    for est, grids in sel.models:
+        t0 = time.perf_counter()
+        scores = est.cv_sweep_async(x32, y32, tw, vw, grids, metric_fn)()
+        del scores
+        fams[type(est).__name__] = round(time.perf_counter() - t0, 3)
+    t0 = time.perf_counter()
+    sel.validator.validate(sel.models, x32, y32, base_w)
+    t_validate = time.perf_counter() - t0
+    return {
+        "families_isolated_secs": fams,
+        "validate_secs": round(t_validate, 3),
+        "tail_refit_eval_secs": round(max(full_fit_secs - t_validate, 0.0), 3),
+    }
 
 
 def _proxy_family_models(name: str, n_rows: int):
@@ -353,7 +386,7 @@ def main():
     n_rows = int(os.environ.get("BENCH_ROWS",
                                 TARGET_ROWS if accel else 20_000))
 
-    value, fit_secs, summary = bench_selector(n_rows)
+    value, fit_secs, summary, phases = bench_selector(n_rows, breakdown=True)
     baseline, alphas = bench_sklearn_proxy(n_rows)
     tflops, mfu = bench_irls_mfu(min(n_rows, 250_000), device_kind)
     hist_gbs, hist_util, hist_tflops = bench_tree_hist(
@@ -364,7 +397,7 @@ def main():
     extras = {}
     if accel and n_rows >= TARGET_ROWS \
             and os.environ.get("BENCH_SECONDARY", "1") != "0":
-        v250, s250, _ = bench_selector(250_000)
+        v250, s250, _, _ = bench_selector(250_000)
         extras = {"secondary_250k_models_per_sec_1m_norm": round(v250, 3),
                   "secondary_250k_fit_seconds": round(s250, 2)}
 
@@ -387,6 +420,7 @@ def main():
         "tree_hist_batched_mfu": round(hb_mfu, 4) if hb_mfu else None,
         "tree_hist_batched_fit_seconds": round(hb_secs, 3),
         "baseline_scaling_exponents": alphas,
+        "phase_breakdown": phases,
         "device_kind": device_kind,
         **extras,
     }))

@@ -89,6 +89,21 @@ class BinaryClassificationEvaluator(Evaluator):
         vals = np.asarray(M.binary_summary(s, p, yj, wj))
         out = dict(zip(("auROC", "auPR", "precision", "recall", "f1", "error",
                         "tp", "fp", "tn", "fn"), (float(v) for v in vals)))
+        return self._maybe_thresholds(out, pred, y, w)
+
+    def evaluate_device(self, score_dev, pred_dev, y_dev, w_dev
+                        ) -> Dict[str, float]:
+        """All ten point metrics from device-resident payloads — one program,
+        one scalar fetch, no (n,)-sized host round trip.  Inputs are aligned
+        1-D device arrays over the padded row block (padded rows carry zero
+        weight).  Threshold curves need host arrays, so ``num_thresholds``
+        falls back to ``evaluate_arrays``."""
+        vals = np.asarray(M.binary_summary(score_dev, pred_dev, y_dev, w_dev))
+        return dict(zip(("auROC", "auPR", "precision", "recall", "f1", "error",
+                         "tp", "fp", "tn", "fn"), (float(v) for v in vals)))
+
+    def _maybe_thresholds(self, out, pred, y, w):
+        w = np.ones_like(y) if w is None else w
         if self.num_thresholds > 0:
             # rank-position sampling is not padding-safe: use the true rows
             th, pr, rc, fpr = M.threshold_curves(

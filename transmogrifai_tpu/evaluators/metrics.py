@@ -19,11 +19,15 @@ EPS = 1e-12
 
 
 def _sorted_cums(scores: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray):
-    order = jnp.argsort(-scores)
-    ys = y[order]
-    ws = w[order]
-    tp = jnp.cumsum(ws * ys)
-    fp = jnp.cumsum(ws * (1.0 - ys))
+    # one multi-operand lax.sort carries the weighted labels through the
+    # sorting network — argsort + two (n,) gathers serialized badly on TPU
+    # (the gathers, not the sort, dominated; r5: the CV sweep evaluates 33
+    # fold-models x 1M rows through this kernel).  is_stable keeps tie
+    # ordering identical to the former stable argsort.
+    _, wy, wn = jax.lax.sort((-scores, w * y, w * (1.0 - y)),
+                             num_keys=1, is_stable=True)
+    tp = jnp.cumsum(wy)
+    fp = jnp.cumsum(wn)
     return tp, fp
 
 

@@ -134,6 +134,15 @@ def eval_softmax_sweep(xd, yd, bs, vw, *, metric_fn):
     return jax.vmap(lambda ps: per_fold(ps, vw), in_axes=0)(probs)
 
 
+@partial(jax.jit, static_argnames=("link",))
+def _linear_eval_payload(xd, coef, intercept, *, link):
+    """(score, pred) on device for a linear head over the padded row block."""
+    z = xd @ coef + intercept
+    if link == "sigmoid":
+        return jax.nn.sigmoid(z), (z > 0).astype(jnp.float32)
+    return z, (z > 0).astype(jnp.float32)
+
+
 class PredictionModelBase(Transformer):
     """Fitted model transformer: scores the feature vector; label input is optional."""
 
@@ -146,6 +155,18 @@ class PredictionModelBase(Transformer):
 
     def predict_column(self, vec: Column) -> PredictionColumn:
         raise NotImplementedError
+
+    def eval_payload_device(self, x32: np.ndarray):
+        """Device fast path for the selector's train/holdout evaluation.
+
+        Returns ``(score_dev, pred_dev)`` — 1-D device arrays over the
+        BUCKET-PADDED row block of the shared content-keyed placement
+        (padded rows are masked by zero weights in the evaluator) — or
+        ``None`` when this model has no device scoring path (the selector
+        then falls back to host ``predict_column``).  Scores are computed
+        in float32, matching the evaluator's documented f32-grade metric
+        precision; serving (`predict_column`) keeps float64 semantics."""
+        return None
 
     def transform(self, dataset: Dataset) -> Dataset:
         # label may be absent at scoring time — only the feature vector is required
@@ -169,9 +190,10 @@ class PredictionEstimatorBase(Estimator):
 
     def fit_columns(self, cols, dataset):
         label, vec = cols
-        x = vec.data.astype(np.float32)
-        y = label.data.astype(np.float32)
-        w = dataset["__sample_weight__"].data.astype(np.float32) \
+        # asarray keeps object identity on float32 blocks -> stamp-memo hit
+        x = np.asarray(vec.data, np.float32)
+        y = np.asarray(label.data, np.float32)
+        w = np.asarray(dataset["__sample_weight__"].data, np.float32) \
             if "__sample_weight__" in dataset else np.ones_like(y)
         return self._fit_arrays(x, y, w)
 

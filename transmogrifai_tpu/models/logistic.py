@@ -357,3 +357,13 @@ class LogisticRegressionModel(PredictionModelBase):
         prob = np.column_stack([1.0 - p1, p1])
         raw = np.column_stack([-z, z])
         return PredictionColumn.classification(raw, prob)
+
+    def eval_payload_device(self, x32):
+        from ..parallel.mesh import place_rows_bucketed_cached
+        from .base import _linear_eval_payload
+
+        xd, _ = place_rows_bucketed_cached(np.asarray(x32, np.float32),
+                                           insert=False)
+        return _linear_eval_payload(
+            xd, jnp.asarray(self.coef, jnp.float32),
+            jnp.float32(self.intercept), link="sigmoid")

@@ -151,3 +151,13 @@ class LinearSVCModel(PredictionModelBase):
         pred = (z > 0.0).astype(np.float64)
         # Spark parity: rawPrediction only, no probability column
         return PredictionColumn(pred, raw=np.column_stack([-z, z]), prob=None)
+
+    def eval_payload_device(self, x32):
+        from ..parallel.mesh import place_rows_bucketed_cached
+        from .base import _linear_eval_payload
+
+        xd, _ = place_rows_bucketed_cached(np.asarray(x32, np.float32),
+                                           insert=False)
+        return _linear_eval_payload(
+            xd, jnp.asarray(self.coef, jnp.float32),
+            jnp.float32(self.intercept), link="identity")

@@ -73,6 +73,49 @@ DEFAULT_BINS = 32
 #: GBT cv 2.4s) while 8192 still regresses GBT 3.4x — 2048 stands.
 _HIST_CHUNK = 2048
 
+#: unroll factor for the histogram chunk scans — r5 tuning knob: the 1M-row
+#: growth runs ~500 scan steps per level, and per-step sequencing overhead
+#: is material at 32 bins where each step's matmul is small
+_HIST_UNROLL = 1
+
+#: forest-CV lane layout: True = vmap over folds with the T tree lanes
+#: folded into each fold's GEMM (k small batched GEMMs of M=T*nn*2K);
+#: False = all k*T lanes in ONE GEMM.  Measured on v5e (r5).
+_RF_FOLD_VMAP = False
+
+#: boosting reuses ONE materialized int8 bin one-hot across all rounds and
+#: levels instead of regenerating it per histogram pass — GBT's measured
+#: cost is ~100% one-hot construction (r5: ~29us/chunk rebuilt 150x for a
+#: 50-round depth-3 fit; an int8 read is ~11us/chunk).  Capped so the
+#: resident operand (n_padded * (bins+1) * d int8) never risks HBM.
+_GBT_MAT_BINOH = True
+_BINOH_MAT_MAX_BYTES = 6_000_000_000
+
+
+def _materialize_bin_oh(binned: jnp.ndarray, n_bins: int):
+    """(n_chunks, CHUNK, B*d) int8 bin one-hot for chunk-scanned growth, or
+    None when the row count takes the unchunked path / exceeds the cap."""
+    n, d = binned.shape
+    B = n_bins + 1
+    if n <= 2 * _HIST_CHUNK:
+        return None
+    pad = (-n) % _HIST_CHUNK
+    if (n + pad) * B * d > _BINOH_MAT_MAX_BYTES:
+        return None
+    if pad:
+        binned = jnp.pad(binned, ((0, pad), (0, 0)))
+    bc = binned.reshape(-1, _HIST_CHUNK, d)
+
+    def one_chunk(bc_i):
+        # per-chunk construction: a single full-table broadcast compare made
+        # XLA materialize an int32 (chunks, CHUNK, B, d) intermediate — 17 GB
+        # at 1M x 128 x B33 (r5); the lax.map body's live temp is ~1 MB
+        return (bc_i[:, None, :] ==
+                jnp.arange(B, dtype=bc_i.dtype)[None, :, None]
+                ).astype(jnp.int8).reshape(_HIST_CHUNK, B * d)
+
+    return jax.lax.map(one_chunk, bc)
+
 
 def _hist_dtype():
     """MXU input dtype for histogram matmuls: bf16 on TPU (one-hots are exact,
@@ -254,6 +297,29 @@ def _node_lookup(tbl: jnp.ndarray, node: jnp.ndarray) -> jnp.ndarray:
     return (oh[:, :, None] * tbl[None, :, :]).sum(axis=1)            # (n, K)
 
 
+def _row_select_l(binned: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """binned[i, idx[l, i]] per lane — lane-batched ``_row_select``.
+
+    binned: (n, d) shared codes; idx: (L, n) -> (L, n)."""
+    d = binned.shape[1]
+    oh = (jnp.arange(d, dtype=jnp.int32)[None, None, :] == idx[:, :, None])
+    return (binned.astype(jnp.float32)[None] * oh).sum(axis=-1) \
+        .astype(jnp.int32)
+
+
+def _node_lookup_l(tbl: jnp.ndarray, node: jnp.ndarray) -> jnp.ndarray:
+    """tbl[l, node[l, i]] per lane — lane-batched ``_node_lookup``.
+
+    tbl: (L, m) or (L, m, K); node: (L, n)."""
+    m = tbl.shape[1]
+    oh = node[:, :, None] == jnp.arange(m, dtype=node.dtype)[None, None, :]
+    if tbl.ndim == 2:
+        if tbl.dtype == jnp.bool_:
+            return (oh & tbl[:, None, :]).any(axis=-1)
+        return jnp.where(oh, tbl[:, None, :], 0).sum(axis=-1)
+    return (oh[..., None] * tbl[:, None, :, :]).sum(axis=2)        # (L, n, K)
+
+
 def _leaf_value(G, H, reg_lambda, alpha, eta, max_delta_step):
     raw = -_soft_threshold(G, alpha) / (H + reg_lambda + 1e-12)
     clipped = jnp.where(max_delta_step > 0.0,
@@ -261,24 +327,47 @@ def _leaf_value(G, H, reg_lambda, alpha, eta, max_delta_step):
     return clipped * eta
 
 
-def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
-               feat_mask: jnp.ndarray, key, max_depth: int, n_bins: int,
-               reg_lambda, alpha, gamma, min_child_weight, eta, max_delta_step,
-               colsample_bylevel: float = 1.0):
-    """Level-wise histogram growth of ONE multi-output tree; static shapes, jit-safe.
+def _grow_trees(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+                feat_mask: jnp.ndarray, key, max_depth: int, n_bins: int,
+                reg_lambda, alpha, gamma, min_child_weight, eta, max_delta_step,
+                colsample_bylevel: float = 1.0, int_exact: bool = False,
+                bin_oh_c=None):
+    """Level-wise histogram growth of L trees JOINTLY; static shapes, jit-safe.
 
-    binned: (n, d) int32 in [0, n_bins] (n_bins = missing).
-    grad/hess: (n, K) per-class — zero-weight rows contribute nothing.
-    feat_mask: (d,) float 1/0 — colsample_bytree support.
-    key: PRNG key for colsample_bylevel (ignored when colsample_bylevel >= 1).
+    binned: (n, d) int32 in [0, n_bins] (n_bins = missing) — SHARED by lanes.
+    grad/hess: (L, n, K) per-lane per-class — zero-weight rows contribute 0.
+    feat_mask: (L, d) float 1/0 — colsample_bytree support per lane.
+    key: PRNG key for colsample_bylevel (ignored when colsample_bylevel >= 1;
+    the per-level draw is shared by all lanes, matching the former per-lane
+    vmap which closed over one key).
 
-    Returns (Tree, node): ``node`` is each input row's FINAL leaf assignment —
-    callers that need in-sample predictions (boosting margin updates, forest
-    training-set votes) read ``value[node]`` directly instead of re-traversing.
+    The lane axis L — the (fold x tree) lanes of a CV sweep — folds into the
+    M dimension of ONE histogram GEMM per row chunk, so the (chunk, B*d) bin
+    one-hot operand is built once per chunk and shared by every lane.  Under
+    the former per-lane ``vmap`` formulation XLA regenerated that operand
+    inside each lane's batched matmul: measured growth cost scaled linearly
+    with L and was INDEPENDENT of the bin count — the one-hot construction
+    floor paid L times over (r5 profiling; chunk size and scan unroll moved
+    nothing, ruling out step overhead).
+
+    ``int_exact=True`` runs the histogram GEMMs in int8 x int8 -> int32 —
+    EXACT (not quantized) whenever grad/hess values are integers in
+    [-127, 127], which is precisely the forest-CV case: grad = -fold_w *
+    poisson_boot * onehot_target, hess = fold_w * poisson_boot, with 0/1
+    fold weights (P[poisson(1) >= 128] ~ 1e-216 makes overflow a
+    non-event).  The MXU runs int8 at twice the bf16 rate on v5e, and
+    per-(node, feat, bin) partial sums stay below 2^24 so the int32 -> f32
+    histogram conversion is lossless.  Callers must verify integerness
+    (host-side weight check) before setting it.
+
+    Returns (Tree with leading L axis, node (L, n)): ``node`` is each row's
+    FINAL leaf assignment per lane — callers that need in-sample predictions
+    (boosting margin updates, forest training-set votes) read ``value[node]``
+    directly instead of re-traversing.
     """
-    n, d = binned.shape
+    L, n, K = grad.shape
+    d = binned.shape[1]
     n_orig = n
-    K = grad.shape[1]
     m = 2 ** (max_depth + 1) - 1
     B = n_bins + 1  # + missing slot
 
@@ -294,8 +383,8 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         pad = (-n) % CHUNK
         if pad:
             binned = jnp.pad(binned, ((0, pad), (0, 0)))
-            grad = jnp.pad(grad, ((0, pad), (0, 0)))
-            hess = jnp.pad(hess, ((0, pad), (0, 0)))
+            grad = jnp.pad(grad, ((0, 0), (0, pad), (0, 0)))
+            hess = jnp.pad(hess, ((0, 0), (0, pad), (0, 0)))
             n = n + pad
         n_chunks = n // CHUNK
         binned_c = binned.reshape(n_chunks, CHUNK, d)
@@ -303,25 +392,36 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         n_chunks = 0
         binned_c = None
 
-    feat = jnp.zeros(m, dtype=jnp.int32)
-    thr_bin = jnp.full(m, n_bins, dtype=jnp.int32)
-    miss_left = jnp.zeros(m, dtype=bool)
-    is_leaf = jnp.zeros(m, dtype=bool)
-    value = jnp.zeros((m, K), dtype=jnp.float32)
+    feat = jnp.zeros((L, m), dtype=jnp.int32)
+    thr_bin = jnp.full((L, m), n_bins, dtype=jnp.int32)
+    miss_left = jnp.zeros((L, m), dtype=bool)
+    is_leaf = jnp.zeros((L, m), dtype=bool)
+    value = jnp.zeros((L, m, K), dtype=jnp.float32)
 
-    node = jnp.zeros(n, dtype=jnp.int32)  # current node id per row
-    gh = jnp.concatenate([grad, hess], axis=1)                           # (n, 2K)
-    gh_c = gh.reshape(n_chunks, CHUNK, 2 * K) if n_chunks else None
+    node = jnp.zeros((L, n), dtype=jnp.int32)  # current node id per row/lane
+    hdt = jnp.int8 if int_exact else _hist_dtype()
+    acc_t = jnp.int32 if int_exact else jnp.float32
+    # gh pre-transposed ONCE to (L, 2K, n): the per-chunk GEMM lhs
+    # (L*nn*2K, rows) is then a pure fused broadcast-multiply — no per-chunk
+    # transpose of a lane-folded tensor (the r5 first cut paid one per chunk
+    # per level and regressed deep forests ~20%)
+    ghT = jnp.concatenate([grad, hess], axis=-1).swapaxes(1, 2)   # (L, 2K, n)
+    ghT = ghT.astype(hdt) if int_exact else ghT
+    # chunk axis leads for the scan; per-step element is (L, 2K, CHUNK)
+    gh_c = ghT.reshape(L, 2 * K, n_chunks, CHUNK).transpose(2, 0, 1, 3) \
+        if n_chunks else None
 
     # per-(node, class, feat, bin) grad/hess histograms as ONE MXU matmul per
     # row block: scatter-free — TPU lowers segment_sum to slow sorts, but
     # contracting the one-hot(node) x [grad|hess] activation against a joint
     # one-hot over the (feature, bin) axis is pure matmul work of shape
-    # (classes*2K, rows) @ (rows, d*B).  The bin one-hot depends only on
-    # ``binned`` (not on the fold/tree vmap axes), so XLA shares it across all
-    # CV lanes.  Inputs go through the MXU in ``hdt`` (bfloat16 on TPU — the
-    # one-hot is exact in bf16 and gradients tolerate 8-bit mantissas, cf.
-    # LightGBM's quantized histograms) with float32 accumulation.
+    # (L*nodes*2K, rows) @ (rows, d*B) — the lane axis folded into M so the
+    # bin one-hot is ONE shared rhs per chunk (a per-lane vmap regenerates it
+    # per lane: r5 measured growth cost linear in L, independent of B).
+    # Inputs go through the MXU in ``hdt`` (bfloat16 on TPU — the one-hot is
+    # exact in bf16 and gradients tolerate 8-bit mantissas, cf. LightGBM's
+    # quantized histograms; EXACT int8 when ``int_exact``) with f32/int32
+    # accumulation.
     #
     # Two classic halvings on top (together ~4x less histogram work):
     # - sibling subtraction: at depth > 0 only LEFT children get a fresh
@@ -330,81 +430,75 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     #   leaves inherit the parent's mass through the subtraction, but those
     #   nodes are unreachable (routing and prediction stop at leaves), so
     #   their garbage gains/values never surface.
-    # - the deepest level (the one with the most nodes) never needs (d, B)
-    #   histograms at all — leaf values only need per-node G/H totals, one
-    #   (2K, rows) @ (rows, nodes) matmul.
-    hdt = _hist_dtype()
+    # - the final level's leaf values derive from the last split's left/right
+    #   sums (already in the cumulative histograms) — no deepest-level data
+    #   pass at all.
 
-    def _hist_block(local_blk, gh_blk, binned_blk, nn):
-        rows = local_blk.shape[0]
-        node_oh = jax.nn.one_hot(local_blk, nn, dtype=hdt)
-        acc = (node_oh[:, :, None] * gh_blk[:, None, :].astype(hdt)
-               ).reshape(rows, nn * 2 * K)
-        # (rows, B, d) layout — NOT (rows, d, B): the innermost axis must be
-        # the 128-lane-aligned feature dim; with B=65 innermost, bf16 tiles
-        # pad 65 -> 128 and half the one-hot bandwidth is wasted (profiled:
-        # these chunk scans are ~100% of GBT fit time)
-        bin_oh = (binned_blk[:, None, :] ==
-                  jnp.arange(B, dtype=binned_blk.dtype)[None, :, None]
-                  ).astype(hdt).reshape(rows, B * d)
-        h = jax.lax.dot_general(
-            acc.T, bin_oh, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        # tiny per-level tensor: transpose back to the (…, d, B) convention
-        return jnp.swapaxes(h.reshape(nn * 2 * K, B, d), -1, -2)
+    def _hist_block(local_blk, ghT_blk, binned_blk, nn, premade):
+        # local_blk: (L, rows); ghT_blk: (L, 2K, rows); binned_blk is the
+        # (rows, d) codes — or, when ``premade``, the already-materialized
+        # (rows, B*d) int8 one-hot slice (boosting reuse, _GBT_MAT_BINOH)
+        rows = binned_blk.shape[0]
+        # node one-hot generated DIRECTLY in (L, nn, rows) layout — broadcast
+        # compare; no transpose anywhere on the lane-folded lhs
+        node_oh = (local_blk[:, None, :] ==
+                   jnp.arange(nn, dtype=local_blk.dtype)[None, :, None]
+                   ).astype(hdt)                                # (L, nn, rows)
+        acc = (node_oh[:, :, None, :] * ghT_blk[:, None, :, :].astype(hdt)
+               ).reshape(L * nn * 2 * K, rows)
+        if premade:
+            bin_oh = binned_blk.astype(hdt)
+        else:
+            # (rows, B, d) layout — NOT (rows, d, B): the innermost axis must
+            # be the 128-lane-aligned feature dim; with B=65 innermost, bf16
+            # tiles pad 65 -> 128 and half the one-hot bandwidth is wasted
+            # (profiled: these chunk scans are ~100% of GBT fit time)
+            bin_oh = (binned_blk[:, None, :] ==
+                      jnp.arange(B, dtype=binned_blk.dtype)[None, :, None]
+                      ).astype(hdt).reshape(rows, B * d)
+        return jax.lax.dot_general(
+            acc, bin_oh, (((1,), (0,)), ((), ())),
+            preferred_element_type=acc_t)                # (L*nn*2K, B*d)
 
     def _level_hist(local, nn):
-        """(nn, 2K, d, B) histogram; negative ``local`` rows contribute 0."""
+        """(L, nn, 2K, d, B) histograms; negative ``local`` rows contribute 0."""
         if n_chunks:
-            local_c = local.reshape(n_chunks, CHUNK)
+            local_c = local.reshape(L, n_chunks, CHUNK).swapaxes(0, 1)
+            premade = bin_oh_c is not None
 
             def chunk_step(hacc, blk):
                 lb, gb, bb = blk
-                return hacc + _hist_block(lb, gb, bb, nn), None
+                return hacc + _hist_block(lb, gb, bb, nn, premade), None
 
-            hist0 = jnp.zeros((nn * 2 * K, d, B), jnp.float32)
-            hist, _ = jax.lax.scan(chunk_step, hist0,
-                                   (local_c, gh_c, binned_c))
+            hist0 = jnp.zeros((L * nn * 2 * K, B * d), acc_t)
+            hist, _ = jax.lax.scan(
+                chunk_step, hist0,
+                (local_c, gh_c, bin_oh_c if premade else binned_c),
+                unroll=_HIST_UNROLL)
         else:
-            hist = _hist_block(local, gh, binned, nn)
-        return hist.reshape(nn, 2 * K, d, B)
+            hist = _hist_block(local, ghT, binned, nn, False)
+        # int_exact: per-(node, feat, bin) partial sums stay far below 2^24,
+        # so the int32 -> f32 conversion is lossless
+        hist = hist.astype(jnp.float32)
+        # tiny per-level tensor: back to the (…, d, B) convention
+        return jnp.swapaxes(hist.reshape(L, nn, 2 * K, B, d), -1, -2)
 
-    def _level_gh(local, nn):
-        """(nn, 2K) per-node grad/hess totals — no bin axis."""
-        def gh_block(lb, gb):
-            node_oh = jax.nn.one_hot(lb, nn, dtype=hdt)
-            return jax.lax.dot_general(
-                gb.T.astype(hdt), node_oh, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)              # (2K, nn)
+    def _leaf_all(G, H):
+        return _leaf_value(G, H, reg_lambda, alpha, eta, max_delta_step)
 
-        if n_chunks:
-            local_c = local.reshape(n_chunks, CHUNK)
-
-            def chunk_step(acc, blk):
-                lb, gb = blk
-                return acc + gh_block(lb, gb), None
-
-            out, _ = jax.lax.scan(chunk_step,
-                                  jnp.zeros((2 * K, nn), jnp.float32),
-                                  (local_c, gh_c))
-        else:
-            out = gh_block(local, gh)
-        return out.T
+    if max_depth == 0:
+        hist = _level_hist(node, 1)                      # root totals only
+        G = hist[:, :, :K, 0, :].sum(-1)
+        H = hist[:, :, K:, 0, :].sum(-1)
+        value = value.at[:, 0:1].set(_leaf_all(G, H))
+        is_leaf = is_leaf.at[:, 0].set(True)
+        return Tree(feat, thr_bin, miss_left, is_leaf, value), node[:, :n_orig]
 
     prev_hist = None
-    for depth in range(max_depth + 1):
+    for depth in range(max_depth):
         first = 2 ** depth - 1
         n_nodes = 2 ** depth
-        local = node - first  # (n,) in [0, n_nodes) for active rows
-
-        if depth == max_depth:
-            GH = _level_gh(local, n_nodes)
-            G, H = GH[:, :K], GH[:, K:]
-            node_val = _leaf_value(G, H, reg_lambda, alpha, eta,
-                                   max_delta_step)
-            value = value.at[first:first + n_nodes].set(node_val)
-            is_leaf = is_leaf.at[first:first + n_nodes].set(True)
-            break
+        local = node - first  # (L, n) in [0, n_nodes) for active rows
 
         if depth == 0:
             hist = _level_hist(local, 1)
@@ -415,74 +509,119 @@ def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             left_local = jnp.where(is_left, local // 2, -1)
             left = _level_hist(left_local, n_nodes // 2)
             right = prev_hist - left
-            hist = jnp.stack([left, right], axis=1).reshape(
-                n_nodes, 2 * K, d, B)
+            hist = jnp.stack([left, right], axis=2).reshape(
+                L, n_nodes, 2 * K, d, B)
         prev_hist = hist
-        hist_g, hist_h = hist[:, :K], hist[:, K:]                        # (nodes,K,d,B)
+        hist_g, hist_h = hist[:, :, :K], hist[:, :, K:]          # (L,nodes,K,d,B)
 
-        G = hist_g[:, :, 0, :].sum(-1)  # (nodes, K) totals (feature 0 covers all rows)
-        H = hist_h[:, :, 0, :].sum(-1)
-        node_val = _leaf_value(G, H, reg_lambda, alpha, eta, max_delta_step)
+        G = hist_g[:, :, :, 0, :].sum(-1)  # (L, nodes, K) totals (feature 0 covers all)
+        H = hist_h[:, :, :, 0, :].sum(-1)
+        node_val = _leaf_all(G, H)
 
         # split search: left = bins [0..b]; missing tried on both sides
-        gl = jnp.cumsum(hist_g[:, :, :, :n_bins], axis=-1)[..., :-1]  # (nodes,K,d,b-1)
-        hl = jnp.cumsum(hist_h[:, :, :, :n_bins], axis=-1)[..., :-1]
-        g_miss = hist_g[:, :, :, n_bins][..., None]
-        h_miss = hist_h[:, :, :, n_bins][..., None]
-        Gt = G[:, :, None, None]
-        Ht = H[:, :, None, None]
+        gl = jnp.cumsum(hist_g[..., :n_bins], axis=-1)[..., :-1]  # (L,nodes,K,d,b-1)
+        hl = jnp.cumsum(hist_h[..., :n_bins], axis=-1)[..., :-1]
+        g_miss = hist_g[..., n_bins][..., None]
+        h_miss = hist_h[..., n_bins][..., None]
+        Gt = G[..., None, None]
+        Ht = H[..., None, None]
 
         def gain_of(gl_, hl_):
             gr_, hr_ = Gt - gl_, Ht - hl_
             # child-weight constraint on the mean hessian across classes so the
             # K=1 case reduces exactly to the scalar XGBoost rule
-            ok = (hl_.mean(1) >= min_child_weight) & (hr_.mean(1) >= min_child_weight)
+            ok = (hl_.mean(2) >= min_child_weight) & (hr_.mean(2) >= min_child_weight)
             eps = 1e-12  # empty-child guard: 0^2/0 counts as zero gain
             raw = (_soft_threshold(gl_, alpha) ** 2 / (hl_ + reg_lambda + eps)
                    + _soft_threshold(gr_, alpha) ** 2 / (hr_ + reg_lambda + eps)
                    - _soft_threshold(Gt, alpha) ** 2 / (Ht + reg_lambda + eps))
-            raw = raw.sum(axis=1)  # sum per-class contributions -> (nodes, d, bins)
+            raw = raw.sum(axis=2)  # sum per-class contributions -> (L, nodes, d, bins)
             return jnp.where(ok, 0.5 * raw - gamma, -jnp.inf)
 
         gain_mr = gain_of(gl, hl)                    # missing goes right
         gain_ml = gain_of(gl + g_miss, hl + h_miss)  # missing goes left
         gain = jnp.maximum(gain_mr, gain_ml)
 
-        level_mask = feat_mask
+        level_mask = feat_mask                       # (L, d)
         if colsample_bylevel < 1.0:
             # salt 3 keeps level draws independent of the subsample (salt 1)
-            # and colsample_bytree (salt 2) draws made from the same round key
+            # and colsample_bytree (salt 2) draws made from the same round key;
+            # ONE draw shared by all lanes (parity with the former vmap, which
+            # closed every lane over the same key)
             level_key = jax.random.fold_in(jax.random.fold_in(key, 3), depth)
-            level_mask = feat_mask * _colsample_mask(level_key, d, colsample_bylevel)
-        gain = jnp.where(level_mask[None, :, None] > 0, gain, -jnp.inf)
+            level_mask = feat_mask * _colsample_mask(level_key, d,
+                                                     colsample_bylevel)[None, :]
+        gain = jnp.where(level_mask[:, None, :, None] > 0, gain, -jnp.inf)
 
-        flat = gain.reshape(n_nodes, -1)
-        best = flat.argmax(axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+        flat = gain.reshape(L, n_nodes, -1)
+        best = flat.argmax(axis=-1)                              # (L, nodes)
+        best_gain = jnp.take_along_axis(flat, best[..., None], -1)[..., 0]
         bf = (best // (n_bins - 1)).astype(jnp.int32)
         bb = (best % (n_bins - 1)).astype(jnp.int32)
-        bml = jnp.take_along_axis(
-            gain_ml.reshape(n_nodes, -1), best[:, None], 1)[:, 0] >= \
-            jnp.take_along_axis(gain_mr.reshape(n_nodes, -1), best[:, None], 1)[:, 0]
+        ml_flat = gain_ml.reshape(L, n_nodes, -1)
+        mr_flat = gain_mr.reshape(L, n_nodes, -1)
+        bml = jnp.take_along_axis(ml_flat, best[..., None], -1)[..., 0] >= \
+            jnp.take_along_axis(mr_flat, best[..., None], -1)[..., 0]
 
         # nodes with no positive gain (or no rows) become leaves now
-        leaf_now = (best_gain <= 0.0) | (H.mean(1) <= 0.0)
+        leaf_now = (best_gain <= 0.0) | (H.mean(-1) <= 0.0)
         sl = slice(first, first + n_nodes)
-        feat = feat.at[sl].set(jnp.where(leaf_now, 0, bf))
-        thr_bin = thr_bin.at[sl].set(jnp.where(leaf_now, n_bins, bb))
-        miss_left = miss_left.at[sl].set(jnp.where(leaf_now, False, bml))
-        is_leaf = is_leaf.at[sl].set(leaf_now)
-        value = value.at[sl].set(node_val)
+        feat = feat.at[:, sl].set(jnp.where(leaf_now, 0, bf))
+        thr_bin = thr_bin.at[:, sl].set(jnp.where(leaf_now, n_bins, bb))
+        miss_left = miss_left.at[:, sl].set(jnp.where(leaf_now, False, bml))
+        is_leaf = is_leaf.at[:, sl].set(leaf_now)
+        value = value.at[:, sl].set(node_val)
+
+        if depth == max_depth - 1:
+            # FINAL level: the children's G/H totals are exactly the chosen
+            # split's left/right sums, already sitting in the cumulative
+            # histograms — deriving leaf values from them eliminates the
+            # former deepest-level totals pass over the data entirely
+            # (one full (n, d) scan per tree per round saved)
+            bidx = jnp.broadcast_to(best[:, :, None, None],
+                                    (L, n_nodes, K, 1))
+            gl_best = jnp.take_along_axis(
+                gl.reshape(L, n_nodes, K, -1), bidx, -1)[..., 0]
+            hl_best = jnp.take_along_axis(
+                hl.reshape(L, n_nodes, K, -1), bidx, -1)[..., 0]
+            fidx = jnp.broadcast_to(bf[:, :, None, None], (L, n_nodes, K, 1))
+            gm_best = jnp.take_along_axis(g_miss[..., 0], fidx, -1)[..., 0]
+            hm_best = jnp.take_along_axis(h_miss[..., 0], fidx, -1)[..., 0]
+            G_l = gl_best + jnp.where(bml[..., None], gm_best, 0.0)
+            H_l = hl_best + jnp.where(bml[..., None], hm_best, 0.0)
+            lv = _leaf_all(G_l, H_l)
+            rv = _leaf_all(G - G_l, H - H_l)
+            child_vals = jnp.stack([lv, rv], axis=2).reshape(
+                L, 2 * n_nodes, K)
+            csl = slice(first + n_nodes, first + 3 * n_nodes)
+            # children of leaf-now parents get garbage values here — they are
+            # unreachable (routing stops at leaves), same as the former
+            # sibling-subtraction garbage
+            value = value.at[:, csl].set(child_vals)
+            is_leaf = is_leaf.at[:, csl].set(True)
 
         # route rows: rows at leaf nodes stay put
-        nf = _node_lookup(feat, node)
-        nb = _row_select(binned, nf)
-        go_left = jnp.where(nb == n_bins, _node_lookup(miss_left, node),
-                            nb <= _node_lookup(thr_bin, node))
+        nf = _node_lookup_l(feat, node)
+        nb = _row_select_l(binned, nf)
+        go_left = jnp.where(nb == n_bins, _node_lookup_l(miss_left, node),
+                            nb <= _node_lookup_l(thr_bin, node))
         child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
-        node = jnp.where(_node_lookup(is_leaf, node), node, child)
+        node = jnp.where(_node_lookup_l(is_leaf, node), node, child)
 
-    return Tree(feat, thr_bin, miss_left, is_leaf, value), node[:n_orig]
+    return Tree(feat, thr_bin, miss_left, is_leaf, value), node[:, :n_orig]
+
+
+def _grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+               feat_mask: jnp.ndarray, key, max_depth: int, n_bins: int,
+               reg_lambda, alpha, gamma, min_child_weight, eta, max_delta_step,
+               colsample_bylevel: float = 1.0):
+    """Single-lane convenience wrapper over ``_grow_trees`` (grad/hess (n, K),
+    feat_mask (d,)); returns (Tree without lane axis, node (n,))."""
+    tree, node = _grow_trees(binned, grad[None], hess[None], feat_mask[None],
+                             key, max_depth, n_bins, reg_lambda, alpha, gamma,
+                             min_child_weight, eta, max_delta_step,
+                             colsample_bylevel)
+    return Tree(*(a[0] for a in tree)), node[0]
 
 
 def _predict_tree(tree: Tree, binned: jnp.ndarray, max_depth: int, n_bins: int
@@ -531,56 +670,87 @@ def _base_score_device(y, w, objective: str, num_class: int, scale_pos_weight):
     return ((w * y).sum() / jnp.maximum(w.sum(), 1e-12))[None]
 
 
-def _fit_gbt_impl(binned, y, w, key, n_rounds: int, max_depth: int, n_bins: int,
-                  objective: str, num_class: int, subsample: float,
-                  colsample_bytree: float, colsample_bylevel: float,
-                  eta, reg_lambda, alpha, gamma, min_child_weight,
-                  scale_pos_weight, max_delta_step, base_score):
-    """Boosting under lax.scan; carry = (n, K) margins.  Returns stacked Tree arrays.
+def _fit_gbt_lanes(binned, y, w_lanes, key, n_rounds: int, max_depth: int,
+                   n_bins: int, objective: str, num_class: int,
+                   subsample: float, colsample_bytree: float,
+                   colsample_bylevel: float, eta, reg_lambda, alpha, gamma,
+                   min_child_weight, scale_pos_weight, max_delta_step,
+                   base_score):
+    """Boosting of L lanes jointly under lax.scan; carry = (L, n, K) margins.
 
-    base_score: (K,) margin offset.  ``subsample`` draws per-round Bernoulli row
-    masks; ``colsample_bytree`` per-round exact-k feature masks (XGBoost semantics).
+    w_lanes: (L, n) per-lane row weights (CV fold weights — validation rows
+    zeroed); base_score: (L, K) per-lane prior margin.  Every lane's tree of
+    round r grows in ONE ``_grow_trees`` call, so the fold lanes share the
+    histogram GEMM's one-hot operand (r5).  ``subsample`` row masks and
+    ``colsample_bytree`` feature masks draw once per round, shared by lanes
+    (parity with the former per-fold vmap over a closed-over key).
+    Returns (final margins (L, n, K), stacked Trees (rounds, L, ...)).
     """
-    n, d = binned.shape
+    L, n = w_lanes.shape
+    d = binned.shape[1]
     K = num_class
 
     if objective == "multi:softmax":
         y_onehot = jax.nn.one_hot(y.astype(jnp.int32), K, dtype=jnp.float32)
 
+    # one int8 bin one-hot shared by every round x level (None when the
+    # unchunked path applies or the operand would exceed the HBM cap)
+    bin_oh_c = _materialize_bin_oh(binned, n_bins) if _GBT_MAT_BINOH else None
+
     def round_fn(margin, r):
         rkey = jax.random.fold_in(key, r)
-        wt = w
+        wt = w_lanes
         if subsample < 1.0:
             wt = wt * jax.random.bernoulli(
-                jax.random.fold_in(rkey, 1), subsample, (n,)).astype(jnp.float32)
+                jax.random.fold_in(rkey, 1), subsample,
+                (n,)).astype(jnp.float32)[None, :]
         feat_mask = jnp.ones(d, dtype=jnp.float32)
         if colsample_bytree < 1.0:
             feat_mask = _colsample_mask(jax.random.fold_in(rkey, 2), d,
                                         colsample_bytree)
+        fm_l = jnp.broadcast_to(feat_mask[None, :], (L, d))
 
         if objective == "binary:logistic":
-            wp = wt * jnp.where(y == 1.0, scale_pos_weight, 1.0)
-            p = jax.nn.sigmoid(margin[:, 0])
-            grad = (wp * (p - y))[:, None]
-            hess = (wp * jnp.maximum(p * (1 - p), 1e-16))[:, None]
+            wp = wt * jnp.where(y == 1.0, scale_pos_weight, 1.0)[None, :]
+            p = jax.nn.sigmoid(margin[..., 0])
+            grad = (wp * (p - y[None, :]))[..., None]
+            hess = (wp * jnp.maximum(p * (1 - p), 1e-16))[..., None]
         elif objective == "multi:softmax":
             p = jax.nn.softmax(margin, axis=-1)
-            grad = wt[:, None] * (p - y_onehot)
-            hess = wt[:, None] * jnp.maximum(p * (1 - p), 1e-16)
+            grad = wt[..., None] * (p - y_onehot[None])
+            hess = wt[..., None] * jnp.maximum(p * (1 - p), 1e-16)
         else:  # reg:squarederror
-            grad = (wt * (margin[:, 0] - y))[:, None]
-            hess = wt[:, None] * jnp.ones((1, 1), jnp.float32)
-        tree, node = _grow_tree(binned, grad, hess, feat_mask, rkey, max_depth,
-                                n_bins, reg_lambda, alpha, gamma,
-                                min_child_weight, eta, max_delta_step,
-                                colsample_bylevel)
+            grad = (wt * (margin[..., 0] - y[None, :]))[..., None]
+            hess = wt[..., None] * jnp.ones((1, 1, 1), jnp.float32)
+        tree, node = _grow_trees(binned, grad, hess, fm_l, rkey, max_depth,
+                                 n_bins, reg_lambda, alpha, gamma,
+                                 min_child_weight, eta, max_delta_step,
+                                 colsample_bylevel, bin_oh_c=bin_oh_c)
         # the grower already routed every row to its leaf — no re-traversal
-        new_margin = margin + _node_lookup(tree.value, node)
+        new_margin = margin + _node_lookup_l(tree.value, node)
         return new_margin, tree
 
-    margin0 = jnp.broadcast_to(base_score.astype(jnp.float32), (n, K))
+    margin0 = jnp.broadcast_to(base_score.astype(jnp.float32)[:, None, :],
+                               (L, n, K))
     final_margin, trees = jax.lax.scan(round_fn, margin0, jnp.arange(n_rounds))
     return final_margin, trees
+
+
+def _fit_gbt_impl(binned, y, w, key, n_rounds: int, max_depth: int, n_bins: int,
+                  objective: str, num_class: int, subsample: float,
+                  colsample_bytree: float, colsample_bylevel: float,
+                  eta, reg_lambda, alpha, gamma, min_child_weight,
+                  scale_pos_weight, max_delta_step, base_score):
+    """Single-lane boosting (the refit path).  base_score: (K,) margin offset.
+    Returns (final margins (n, K), stacked Trees (rounds, ...)) — identical
+    PRNG stream and semantics to one lane of ``_fit_gbt_lanes``."""
+    margin, trees = _fit_gbt_lanes(
+        binned, y, w[None, :], key, n_rounds, max_depth, n_bins, objective,
+        num_class, subsample, colsample_bytree, colsample_bylevel, eta,
+        reg_lambda, alpha, gamma, min_child_weight, scale_pos_weight,
+        max_delta_step, jnp.reshape(jnp.asarray(base_score, jnp.float32),
+                                    (1, -1)))
+    return margin[0], Tree(*(a[:, 0] for a in trees))
 
 
 _GBT_STATICS = ("n_rounds", "max_depth", "n_bins", "objective", "num_class",
@@ -599,30 +769,36 @@ def _fit_gbt(binned, y, w, key, n_rounds, max_depth, n_bins, objective, num_clas
 
 
 def _fit_forest_impl(binned, y_cols, w, max_depth: int, n_bins: int,
-                     reg_lambda, min_child_weight, feat_masks, boot_w):
-    """Random forest: vmap the grower over (bootstrap weights, feature masks).
+                     reg_lambda, min_child_weight, feat_masks, boot_w,
+                     int_exact: bool = False):
+    """Random forest: grow all (bootstrap weights, feature masks) lanes in one
+    joint ``_grow_trees`` call — the T tree lanes fold into the histogram
+    GEMM's M dimension instead of a per-tree vmap (r5).
 
     y_cols: (n, K) regression targets — one-hot class indicators for classification,
     so leaf values are per-class probability vectors; variance-reduction splits on
     one-hot targets equal Gini-gain splits up to a constant factor.
+
+    int_exact: histogram GEMMs in int8 (EXACT — see _grow_trees); valid only
+    when w is 0/1 and y_cols is one-hot (callers verify host-side).
     """
     key = jax.random.PRNGKey(0)  # unused (no bylevel sampling in forests)
-
-    def one_tree(fm, bw):
-        wt = w * bw
-        grad = -wt[:, None] * y_cols   # squared loss around 0 => leaf = weighted mean
-        hess = wt[:, None] * jnp.ones((1, y_cols.shape[1]), jnp.float32)
-        return _grow_tree(binned, grad, hess, fm, key, max_depth, n_bins,
-                          reg_lambda, 0.0, 0.0, min_child_weight, 1.0, 0.0)
-
-    return jax.vmap(one_tree)(feat_masks, boot_w)  # (trees, nodes (T, n))
+    wt = w[None, :] * boot_w                                     # (T, n)
+    # squared loss around 0 => leaf = weighted mean of targets
+    grad = -wt[:, :, None] * y_cols[None]                        # (T, n, K)
+    hess = wt[:, :, None] * jnp.ones((1, 1, y_cols.shape[1]), jnp.float32)
+    return _grow_trees(binned, grad, hess, feat_masks, key, max_depth, n_bins,
+                       reg_lambda, 0.0, 0.0, min_child_weight, 1.0, 0.0,
+                       int_exact=int_exact)
 
 
-@partial(jax.jit, static_argnames=("max_depth", "n_bins"))
+@partial(jax.jit, static_argnames=("max_depth", "n_bins", "int_exact"))
 def _fit_forest(binned, y_cols, w, max_depth, n_bins,
-                reg_lambda, min_child_weight, feat_masks, boot_w):
+                reg_lambda, min_child_weight, feat_masks, boot_w,
+                int_exact=False):
     return _fit_forest_impl(binned, y_cols, w, max_depth, n_bins,
-                            reg_lambda, min_child_weight, feat_masks, boot_w)[0]
+                            reg_lambda, min_child_weight, feat_masks, boot_w,
+                            int_exact=int_exact)[0]
 
 
 @partial(jax.jit, static_argnames=("max_depth", "n_bins"))
@@ -646,51 +822,69 @@ def _gbt_cv_program(binned, y, train_w, val_w, key, n_rounds, max_depth, n_bins,
     full row block already contain the validation predictions (fold membership only
     zeroes training weights), so fit + eval fuse with no second predict pass.
     The prior margin is recomputed per fold from the fold's training weights —
-    exactly what ``_fit_arrays`` would produce on that fold."""
-
-    def one_fold(w_, vw_):
-        base_score = _base_score_device(y, w_, objective, num_class,
-                                        scale_pos_weight)
-        margin, _ = _fit_gbt_impl(
-            binned, y, w_, key, n_rounds, max_depth, n_bins, objective, num_class,
-            subsample, colsample_bytree, colsample_bylevel, eta, reg_lambda, alpha,
-            gamma, min_child_weight, scale_pos_weight, max_delta_step, base_score)
-        if objective == "binary:logistic":
-            payload = jax.nn.sigmoid(margin[:, 0])
-        elif objective == "multi:softmax":
-            payload = jax.nn.softmax(margin, axis=-1)
-        else:
-            payload = margin[:, 0]
-        return metric_fn(payload, y, vw_)
-
-    return jax.vmap(one_fold)(train_w, val_w)
+    exactly what ``_fit_arrays`` would produce on that fold.  Folds are LANES
+    of one joint boosting run (``_fit_gbt_lanes``): each round grows all
+    folds' trees in one histogram GEMM sharing the one-hot operand (r5)."""
+    base = jax.vmap(lambda w_: _base_score_device(
+        y, w_, objective, num_class, scale_pos_weight))(train_w)     # (k, K)
+    margin, _ = _fit_gbt_lanes(
+        binned, y, train_w, key, n_rounds, max_depth, n_bins, objective,
+        num_class, subsample, colsample_bytree, colsample_bylevel, eta,
+        reg_lambda, alpha, gamma, min_child_weight, scale_pos_weight,
+        max_delta_step, base)                                    # (k, n, K)
+    if objective == "binary:logistic":
+        payload = jax.nn.sigmoid(margin[..., 0])
+    elif objective == "multi:softmax":
+        payload = jax.nn.softmax(margin, axis=-1)
+    else:
+        payload = margin[..., 0]
+    return jax.vmap(lambda pf, vw_: metric_fn(pf, y, vw_))(payload, val_w)
 
 
 @partial(jax.jit, static_argnames=("max_depth", "n_bins", "classification",
-                                  "metric_fn"))
+                                  "metric_fn", "int_exact"))
 def _forest_cv_program(binned, y, y_cols, train_w, val_w, feat_masks, boot_w,
                        max_depth, n_bins, reg_lambda, min_child_weight,
-                       classification, metric_fn):
-    """All folds of one forest grid point (fit + predict + metric) in one program."""
-    n_trees = feat_masks.shape[0]
+                       classification, metric_fn, int_exact=False):
+    """All folds of one forest grid point (fit + predict + metric) in one
+    program.  The (fold x tree) grid flattens into k*T lanes of ONE joint
+    ``_grow_trees`` call — every lane shares the histogram GEMM's one-hot
+    operand instead of regenerating it per fold per tree (r5)."""
+    k, n = train_w.shape
+    n_trees, _ = feat_masks.shape
+    K = y_cols.shape[1]
+    if _RF_FOLD_VMAP:
+        def one_fold(w_):
+            return _fit_forest_impl(binned, y_cols, w_, max_depth, n_bins,
+                                    reg_lambda, min_child_weight,
+                                    feat_masks, boot_w, int_exact=int_exact)
 
-    def one_fold(w_, vw_):
-        trees, nodes = _fit_forest_impl(binned, y_cols, w_, max_depth, n_bins,
-                                        reg_lambda, min_child_weight,
-                                        feat_masks, boot_w)
-        # in-sample votes read each tree's final row->leaf assignment from the
-        # grower — no re-traversal of the whole forest
-        vals = jax.vmap(_node_lookup)(trees.value, nodes)        # (T, n, K)
-        mean = vals.sum(axis=0) / n_trees
-        if classification:
-            payload = mean[:, 0] if mean.shape[1] == 1 else \
-                jnp.clip(mean, 0.0, 1.0) / jnp.maximum(
-                    jnp.clip(mean, 0.0, 1.0).sum(-1, keepdims=True), 1e-12)
+        trees, nodes = jax.vmap(one_fold)(train_w)       # (k, T, ...)
+        vals = jax.vmap(_node_lookup_l)(trees.value, nodes)  # (k, T, n, K)
+        mean = vals.sum(axis=1) / n_trees                # (k, n, K)
+    else:
+        wt = (train_w[:, None, :] * boot_w[None, :, :]
+              ).reshape(k * n_trees, n)
+        grad = -wt[:, :, None] * y_cols[None]
+        hess = wt[:, :, None] * jnp.ones((1, 1, K), jnp.float32)
+        masks = jnp.tile(feat_masks, (k, 1))
+        trees, nodes = _grow_trees(
+            binned, grad, hess, masks, jax.random.PRNGKey(0), max_depth,
+            n_bins, reg_lambda, 0.0, 0.0, min_child_weight, 1.0, 0.0,
+            int_exact=int_exact)
+        # in-sample votes read each lane's final row->leaf assignment from
+        # the grower — no re-traversal of the whole forest
+        vals = _node_lookup_l(trees.value, nodes)            # (k*T, n, K)
+        mean = vals.reshape(k, n_trees, n, K).sum(axis=1) / n_trees
+    if classification:
+        if K == 1:
+            payload = mean[..., 0]
         else:
-            payload = mean[:, 0]
-        return metric_fn(payload, y, vw_)
-
-    return jax.vmap(one_fold)(train_w, val_w)
+            cl = jnp.clip(mean, 0.0, 1.0)
+            payload = cl / jnp.maximum(cl.sum(-1, keepdims=True), 1e-12)
+    else:
+        payload = mean[..., 0]
+    return jax.vmap(lambda pf, vw_: metric_fn(pf, y, vw_))(payload, val_w)
 
 
 # ---------------------------------------------------------------------------
@@ -781,6 +975,19 @@ class _TreeEnsembleModelBase(PredictionModelBase):
         tot = counts.sum()
         return counts / tot if tot > 0 else counts
 
+    def _margin_device(self, x32: np.ndarray):
+        """(margins (n_padded, K) on device, base (K,)) over the shared
+        placement — no host fetch; selector train-eval fast path."""
+        from ..parallel.mesh import place_rows_bucketed_cached
+
+        xd, _ = place_rows_bucketed_cached(np.asarray(x32, np.float32),
+                                           insert=False)
+        binned = _digitize_device(xd, jnp.asarray(self.edges), self.n_bins)
+        m = _predict_trees_sum(self._tree_batch(), binned, self.max_depth,
+                               self.n_bins)
+        base = np.asarray(self.base_score, dtype=np.float64).reshape(-1)
+        return m, base
+
 
 class GBTClassifierModel(_TreeEnsembleModelBase):
     def predict_column(self, vec: Column) -> PredictionColumn:
@@ -793,6 +1000,13 @@ class GBTClassifierModel(_TreeEnsembleModelBase):
         from .base import softmax_probs
 
         return PredictionColumn.classification(m, softmax_probs(m))
+
+    def eval_payload_device(self, x32):
+        if self.n_outputs != 1:
+            return None  # multiclass eval is host-side (confusion matrices)
+        m, base = self._margin_device(x32)
+        z = m[:, 0] + jnp.float32(base[0])
+        return jax.nn.sigmoid(z), (z > 0).astype(jnp.float32)
 
 
 class GBTRegressorModel(_TreeEnsembleModelBase):
@@ -810,6 +1024,14 @@ class ForestClassifierModel(_TreeEnsembleModelBase):
             prob = np.clip(mean, 0.0, 1.0)
             prob = prob / np.maximum(prob.sum(axis=1, keepdims=True), 1e-12)
         return PredictionColumn.classification(prob * self.n_trees, prob)
+
+    def eval_payload_device(self, x32):
+        if self.n_outputs != 1:
+            return None
+        m, base = self._margin_device(x32)
+        b = jnp.float32(base[0] if len(base) else 0.0)
+        p1 = jnp.clip((m[:, 0] + b) / self.n_trees, 0.0, 1.0)
+        return p1, (p1 > 0.5).astype(jnp.float32)
 
 
 class ForestRegressorModel(_TreeEnsembleModelBase):
@@ -856,6 +1078,9 @@ class _TreeEstimatorBase(PredictionEstimatorBase):
         from .base import sweep_placements
 
         x32 = np.asarray(x, np.float32)
+        # 0/1 fold weights (the unweighted/unbalanced case) let forests run
+        # the EXACT int8 histogram path — verified host-side, decided per fit
+        int01 = bool(np.all((train_w == 0.0) | (train_w == 1.0)))
         xd, _, tw, vw, n0 = sweep_placements(x32, [], train_w, val_w)
         binned, _ = _shared_binned(x32, xd, int(self.n_bins))
         pad = int(xd.shape[0]) - n0
@@ -870,14 +1095,16 @@ class _TreeEstimatorBase(PredictionEstimatorBase):
             # a grid point that changes the binning resolution needs its own codes
             b = binned if int(est.n_bins) == int(self.n_bins) else \
                 _shared_binned(x32, xd, int(est.n_bins))[0]
-            pending.append(est._sweep_folds(b, x, y_p, tw, vw, metric_fn))
+            pending.append(est._sweep_folds(b, x, y_p, tw, vw, metric_fn,
+                                            weights01=int01))
         return pending
 
     def _reshard_fold_weights(self, tw, vw):
         """Family-specific model-axis layout for the fold weight matrices."""
         return tw, vw
 
-    def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn):
+    def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn,
+                     weights01=False):
         raise NotImplementedError
 
 
@@ -949,7 +1176,8 @@ class _GBTBase(_TreeEstimatorBase):
         return (place_spec(tw, (MODEL_AXIS, DATA_AXIS)),
                 place_spec(vw, (MODEL_AXIS, DATA_AXIS)))
 
-    def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn):
+    def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn,
+                     weights01=False):
         from ..parallel.mesh import DATA_AXIS, place_cached
 
         objective, num_class, _ = self._resolved(y, np.ones_like(y))
@@ -1077,10 +1305,14 @@ class _ForestBase(_TreeEstimatorBase):
             int(self.max_depth), int(self.n_bins),
             jnp.float32(self.reg_lambda), jnp.float32(self.min_child_weight),
             self._masks(x.shape[1]), boot,
+            int_exact=bool(self.classification
+                           and np.all((np.asarray(w) == 0.0)
+                                      | (np.asarray(w) == 1.0))),
         )
         return trees, edges
 
-    def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn):
+    def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn,
+                     weights01=False):
         from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, place_cached
         from .base import place_spec
 
@@ -1103,6 +1335,9 @@ class _ForestBase(_TreeEstimatorBase):
             int(self.max_depth), int(self.n_bins), jnp.float32(self.reg_lambda),
             jnp.float32(self.min_child_weight), classification=self.classification,
             metric_fn=metric_fn,
+            # grad/hess = fold_w x poisson counts x one-hot targets: exact
+            # int8 when fold weights are 0/1 and targets are class indicators
+            int_exact=weights01 and self.classification,
         )
 
 
