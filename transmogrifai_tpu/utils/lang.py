@@ -58,7 +58,16 @@ SEED_TEXTS: Dict[str, str] = {
            "volta correndo para casa porque estava ficando tarde à noite "
            "quando todas as crianças já estavam dormindo e as luzes da "
            "cidade se apagavam uma a uma enquanto a chuva continuava caindo "
-           "suavemente sobre os telhados"),
+           "suavemente sobre os telhados "
+           # everyday register — keeps pt apart from gl on short strings
+           "bom dia queria perguntar se vocês têm horário livre para "
+           "amanhã à tarde preciso levar o carro até a oficina e não sei "
+           "quanto vai custar obrigado pela resposta me escreva por favor "
+           "o quanto antes ou ligue para o número que deixei na semana "
+           "passada compramos sapatos novos na loja mas ficaram pequenos "
+           "então vamos ter que trocar a fatura chega sempre até "
+           "sexta-feira e o celular continua reiniciando depois da "
+           "atualização"),
     "nl": ("de snelle bruine vos springt over de luie hond en rent daarna "
            "terug naar huis omdat het al laat werd in de avond toen alle "
            "kinderen al sliepen en de lichten van de stad een voor een "
@@ -66,11 +75,26 @@ SEED_TEXTS: Dict[str, str] = {
     "ru": ("быстрая коричневая лиса прыгает через ленивую собаку и потом "
            "бежит домой потому что вечером уже становилось поздно когда все "
            "дети уже спали и огни города гасли один за другим пока дождь "
-           "продолжал тихо падать на крыши домов"),
+           "продолжал тихо падать на крыши домов "
+           # everyday register (requests, errands) — short strings need
+           # n-grams from common verbs and clitics, not just narrative
+           "добрый день хотел спросить есть ли у вас свободное время на "
+           "завтра после обеда мне нужно отвезти машину в сервис и я не "
+           "знаю сколько это будет стоить спасибо большое за ответ "
+           "напишите мне пожалуйста как можно скорее или позвоните по "
+           "номеру который я оставил на прошлой неделе в магазине мы "
+           "купили новые ботинки но они оказались малы поэтому их нужно "
+           "поменять после обновления программа работает лучше"),
     "uk": ("швидка коричнева лисиця стрибає через ледачого пса і потім "
            "біжить додому бо ввечері вже ставало пізно коли всі діти вже "
            "спали і вогні міста гасли один за одним поки дощ продовжував "
-           "тихо падати на дахи будинків"),
+           "тихо падати на дахи будинків "
+           "добрий день хотів запитати чи є у вас вільне місце на завтра "
+           "після обіду мені треба відвезти машину в сервіс і я не знаю "
+           "скільки це коштуватиме дякую за відповідь напишіть мені будь "
+           "ласка якнайшвидше або зателефонуйте за номером який я залишив "
+           "минулого тижня в магазині ми купили нові черевики але вони "
+           "виявилися малі тому їх треба поміняти"),
     "pl": ("szybki brązowy lis skacze nad leniwym psem a potem biegnie z "
            "powrotem do domu ponieważ wieczorem robiło się już późno kiedy "
            "wszystkie dzieci już spały a światła miasta gasły jedno po "
@@ -213,6 +237,73 @@ SEED_TEXTS: Dict[str, str] = {
            "wakati watoto wote walikuwa wamelala tayari na taa za mji "
            "zilizimika moja baada ya nyingine huku mvua ikiendelea kunyesha "
            "polepole juu ya mapaa"),
+    # --- r5 breadth (VERDICT r4 #8): nine more toward optimaize's ~70 ---
+    "bg": ("бързата кафява лисица прескача мързеливото куче и после тича "
+           "обратно към къщи защото вечерта вече ставаше късно когато "
+           "всички деца вече спяха и светлините на града угасваха една "
+           "след друга докато дъждът продължаваше да пада тихо върху "
+           "покривите добър ден бих искал да попитам дали имате свободно "
+           "място за утре следобед трябва да закарам колата на сервиз и не "
+           "знам колко ще струва благодаря много за отговора"),
+    "ca": ("la ràpida guineu marró salta per sobre del gos mandrós i "
+           "després torna corrents cap a casa perquè es feia tard al "
+           "vespre quan tots els nens ja dormien i els llums de la ciutat "
+           "s'apagaven un darrere l'altre mentre la pluja continuava "
+           "caient suaument sobre les teulades bon dia voldria preguntar "
+           "si teniu lloc lliure per demà a la tarda haig de portar el "
+           "cotxe al taller i no sé quant costarà moltes gràcies per la "
+           "resposta escriviu-me si us plau tan aviat com pugueu"),
+    "gl": ("o rápido raposo marrón salta por riba do can preguiceiro e "
+           "despois volve correndo á casa porque se estaba a facer tarde "
+           "pola noite cando todos os nenos xa durmían e as luces da "
+           "cidade apagábanse unha tras outra mentres a chuvia seguía "
+           "caendo suavemente sobre os tellados bo día quería preguntar "
+           "se tedes sitio libre para mañá pola tarde teño que levar o "
+           "coche ao taller e non sei canto vai custar moitas grazas pola "
+           "resposta escribídeme por favor canto antes"),
+    "lt": ("greita ruda lapė peršoka per tingų šunį ir paskui bėga atgal "
+           "namo nes vakare jau buvo vėlu kai visi vaikai jau miegojo ir "
+           "miesto šviesos geso viena po kitos kol lietus toliau tyliai "
+           "krito ant stogų laba diena norėčiau paklausti ar turite "
+           "laisvą vietą rytojaus popietei nes turiu nuvežti automobilį į "
+           "servisą ir nežinau kiek tai kainuos labai ačiū už atsakymą "
+           "parašykite man prašau kuo greičiau"),
+    "lv": ("ātrā brūnā lapsa pārlec pār slinko suni un tad skrien atpakaļ "
+           "mājās jo vakarā jau kļuva vēls kad visi bērni jau gulēja un "
+           "pilsētas gaismas dzisa viena pēc otras kamēr lietus turpināja "
+           "klusi krist uz jumtiem labdien es vēlētos pajautāt vai jums "
+           "ir brīva vieta rītdienas pēcpusdienai jo man jāaizved "
+           "automašīna uz servisu un es nezinu cik tas maksās liels "
+           "paldies par atbildi lūdzu uzrakstiet man pēc iespējas ātrāk"),
+    "et": ("kiire pruun rebane hüppab üle laisa koera ja jookseb siis "
+           "koju tagasi sest õhtul läks juba hiljaks kui kõik lapsed "
+           "juba magasid ja linna tuled kustusid üksteise järel samal "
+           "ajal kui vihm jätkas vaikselt katustele langemist tere "
+           "sooviksin küsida kas teil on homme pärastlõunal vaba aega "
+           "sest pean auto töökotta viima ja ma ei tea kui palju see "
+           "maksma läheb suur tänu vastuse eest kirjutage mulle palun "
+           "võimalikult kiiresti"),
+    "hr": ("brza smeđa lisica preskače lijenog psa i zatim trči natrag "
+           "kući jer je navečer već postajalo kasno kada su sva djeca "
+           "već spavala i svjetla grada gasila su se jedno za drugim dok "
+           "je kiša i dalje tiho padala po krovovima dobar dan htio bih "
+           "pitati imate li slobodno mjesto za sutra poslijepodne moram "
+           "odvesti auto u servis i ne znam koliko će to koštati puno "
+           "hvala na odgovoru napišite mi molim vas što prije"),
+    "sl": ("hitra rjava lisica skoči čez lenega psa in nato teče nazaj "
+           "domov ker je zvečer postajalo že pozno ko so vsi otroci že "
+           "spali in so luči mesta ugašale ena za drugo medtem ko je dež "
+           "še naprej tiho padal na strehe dober dan rad bi vprašal ali "
+           "imate prosto mesto za jutri popoldne ker moram peljati avto "
+           "na servis in ne vem koliko bo to stalo najlepša hvala za "
+           "odgovor prosim pišite mi čim prej"),
+    "az": ("sürətli qəhvəyi tülkü tənbəl itin üstündən tullanır və sonra "
+           "evə geri qaçır çünki axşam artıq gec olurdu bütün uşaqlar "
+           "artıq yatmışdı və şəhərin işıqları bir bir sönürdü yağış "
+           "damların üzərinə yavaş yavaş yağmağa davam edirdi salam "
+           "sabah günorta üçün boş yeriniz olub olmadığını soruşmaq "
+           "istəyirəm maşını servisə aparmalıyam və nə qədər baha "
+           "olacağını bilmirəm cavab üçün çox sağ olun"),
 }
 
 LANGUAGES: Tuple[str, ...] = tuple(sorted(SEED_TEXTS))
@@ -238,6 +329,7 @@ _SCRIPT_RANGES = (
 # (U+06A9), which Persian orthography uses where Arabic writes ي / ك
 _PERSIAN_CHARS = set("پچژگیک")
 _UKRAINIAN_CHARS = set("іїєґ")
+_RUSSIAN_CHARS = set("ыэё")
 
 
 def _script_counts(text: str) -> Dict[str, int]:
@@ -346,15 +438,26 @@ def detect_language_scores(text: Optional[str]) -> Dict[str, float]:
     scripted = _script_language(text, counts)
     if scripted is not None:
         return {scripted: 1.0}
-    # cyrillic: ru vs uk
+    # cyrillic: ru vs uk vs bg
     if counts.get("cyrillic", 0) > counts.get("latin", 0):
-        if any(c in _UKRAINIAN_CHARS for c in text.lower()):
+        low = text.lower()
+        if any(c in _UKRAINIAN_CHARS for c in low):
             return {"uk": 1.0}
-        candidates = ("ru", "uk")
+        # ы / э / ё exist in the Russian alphabet but in neither the
+        # Ukrainian nor the Bulgarian one — almost every Russian sentence
+        # carries at least one
+        if any(c in _RUSSIAN_CHARS for c in low):
+            return {"ru": 1.0}
+        candidates = ("ru", "uk", "bg")
     else:
+        # Azerbaijani schwa appears in nearly every az sentence and in no
+        # other Latin-script language here — decide before profiles (the
+        # az/tr n-gram profiles are otherwise close)
+        if "ə" in text.lower():
+            return {"az": 1.0}
         candidates = tuple(l for l in LANGUAGES if l not in (
             "el", "he", "ar", "fa", "hi", "bn", "th", "ko", "ja", "zh",
-            "ru", "uk"))
+            "ru", "uk", "bg"))
     doc = _rank_profile(_text_ngrams(text))
     profs = _profiles()
     # rank distance blended with a function-word overlap bonus: short inputs
@@ -476,6 +579,60 @@ STOPWORDS: Dict[str, FrozenSet[str]] = {
         από που δεν θα είναι ήταν έχει είχε αυτό αυτή αυτός ως κατά μετά
         πριν χωρίς πάνω κάτω μέσα έξω ένα μια πολύ πιο όπως όταν αλλά ή
         αν τι πως""".split()),
+    # --- r5 analyzer breadth (VERDICT r4 #8) ---
+    "ar": frozenset("""في من على إلى عن أن إن كان كانت هذا هذه ذلك التي
+        الذي ما لا لم لن قد كل بعد قبل عند حتى هو هي هم نحن أنا أنت ثم
+        أو و يا إذا لكن بين غير سوف هناك حيث كما أي مع منذ عندما لأن""".split()),
+    "fa": frozenset("""و در به از که این آن را با برای است بود شد های می
+        هم او ما شما آنها من تو یک دو تا هر اگر اما یا نیز پس چون بر
+        چه کرد شده باید خود دیگر هیچ همه وقتی چرا کجا""".split()),
+    "hi": frozenset("""का के की है में और से को पर यह वह एक ने हैं था थी
+        थे हो गया गई कर रहा रही रहे लिए भी नहीं तो ही कि जो अब तक साथ
+        बाद फिर कुछ सब अपने उनके इसके हम तुम आप वे मैं क्या कब कहाँ""".split()),
+    "uk": frozenset("""і в не на я що він з як це по але вони до у же ви
+        за ми від вона так його то все а о її йому тільки мене було коли
+        вже для хто ні якщо або бути був них нас їх чим мені є про цей
+        той де навіть під буде тоді себе нічого може тут треба там потім
+        дуже через ці один такий""".split()),
+    "bg": frozenset("""и в не на аз що той с как това по но те до у же
+        вие за ние от тя така го то всичко а о ѝ му само мене беше кога
+        вече за кой не ако или да бил тях нас им какво ми е при този онзи
+        къде дори под ще тогава себе нищо може тук трябва там после
+        много през тези един такъв се са като ли""".split()),
+    "ca": frozenset("""el la els les un una de del dels i en a per amb que
+        és són era no hi ha més però com si o ja molt poc tot tots aquest
+        aquesta això allò seu seva meu meva nostre vostre jo tu ell ella
+        nosaltres vosaltres quan on qui què perquè sense sobre entre
+        fins des també només""".split()),
+    "gl": frozenset("""o a os as un unha de do dos da das e en por para
+        con que é son era non hai máis pero como se ou xa moi pouco todo
+        todos este esta isto aquilo seu súa meu miña noso voso eu ti el
+        ela nós vós cando onde quen que porque sen sobre entre ata desde
+        tamén só""".split()),
+    "lt": frozenset("""ir yra į iš su be per po prie už kad kaip bet ar
+        jau dar tik taip pat labai čia ten kur kada kas jis ji mes jūs aš
+        tu jie jos šis ši tas ta visi visos savo mano tavo mūsų jūsų
+        buvo bus būti nėra prieš tarp apie nuo iki""".split()),
+    "lv": frozenset("""un ir uz no ar bez par pēc pie aiz ka kā bet vai
+        jau vēl tikai tā arī ļoti šeit tur kur kad kas viņš viņa mēs jūs
+        es tu viņi viņas šis šī tas tā visi visas savs mans tavs mūsu
+        jūsu bija būs būt nav pirms starp ap līdz""".split()),
+    "et": frozenset("""ja on ei see et oli ta aga nad kui mis nii nagu ka
+        siis veel ainult siin seal kus millal kes tema meie teie mina
+        sina nemad kõik oma minu sinu enne vahel umbes kuni juba väga
+        pärast ilma koos üle alla sisse välja ning või ning olema pole""".split()),
+    # detection-only sets (no analyzers yet): overlap bonus for short strings
+    "hr": frozenset("""i u na je se da su za s o od do kao ali ili već
+        još samo tako vrlo ovdje tamo gdje kada tko što on ona mi vi ja
+        ti oni ove ovaj ta taj svi sve svoj moj tvoj naš vaš bio bila
+        biti nije prije između oko""".split()),
+    "sl": frozenset("""in v na je se da so za s o od do kot ali pa že še
+        samo tako zelo tukaj tam kje kdaj kdo kaj on ona mi vi jaz ti
+        oni ta ti vsi vse svoj moj tvoj naš vaš bil bila biti ni pred
+        med okoli""".split()),
+    "az": frozenset("""və bir bu da də üçün ilə o mən sən biz siz onlar
+        amma kimi daha çox ən nə var yox sonra əvvəl qədər hər şey ki ya
+        həm isə deyil olan bunu onun""".split()),
 }
 
 
@@ -700,7 +857,142 @@ _STEMMERS = {
         ("ους", ""), ("ων", ""), ("ες", ""), ("ος", ""), ("ου", ""),
         ("ας", ""), ("ης", ""), ("α", ""), ("η", ""), ("ο", ""),
         ("ι", "")], min_stem=3),
+    # --- r5 breadth (VERDICT r4 #8): ten more of the reference's Lucene
+    # analyzer inventory, incl. Arabic with its normalizer ---
+    "uk": _suffix_stemmer([
+        ("іями", ""), ("ями", ""), ("ами", ""), ("ого", ""), ("ього", ""),
+        ("ому", ""), ("ьому", ""), ("ими", ""), ("іми", ""), ("ється", ""),
+        ("ються", ""), ("еш", ""), ("ете", ""), ("ають", ""), ("яють", ""),
+        ("ала", ""), ("ила", ""), ("ена", ""), ("ості", "іст"),
+        ("остей", "іст"), ("а", ""), ("я", ""), ("о", ""), ("е", ""),
+        ("и", ""), ("і", ""), ("у", ""), ("ю", ""), ("ь", ""),
+        ("ий", ""), ("ій", ""), ("ої", ""), ("ів", ""), ("ах", ""),
+        ("ях", ""), ("ом", ""), ("ем", ""), ("ам", ""), ("ям", ""),
+        ("ти", "")]),
+    "bg": _suffix_stemmer([
+        ("остите", "ост"), ("остта", "ост"), ("овете", ""), ("ията", ""),
+        ("ите", ""), ("ата", ""), ("ята", ""), ("ове", ""), ("ето", ""),
+        ("та", ""), ("то", ""), ("те", ""), ("ът", ""),
+        ("ят", ""), ("ия", ""), ("ваше", ""), ("еше", ""), ("аха", ""),
+        ("а", ""), ("я", ""), ("о", ""), ("е", ""), ("и", ""),
+        ("у", "")]),
+    "ca": _suffix_stemmer([
+        ("aments", ""), ("ament", ""), ("acions", ""), ("ació", ""),
+        ("itats", ""), ("itat", ""), ("ments", ""), ("ment", ""),
+        ("istes", "ista"), ("able", ""), ("ible", ""), ("ança", ""),
+        ("ència", ""), ("ant", ""), ("ent", ""), ("ats", "at"),
+        ("ada", ""), ("ades", ""), ("ar", ""), ("er", ""), ("ir", ""),
+        ("es", ""), ("os", ""), ("s", ""), ("a", ""), ("e", "")]),
+    "gl": _suffix_stemmer([
+        ("amentos", ""), ("amento", ""), ("acións", ""), ("ación", ""),
+        ("idades", "idade"), ("idade", ""), ("mente", ""), ("ando", ""),
+        ("endo", ""), ("indo", ""), ("ados", "ad"), ("idos", "id"),
+        ("ado", "ad"), ("ido", "id"), ("oso", ""), ("osa", ""),
+        ("ar", ""), ("er", ""), ("ir", ""), ("os", "o"), ("as", "a"),
+        ("es", ""), ("s", "")]),
+    "lt": _suffix_stemmer([
+        ("iausias", ""), ("iausia", ""), ("uose", ""), ("uosiuose", ""),
+        ("iams", ""), ("omis", ""), ("amis", ""), ("ams", ""),
+        ("ais", ""), ("oms", ""), ("ose", ""), ("ius", ""), ("iai", ""),
+        ("iui", ""), ("imas", ""), ("imo", ""), ("ių", ""), ("as", ""),
+        ("is", ""), ("ys", ""), ("us", ""), ("os", ""), ("ai", ""),
+        ("ui", ""), ("ės", ""), ("ę", ""), ("ų", ""), ("ą", ""),
+        ("į", ""), ("o", ""), ("a", ""), ("e", ""), ("i", ""),
+        ("u", ""), ("ė", ""), ("y", "")]),
+    "lv": _suffix_stemmer([
+        ("šanas", ""), ("šanu", ""), ("šana", ""), ("ības", "ība"),
+        ("ību", "ība"), ("iem", ""), ("ajiem", ""), ("ajām", ""),
+        ("ām", ""), ("am", ""), ("as", ""), ("ai", ""), ("ie", ""),
+        ("os", ""), ("us", ""), ("is", ""), ("es", ""), ("em", ""),
+        ("im", ""), ("u", ""), ("a", ""), ("e", ""), ("i", ""),
+        ("s", ""), ("š", "")]),
+    "et": _suffix_stemmer([
+        ("dele", ""), ("dest", ""), ("dega", ""), ("desse", ""),
+        ("tele", ""), ("test", ""), ("tega", ""), ("sse", ""),
+        ("st", ""), ("le", ""), ("lt", ""), ("ga", ""), ("ks", ""),
+        ("ni", ""), ("na", ""), ("de", ""), ("te", ""), ("id", ""),
+        ("s", ""), ("t", ""), ("d", ""), ("e", ""), ("a", ""),
+        ("i", ""), ("u", "")], min_stem=3),
+    "hi": _suffix_stemmer([
+        ("ियों", ""), ("ाओं", ""), ("ाएं", ""), ("ुओं", ""), ("ुएं", ""),
+        ("ों", ""), ("ें", ""), ("ीं", ""), ("ां", ""), ("ाँ", ""),
+        ("े", ""), ("ी", ""), ("ि", ""), ("ा", ""), ("ु", ""),
+        ("ू", ""), ("ो", "")], min_stem=2),
 }
+
+
+# ---------------------------------------------------------------------------
+# Arabic-script normalization + stemming (Lucene ArabicNormalizer/
+# ArabicStemmer light10 role; Persian variant normalizes to Farsi forms)
+# ---------------------------------------------------------------------------
+
+#: tashkeel (harakat) diacritics + tatweel stripped by normalization
+_AR_DIACRITICS = set("ًٌٍَُِّْ"
+                     "ـ")
+_AR_PREFIXES = ("وال", "بال", "كال", "فال", "لل", "ال")
+_AR_SUFFIXES = ("ها", "ان", "ات", "ون", "ين", "يه", "ية", "ه", "ة", "ي")
+
+
+def _normalize_ar(w: str) -> str:
+    """Arabic normalization: strip diacritics/tatweel, unify alef variants,
+    alef-maqsura -> ya, teh-marbuta -> ha."""
+    out = []
+    for ch in w:
+        if ch in _AR_DIACRITICS:
+            continue
+        if ch in "آأإ":   # آ أ إ -> ا
+            ch = "ا"
+        elif ch == "ى":             # ى -> ي
+            ch = "ي"
+        elif ch == "ة":             # ة -> ه
+            ch = "ه"
+        out.append(ch)
+    return "".join(out)
+
+
+def _stem_ar(w: str) -> str:
+    w = _normalize_ar(w)
+    for p in _AR_PREFIXES:
+        if w.startswith(p) and len(w) - len(p) >= 2:
+            w = w[len(p):]
+            break
+    for s in _AR_SUFFIXES:
+        if w.endswith(s) and len(w) - len(s) >= 2:
+            w = w[: -len(s)]
+            break
+    return w
+
+
+def _normalize_fa(w: str) -> str:
+    """Persian normalization: Arabic yeh/kaf -> Farsi forms, strip
+    diacritics, drop the ZWNJ joiner (plural 'ها' attaches with it)."""
+    out = []
+    for ch in w:
+        if ch in _AR_DIACRITICS or ch == "‌":   # ZWNJ
+            continue
+        if ch == "ي":               # ي -> ی
+            ch = "ی"
+        elif ch == "ك":             # ك -> ک
+            ch = "ک"
+        out.append(ch)
+    return "".join(out)
+
+
+_FA_SUFFIXES = ("هایی", "های", "ها", "ترین", "تر", "ات", "ان", "ام",
+                "اش", "ی")
+
+
+def _stem_fa(w: str) -> str:
+    w = _normalize_fa(w)
+    for s in _FA_SUFFIXES:
+        if w.endswith(s) and len(w) - len(s) >= 2:
+            w = w[: -len(s)]
+            break
+    return w
+
+
+_STEMMERS["ar"] = _stem_ar
+_STEMMERS["fa"] = _stem_fa
 
 STEMMED_LANGUAGES: Tuple[str, ...] = tuple(sorted(_STEMMERS))
 
