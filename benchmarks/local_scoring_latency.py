@@ -120,6 +120,13 @@ def main():
     assert p99 < 1.0, (
         f"scorer p99 {p99:.3f} ms breached the 1 ms serving bound "
         f"(env control p99 {env_p99:.3f} ms)")
+    # VERDICT r4 #10: gate the HONEST single-attempt tail too, not just the
+    # min-of-3 — a real serving regression must not hide behind the
+    # scheduler-noise rationale.  The raw bound allows the measured VM noise
+    # floor on top of the 1 ms serving budget (r4 advisor suggestion).
+    assert raw_p99 < 1.0 + env_p99, (
+        f"raw single-attempt p99 {raw_p99:.3f} ms breached the serving "
+        f"bound + measured scheduler noise floor ({env_p99:.3f} ms)")
 
 
 if __name__ == "__main__":
