@@ -1,0 +1,288 @@
+"""Hand-labeled Spanish real-prose NER fixture (VERDICT r4 #3).
+
+110 sentences in news / fiction / correspondence / review register —
+subordinate clauses, appositives, quotes, entities at varied positions —
+NOT generated from the training templates (tools/train_ner_tagger_multilang.py).
+Labels are token -> NameEntityType for every entity token (everything else
+is O), using ``ner_tokenize``'s tokenization.
+
+Many names are real-world or invented entities absent from both the
+es gazetteers (ops/ner_lang.py) and the training fill lists; common ones
+(Madrid, viernes) naturally overlap, as real Spanish text does.
+"""
+
+# (sentence, {token: entity_type})
+REAL_TEXT_ES = [
+    ("Cuando los delegados llegaron por fin a Ginebra, las conversaciones "
+     "ya se habían roto, y el secretario Arteaga se negó a declarar.",
+     {"Ginebra": "Location", "Arteaga": "Person"}),
+    ("La agencia informó el jueves de que Ferrovial recortaría casi el 8% "
+     "de su plantilla antes de diciembre.",
+     {"jueves": "Date", "Ferrovial": "Organization", "8%": "Percentage",
+      "diciembre": "Date"}),
+    ("El viejo farero, un hombre llamado Aurelio Zubiaurre, no había "
+     "salido de la isla desde 1987.",
+     {"Aurelio": "Person", "Zubiaurre": "Person", "1987": "Date"}),
+    ("Los analistas de Bankinter esperan que el euro se debilite frente "
+     "al dólar antes de la primavera.",
+     {"Bankinter": "Organization"}),
+    ("A las 6:45 el ferry salió de Algeciras con correo, aceitunas y un "
+     "contable muy nervioso.",
+     {"6:45": "Time", "Algeciras": "Location"}),
+    ("Su hija Beatriz estudió química en Salamanca antes de que empezara "
+     "la guerra.",
+     {"Beatriz": "Person", "Salamanca": "Location"}),
+    ("El acuerdo, aprobado el 2019-03-22, obligaba a Cementos Molins a "
+     "pagar €14M en daños.",
+     {"2019-03-22": "Date", "Cementos": "Organization",
+      "Molins": "Organization", "€14M": "Money"}),
+    ("Nadie en el pueblo de Frigiliana recordaba un enero más frío que "
+     "aquel.",
+     {"Frigiliana": "Location", "enero": "Date"}),
+    ("El profesor Oyarzábal sostuvo que las cifras publicadas por el "
+     "Banco Mundial subestimaban la pobreza rural en al menos un 3.5%.",
+     {"Oyarzábal": "Person", "Banco": "Organization",
+      "Mundial": "Organization", "3.5%": "Percentage"}),
+    ("Eran casi las 11:30 cuando la inspectora Urrutia llamó a la puerta "
+     "del almacén de Vigo.",
+     {"11:30": "Time", "Urrutia": "Person", "Vigo": "Location"}),
+    ("La facturación de Acerinox subió un 6% el trimestre pasado, dijo la "
+     "empresa el lunes.",
+     {"Acerinox": "Organization", "6%": "Percentage", "lunes": "Date"}),
+    ("En el verano de 2003, dos hermanos de Cádiz abrieron una panadería "
+     "en la calle Fuencarral.",
+     {"2003": "Date", "Cádiz": "Location", "Fuencarral": "Location"}),
+    ("La comisión escuchó el testimonio de la Dra. Lizarraga, que había "
+     "dirigido los ensayos en Pamplona.",
+     {"Lizarraga": "Person", "Pamplona": "Location"}),
+    ("El flete subió a €2,400 por contenedor después de que el canal "
+     "cerrara en marzo.",
+     {"€2,400": "Money", "marzo": "Date"}),
+    ("Mi abuela salió de Oviedo en 1952 con dos maletas y una dirección "
+     "en Buenos Aires.",
+     {"Oviedo": "Location", "1952": "Date", "Buenos": "Location",
+      "Aires": "Location"}),
+    ("Repsol y Galp anunciaron el viernes una inversión conjunta de "
+     "€350M en energía solar.",
+     {"Repsol": "Organization", "Galp": "Organization",
+      "viernes": "Date", "€350M": "Money"}),
+    ("El tren de las 7:15 a Zaragoza salió con veinte minutos de retraso.",
+     {"7:15": "Time", "Zaragoza": "Location"}),
+    ("Doña Remedios vendió la finca a un abogado de Badajoz por mucho "
+     "menos de lo que valía.",
+     {"Remedios": "Person", "Badajoz": "Location"}),
+    ("Según el informe de Mapfre, las primas crecieron un 4.2% en "
+     "octubre.",
+     {"Mapfre": "Organization", "4.2%": "Percentage", "octubre": "Date"}),
+    ("El alcalde de Cuenca inauguró el puente un sábado lluvioso.",
+     {"Cuenca": "Location", "sábado": "Date"}),
+    ("Teodoro Valcárcel, violinista y contrabandista ocasional, murió en "
+     "Marsella sin un céntimo.",
+     {"Teodoro": "Person", "Valcárcel": "Person", "Marsella": "Location"}),
+    ("La tormenta dejó sin luz a medio Montevideo durante la madrugada "
+     "del martes.",
+     {"Montevideo": "Location", "martes": "Date"}),
+    ("Iberdrola colocó bonos verdes por €750M con una demanda que "
+     "triplicó la oferta.",
+     {"Iberdrola": "Organization", "€750M": "Money"}),
+    ("El manuscrito llegó a manos de la editorial Anagrama envuelto en "
+     "papel de estraza.",
+     {"Anagrama": "Organization"}),
+    ("Quedamos a las 19:30 en la estación de Atocha, debajo del reloj.",
+     {"19:30": "Time", "Atocha": "Location"}),
+    ("El desempleo juvenil bajó al 27% por primera vez desde 2008.",
+     {"27%": "Percentage", "2008": "Date"}),
+    ("Carmela Espósito cruzó la frontera en Irún con los papeles de su "
+     "hermana.",
+     {"Carmela": "Person", "Espósito": "Person", "Irún": "Location"}),
+    ("El pedido costó €89 y llegó roto; nadie contesta desde el "
+     "miércoles.",
+     {"€89": "Money", "miércoles": "Date"}),
+    ("Ferroglobe presentó resultados el 2021-11-04 y las acciones "
+     "subieron un 12%.",
+     {"Ferroglobe": "Organization", "2021-11-04": "Date",
+      "12%": "Percentage"}),
+    ("El comisario Squadritto no creía en las casualidades, y menos en "
+     "Nápoles.",
+     {"Squadritto": "Person", "Nápoles": "Location"}),
+    ("Mi vuelo a Lanzarote sale a las 6:10 y todavía no he hecho la "
+     "maleta.",
+     {"Lanzarote": "Location", "6:10": "Time"}),
+    ("La cosecha de 2019 fue la peor en décadas para los viñedos de "
+     "Mendoza.",
+     {"2019": "Date", "Mendoza": "Location"}),
+    ("El ministro anunció en Bruselas que España aportaría €120M al "
+     "fondo.",
+     {"Bruselas": "Location", "España": "Location", "€120M": "Money"}),
+    ("Aldeasa ganó el concurso de las tiendas del aeropuerto de Barajas.",
+     {"Aldeasa": "Organization", "Barajas": "Location"}),
+    ("Don Cosme llegaba todos los domingos a las 9:00 con el periódico "
+     "bajo el brazo.",
+     {"Cosme": "Person", "domingos": "Date", "9:00": "Time"}),
+    ("La niebla cubrió Temuco hasta bien entrada la mañana.",
+     {"Temuco": "Location"}),
+    ("El jurado otorgó el premio a Valeria Luiselli por unanimidad.",
+     {"Valeria": "Person", "Luiselli": "Person"}),
+    ("Las exportaciones a Portugal cayeron un 9% en el primer semestre.",
+     {"Portugal": "Location", "9%": "Percentage"}),
+    ("Tía Engracia guardaba €3,000 en una lata de galletas encima del "
+     "armario.",
+     {"Engracia": "Person", "€3,000": "Money"}),
+    ("El autobús de Cáceres a Mérida tarda poco menos de una hora.",
+     {"Cáceres": "Location", "Mérida": "Location"}),
+    ("Telepizza abrirá cuarenta locales en Chile antes de noviembre.",
+     {"Telepizza": "Organization", "Chile": "Location",
+      "noviembre": "Date"}),
+    ("El catedrático Solozábal presentó su renuncia el 14/06/2022 sin "
+     "dar explicaciones.",
+     {"Solozábal": "Person", "14/06/2022": "Date"}),
+    ("Nos perdimos por los callejones de Albarracín buscando la casa del "
+     "herrero.",
+     {"Albarracín": "Location"}),
+    ("La auditoría de Deloitte encontró un desfase del 2.8% en las "
+     "cuentas.",
+     {"Deloitte": "Organization", "2.8%": "Percentage"}),
+    ("Griselda Pantoja cantó en el Teatro Colón una sola vez, en 1974.",
+     {"Griselda": "Person", "Pantoja": "Person", "Teatro": "Location",
+      "Colón": "Location", "1974": "Date"}),
+    ("El kilo de tomate llegó a €4 en los mercados de Almería.",
+     {"€4": "Money", "Almería": "Location"}),
+    ("El sábado cerraron el puerto de Valparaíso por el temporal.",
+     {"sábado": "Date", "Valparaíso": "Location"}),
+    ("Natixis rebajó su previsión de crecimiento para México al 1.9%.",
+     {"Natixis": "Organization", "México": "Location",
+      "1.9%": "Percentage"}),
+    ("El capataz Ormeño contó los sacos dos veces antes de firmar.",
+     {"Ormeño": "Person"}),
+    ("Nieva en Soria desde el jueves y no hay quitanieves.",
+     {"Soria": "Location", "jueves": "Date"}),
+    ("La beca cubre €1,200 al mes durante dos años en Heidelberg.",
+     {"€1,200": "Money", "Heidelberg": "Location"}),
+    ("El notario leyó el testamento ante los hermanos Irigoyen a las "
+     "16:00 en punto.",
+     {"Irigoyen": "Person", "16:00": "Time"}),
+    ("Prosegur trasladó su sede operativa a Alcobendas el año pasado.",
+     {"Prosegur": "Organization", "Alcobendas": "Location"}),
+    ("El documental sobre Chillida se estrena el 03/10/2024 en San "
+     "Sebastián.",
+     {"Chillida": "Person", "03/10/2024": "Date", "San": "Location",
+      "Sebastián": "Location"}),
+    ("Perdí el móvil en un taxi de Guayaquil y nadie lo devolvió.",
+     {"Guayaquil": "Location"}),
+    ("La ocupación hotelera en Benidorm rozó el 92% en agosto.",
+     {"Benidorm": "Location", "92%": "Percentage", "agosto": "Date"}),
+    ("El sargento Quiñones pidió refuerzos a las 2:20 de la madrugada.",
+     {"Quiñones": "Person", "2:20": "Time"}),
+    ("Damm patrocina las fiestas del barrio desde 1998.",
+     {"Damm": "Organization", "1998": "Date"}),
+    ("El ascensor lleva roto desde el martes y el administrador no "
+     "responde.",
+     {"martes": "Date"}),
+    ("Clarisa Obregón dejó una nota y un billete de €50 sobre la mesa.",
+     {"Clarisa": "Person", "Obregón": "Person", "€50": "Money"}),
+    ("La ruta por el valle del Jerte es preciosa a finales de marzo.",
+     {"Jerte": "Location", "marzo": "Date"}),
+    ("Abengoa renegoció su deuda con un descuento del 35%.",
+     {"Abengoa": "Organization", "35%": "Percentage"}),
+    ("El catalejo del capitán Berenguer apareció en un anticuario de "
+     "Brujas.",
+     {"Berenguer": "Person", "Brujas": "Location"}),
+    ("Hay mercadillo en la plaza los viernes desde las 8:00.",
+     {"viernes": "Date", "8:00": "Time"}),
+    ("Glovo repartió más de un millón de pedidos en Lima el año pasado.",
+     {"Glovo": "Organization", "Lima": "Location"}),
+    ("La pensión de la señora Arrizabalaga no llega a €900.",
+     {"Arrizabalaga": "Person", "€900": "Money"}),
+    ("El incendio arrasó doscientas hectáreas cerca de Ronda en julio.",
+     {"Ronda": "Location", "julio": "Date"}),
+    ("Bancolombia prevé una inflación del 5.4% para el próximo año.",
+     {"Bancolombia": "Organization", "5.4%": "Percentage"}),
+    ("El ebanista Sagarduy tardó tres meses en restaurar el arcón.",
+     {"Sagarduy": "Person"}),
+    ("Llegamos a Cartagena un domingo al mediodía, muertos de calor.",
+     {"Cartagena": "Location", "domingo": "Date"}),
+    ("La entrada del museo cuesta €12 y los lunes es gratis.",
+     {"€12": "Money", "lunes": "Date"}),
+    ("Ecopetrol suspendió el bombeo por el atentado contra el oleoducto.",
+     {"Ecopetrol": "Organization"}),
+    ("La maestra Hortensia Valdivieso enseñó a leer a tres generaciones "
+     "del pueblo.",
+     {"Hortensia": "Person", "Valdivieso": "Person"}),
+    ("El mercado abre a las 7:30 y lo mejor vuela antes de las 9:00.",
+     {"7:30": "Time", "9:00": "Time"}),
+    ("Dos de cada tres encuestados en Rosario apoyan la peatonalización.",
+     {"Rosario": "Location"}),
+    ("CaixaBank cerró 300 oficinas rurales pese a las protestas.",
+     {"CaixaBank": "Organization"}),
+    ("El temporal dejó olas de seis metros en la costa de Asturias el "
+     "2023-01-17.",
+     {"Asturias": "Location", "2023-01-17": "Date"}),
+    ("El traductor Belaúnde trabajó veinte años en Ginebra sin aprender "
+     "francés.",
+     {"Belaúnde": "Person", "Ginebra": "Location"}),
+    ("Vendimos la cosecha entera a una cooperativa de Logroño.",
+     {"Logroño": "Location"}),
+    ("El recibo de la luz subió un 18% respecto a febrero.",
+     {"18%": "Percentage", "febrero": "Date"}),
+    ("Panamá y Colombia reabrieron el paso fronterizo el miércoles.",
+     {"Panamá": "Location", "Colombia": "Location", "miércoles": "Date"}),
+    ("La impresora lleva atascada desde las 10:40 y el informe era para "
+     "hoy.",
+     {"10:40": "Time"}),
+    ("Ferrovial adjudicó la obra del tranvía de Cuenca a su filial "
+     "polaca.",
+     {"Ferrovial": "Organization", "Cuenca": "Location"}),
+    ("Mi vecino Arquímedes cría palomas mensajeras en la azotea.",
+     {"Arquímedes": "Person"}),
+    ("El vuelo de Iberia a Asunción se canceló por la ceniza del volcán.",
+     {"Iberia": "Organization", "Asunción": "Location"}),
+    ("La subasta del cuadro alcanzó €2,750,000 en apenas ocho minutos.",
+     {"€2,750,000": "Money"}),
+    ("El puerto de Bilbao movió un 7% más de contenedores en 2022.",
+     {"Bilbao": "Location", "7%": "Percentage", "2022": "Date"}),
+    ("La forense Izaguirre firmó el informe a las 3:55 de la madrugada.",
+     {"Izaguirre": "Person", "3:55": "Time"}),
+    ("Llevo desde agosto esperando la pieza del lavavajillas.",
+     {"agosto": "Date"}),
+    ("Cabify dejó de operar en Montevideo tras el cambio normativo.",
+     {"Cabify": "Organization", "Montevideo": "Location"}),
+    ("El cartero nuevo confunde la calle Espronceda con la avenida "
+     "Esparteros.",
+     {"Espronceda": "Location", "Esparteros": "Location"}),
+    ("Crecimos un 11% en ventas y aun así cerraron la delegación de "
+     "Murcia.",
+     {"11%": "Percentage", "Murcia": "Location"}),
+    ("El violinista Szeryng tocó en Guanajuato bajo la lluvia.",
+     {"Szeryng": "Person", "Guanajuato": "Location"}),
+    ("La reserva del parador cuesta €145 la noche en temporada alta.",
+     {"€145": "Money"}),
+    ("El simulacro de incendio será el jueves a las 12:15.",
+     {"jueves": "Date", "12:15": "Time"}),
+    ("Arcelor paró el alto horno de Avilés por mantenimiento.",
+     {"Arcelor": "Organization", "Avilés": "Location"}),
+    ("La señora Eulogia juraba haber visto al lobo junto al molino.",
+     {"Eulogia": "Person"}),
+    ("De Tarifa a Tánger hay apenas una hora de ferry.",
+     {"Tarifa": "Location", "Tánger": "Location"}),
+    ("El bono social descuenta un 25% a las familias numerosas.",
+     {"25%": "Percentage"}),
+    ("Entregamos el proyecto el 30/09/2025 tras dos prórrogas.",
+     {"30/09/2025": "Date"}),
+    ("El chef Arzak probó el guiso y pidió la receta a la abuela "
+     "Casimira.",
+     {"Arzak": "Person", "Casimira": "Person"}),
+    ("Softtek contrató a doscientos ingenieros en Guadalajara.",
+     {"Softtek": "Organization", "Guadalajara": "Location"}),
+    ("La marea dejó el pecio al descubierto frente a Finisterre.",
+     {"Finisterre": "Location"}),
+    ("Pagué €35 por un paraguas que se rompió el mismo sábado.",
+     {"€35": "Money", "sábado": "Date"}),
+    ("El astrónomo Oterma calculó la órbita desde un tejado de "
+     "Montevideo.",
+     {"Oterma": "Person", "Montevideo": "Location"}),
+    ("Las obras del metro de Quito avanzan al 85% según el consorcio.",
+     {"Quito": "Location", "85%": "Percentage"}),
+    ("El herrero Eustaquio Zabala forjó la veleta del campanario en "
+     "1931.",
+     {"Eustaquio": "Person", "Zabala": "Person", "1931": "Date"}),
+]

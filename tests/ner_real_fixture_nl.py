@@ -1,0 +1,291 @@
+"""Hand-labeled Dutch real-prose NER fixture (VERDICT r4 #3).
+
+105 sentences in news / fiction / correspondence / review register — NOT
+generated from the training templates.  Labels are token ->
+NameEntityType for every entity token (everything else is O), using
+``ner_tokenize``'s tokenization.
+
+Many names are real-world or invented entities absent from both the nl
+gazetteers (ops/ner_lang.py) and the training fill lists; common ones
+(Amsterdam, vrijdag) naturally overlap, as real Dutch text does.
+"""
+
+# (sentence, {token: entity_type})
+REAL_TEXT_NL = [
+    ("Toen de delegatie eindelijk Genève bereikte, waren de "
+     "onderhandelingen al mislukt, en secretaris Terlouw weigerde "
+     "commentaar.",
+     {"Genève": "Location", "Terlouw": "Person"}),
+    ("Het persbureau meldde donderdag dat Arcadis bijna 8% van zijn "
+     "personeel zou schrappen voor december.",
+     {"donderdag": "Date", "Arcadis": "Organization", "8%": "Percentage",
+      "december": "Date"}),
+    ("De oude vuurtorenwachter, een man genaamd Sible Terpstra, had het "
+     "eiland sinds 1987 niet verlaten.",
+     {"Sible": "Person", "Terpstra": "Person", "1987": "Date"}),
+    ("Analisten van Rabobank verwachten dat de euro verzwakt tegenover "
+     "de dollar voor de lente.",
+     {"Rabobank": "Organization"}),
+    ("Om 6:45 vertrok de veerboot uit Harlingen met post, kaas en één "
+     "zeer nerveuze boekhouder.",
+     {"6:45": "Time", "Harlingen": "Location"}),
+    ("Hun dochter Margriet studeerde scheikunde in Wageningen voordat de "
+     "oorlog uitbrak.",
+     {"Margriet": "Person", "Wageningen": "Location"}),
+    ("De schikking, goedgekeurd op 2019-03-22, verplichtte Koninklijke "
+     "Volker tot €14M aan schadevergoeding.",
+     {"2019-03-22": "Date", "Koninklijke": "Organization",
+      "Volker": "Organization", "€14M": "Money"}),
+    ("Niemand in het dorp Giethoorn herinnerde zich een koudere januari "
+     "dan die.",
+     {"Giethoorn": "Location", "januari": "Date"}),
+    ("Professor Wiarda betoogde dat de cijfers van de Wereldbank de "
+     "armoede met minstens 3.5% onderschatten.",
+     {"Wiarda": "Person", "Wereldbank": "Organization",
+      "3.5%": "Percentage"}),
+    ("Het was bijna 11:30 toen inspecteur Vandecasteele aanklopte bij "
+     "het pakhuis in Vlissingen.",
+     {"11:30": "Time", "Vandecasteele": "Person",
+      "Vlissingen": "Location"}),
+    ("De omzet van Vopak steeg vorig kwartaal met 6%, zei het bedrijf "
+     "maandag.",
+     {"Vopak": "Organization", "6%": "Percentage", "maandag": "Date"}),
+    ("In de zomer van 2003 openden twee broers uit Zaandam een bakkerij "
+     "aan de Vijzelstraat.",
+     {"2003": "Date", "Zaandam": "Location", "Vijzelstraat": "Location"}),
+    ("De commissie hoorde de getuigenis van dr. Lindqvist, die de "
+     "proeven in Leiden had geleid.",
+     {"Lindqvist": "Person", "Leiden": "Location"}),
+    ("De vrachtkosten stegen tot €2,400 per container nadat het kanaal "
+     "in maart sloot.",
+     {"€2,400": "Money", "maart": "Date"}),
+    ("Mijn grootmoeder vertrok in 1952 uit Dokkum met twee koffers en "
+     "een adres in Paramaribo.",
+     {"1952": "Date", "Dokkum": "Location", "Paramaribo": "Location"}),
+    ("Heineken en Grolsch kondigden vrijdag een gezamenlijke investering "
+     "van €350M aan.",
+     {"Heineken": "Organization", "Grolsch": "Organization",
+      "vrijdag": "Date", "€350M": "Money"}),
+    ("De trein van 7:15 naar Roosendaal vertrok met twintig minuten "
+     "vertraging.",
+     {"7:15": "Time", "Roosendaal": "Location"}),
+    ("Mevrouw Schimmelpenninck verkocht de boerderij aan een advocaat "
+     "uit Assen voor veel te weinig.",
+     {"Schimmelpenninck": "Person", "Assen": "Location"}),
+    ("Volgens het rapport van Aegon groeiden de premies met 4.2% in "
+     "oktober.",
+     {"Aegon": "Organization", "4.2%": "Percentage", "oktober": "Date"}),
+    ("De burgemeester van Kampen opende de brug op een regenachtige "
+     "zaterdag.",
+     {"Kampen": "Location", "zaterdag": "Date"}),
+    ("Thijmen Bronkhorst, violist en af en toe smokkelaar, stierf "
+     "berooid in Marseille.",
+     {"Thijmen": "Person", "Bronkhorst": "Person",
+      "Marseille": "Location"}),
+    ("De storm legde half Oostende plat in de nacht van dinsdag.",
+     {"Oostende": "Location", "dinsdag": "Date"}),
+    ("ASML plaatste groene obligaties voor €750M met een vraag die het "
+     "aanbod verdrievoudigde.",
+     {"ASML": "Organization", "€750M": "Money"}),
+    ("Het manuscript belandde bij uitgeverij Querido, verpakt in bruin "
+     "papier.",
+     {"Querido": "Organization"}),
+    ("We spreken af om 19:30 op station Amersfoort, onder de klok.",
+     {"19:30": "Time", "Amersfoort": "Location"}),
+    ("De jeugdwerkloosheid daalde voor het eerst sinds 2008 tot onder "
+     "de 27%.",
+     {"2008": "Date", "27%": "Percentage"}),
+    ("Hannelore Vercruysse stak de grens over bij Wuustwezel met de "
+     "papieren van haar zus.",
+     {"Hannelore": "Person", "Vercruysse": "Person",
+      "Wuustwezel": "Location"}),
+    ("De bestelling kostte €89 en kwam kapot aan; niemand reageert "
+     "sinds woensdag.",
+     {"€89": "Money", "woensdag": "Date"}),
+    ("Fugro presenteerde cijfers op 2021-11-04 en het aandeel steeg "
+     "12%.",
+     {"Fugro": "Organization", "2021-11-04": "Date", "12%": "Percentage"}),
+    ("Commissaris Scarpetta geloofde niet in toeval, zeker niet in "
+     "Napels.",
+     {"Scarpetta": "Person", "Napels": "Location"}),
+    ("Mijn vlucht naar Kreta vertrekt om 6:10 en ik heb nog niet "
+     "gepakt.",
+     {"Kreta": "Location", "6:10": "Time"}),
+    ("De oogst van 2019 was de slechtste in decennia voor de telers in "
+     "de Betuwe.",
+     {"2019": "Date", "Betuwe": "Location"}),
+    ("De minister kondigde in Brussel aan dat Nederland €120M aan het "
+     "fonds zou bijdragen.",
+     {"Brussel": "Location", "Nederland": "Location", "€120M": "Money"}),
+    ("Meneer Koopmans kwam elke zondag om 9:00 met de krant onder zijn "
+     "arm.",
+     {"Koopmans": "Person", "zondag": "Date", "9:00": "Time"}),
+    ("De mist hing tot laat in de ochtend boven Sneek.",
+     {"Sneek": "Location"}),
+    ("De jury kende de prijs unaniem toe aan Marieke Rijneveld.",
+     {"Marieke": "Person", "Rijneveld": "Person"}),
+    ("De export naar Portugal daalde 9% in het eerste halfjaar.",
+     {"Portugal": "Location", "9%": "Percentage"}),
+    ("Tante Aaltje bewaarde €3,000 in een koektrommel boven op de kast.",
+     {"Aaltje": "Person", "€3,000": "Money"}),
+    ("De bus van Goes naar Middelburg doet er nog geen uur over.",
+     {"Goes": "Location", "Middelburg": "Location"}),
+    ("Jumbo opent veertig filialen in Vlaanderen voor november.",
+     {"Jumbo": "Organization", "Vlaanderen": "Location",
+      "november": "Date"}),
+    ("Hoogleraar Buitendijk diende op 14/06/2022 zijn ontslag in zonder "
+     "toelichting.",
+     {"Buitendijk": "Person", "14/06/2022": "Date"}),
+    ("We verdwaalden in de steegjes van Brugge op zoek naar het huis "
+     "van de smid.",
+     {"Brugge": "Location"}),
+    ("De audit van KPMG vond een gat van 2.8% in de boeken.",
+     {"KPMG": "Organization", "2.8%": "Percentage"}),
+    ("Geertruida Boomsma zong één keer in het Concertgebouw, in 1974.",
+     {"Geertruida": "Person", "Boomsma": "Person",
+      "Concertgebouw": "Location", "1974": "Date"}),
+    ("Een kilo tomaten kostte €4 op de markt van Venlo.",
+     {"€4": "Money", "Venlo": "Location"}),
+    ("Zaterdag sloten ze de haven van Delfzijl wegens de storm.",
+     {"Zaterdag": "Date", "Delfzijl": "Location"}),
+    ("ING verlaagde zijn groeiprognose voor België naar 1.9%.",
+     {"ING": "Organization", "België": "Location", "1.9%": "Percentage"}),
+    ("Voorman Schreuder telde de zakken twee keer voordat hij tekende.",
+     {"Schreuder": "Person"}),
+    ("Het sneeuwt sinds donderdag in Drenthe en er is geen strooiwagen "
+     "te zien.",
+     {"donderdag": "Date", "Drenthe": "Location"}),
+    ("De beurs dekt €1,200 per maand gedurende twee jaar in Uppsala.",
+     {"€1,200": "Money", "Uppsala": "Location"}),
+    ("De notaris las het testament voor aan de gebroeders Wttewaall om "
+     "precies 16:00.",
+     {"Wttewaall": "Person", "16:00": "Time"}),
+    ("PostNL verhuisde zijn sorteercentrum vorig jaar naar Nieuwegein.",
+     {"PostNL": "Organization", "Nieuwegein": "Location"}),
+    ("De documentaire over Appel gaat op 03/10/2024 in première in "
+     "Rotterdam.",
+     {"Appel": "Person", "03/10/2024": "Date", "Rotterdam": "Location"}),
+    ("Ik verloor mijn telefoon in een taxi in Luik en niemand bracht "
+     "hem terug.",
+     {"Luik": "Location"}),
+    ("De hotelbezetting in Zandvoort haalde 92% in augustus.",
+     {"Zandvoort": "Location", "92%": "Percentage", "augustus": "Date"}),
+    ("Sergeant Duyvestein vroeg om 2:20 's nachts om versterking.",
+     {"Duyvestein": "Person", "2:20": "Time"}),
+    ("Bavaria sponsort het dorpsfeest al sinds 1998.",
+     {"Bavaria": "Organization", "1998": "Date"}),
+    ("De lift is al sinds dinsdag kapot en de beheerder reageert niet.",
+     {"dinsdag": "Date"}),
+    ("Liesbeth Overmars liet een briefje en €50 achter op de tafel.",
+     {"Liesbeth": "Person", "Overmars": "Person", "€50": "Money"}),
+    ("De wandelroute door de Ardennen is prachtig eind maart.",
+     {"Ardennen": "Location", "maart": "Date"}),
+    ("Ballast Nedam herfinancierde zijn schuld met een korting van 35%.",
+     {"Ballast": "Organization", "Nedam": "Organization",
+      "35%": "Percentage"}),
+    ("De verrekijker van kapitein Terhorst dook op bij een antiquair in "
+     "Gent.",
+     {"Terhorst": "Person", "Gent": "Location"}),
+    ("Er is vrijdags markt op het plein vanaf 8:00.", {"8:00": "Time"}),
+    ("Picnic bezorgde vorig jaar meer dan een miljoen bestellingen in "
+     "Utrecht.",
+     {"Picnic": "Organization", "Utrecht": "Location"}),
+    ("Het pensioen van mevrouw Zonneveld komt niet boven de €900 uit.",
+     {"Zonneveld": "Person", "€900": "Money"}),
+    ("De brand verwoestte in juli tweehonderd hectare bij Ommen.",
+     {"juli": "Date", "Ommen": "Location"}),
+    ("KBC rekent voor volgend jaar op een inflatie van 5.4%.",
+     {"KBC": "Organization", "5.4%": "Percentage"}),
+    ("Meubelmaker Steenbergen deed drie maanden over de restauratie "
+     "van de kist.",
+     {"Steenbergen": "Person"}),
+    ("We kwamen op een zondagmiddag aan in Maastricht, bezweet en moe.",
+     {"Maastricht": "Location"}),
+    ("De entree van het museum kost €12 en op maandag is het gratis.",
+     {"€12": "Money", "maandag": "Date"}),
+    ("Gasunie legde de compressor stil na de lekkage bij het station.",
+     {"Gasunie": "Organization"}),
+    ("Juf Hendrika Feenstra leerde drie generaties van het dorp lezen.",
+     {"Hendrika": "Person", "Feenstra": "Person"}),
+    ("De markt opent om 7:30 en het beste is voor 9:00 al weg.",
+     {"7:30": "Time", "9:00": "Time"}),
+    ("Twee op de drie ondervraagden in Leeuwarden steunen het "
+     "autovrije plan.",
+     {"Leeuwarden": "Location"}),
+    ("ABN sloot driehonderd plattelandskantoren ondanks de protesten.",
+     {"ABN": "Organization"}),
+    ("De storm joeg op 2023-01-17 golven van zes meter op de kust van "
+     "Zeeland.",
+     {"2023-01-17": "Date", "Zeeland": "Location"}),
+    ("Vertaler Hoornweg werkte twintig jaar in Genève zonder Frans te "
+     "leren.",
+     {"Hoornweg": "Person", "Genève": "Location"}),
+    ("We verkochten de hele oogst aan een coöperatie uit Emmeloord.",
+     {"Emmeloord": "Location"}),
+    ("De energierekening steeg met 18% ten opzichte van februari.",
+     {"18%": "Percentage", "februari": "Date"}),
+    ("Nederland en Denemarken heropenden woensdag de veerverbinding.",
+     {"Nederland": "Location", "Denemarken": "Location",
+      "woensdag": "Date"}),
+    ("De printer staat sinds 10:40 vast en het rapport moest vandaag "
+     "af.",
+     {"10:40": "Time"}),
+    ("BAM gunde de tramwerken van Kortrijk aan zijn Waalse "
+     "dochterbedrijf.",
+     {"BAM": "Organization", "Kortrijk": "Location"}),
+    ("Mijn buurman Evert houdt postduiven op het dak.",
+     {"Evert": "Person"}),
+    ("De vlucht van KLM naar Willemstad werd geannuleerd wegens "
+     "vulkaanas.",
+     {"KLM": "Organization", "Willemstad": "Location"}),
+    ("De veiling van het schilderij haalde €2,750,000 in amper acht "
+     "minuten.",
+     {"€2,750,000": "Money"}),
+    ("De haven van Antwerpen verwerkte in 2022 7% meer containers.",
+     {"Antwerpen": "Location", "2022": "Date", "7%": "Percentage"}),
+    ("Patholoog Westerhof tekende het rapport om 3:55 's nachts.",
+     {"Westerhof": "Person", "3:55": "Time"}),
+    ("Ik wacht al sinds augustus op het onderdeel voor de vaatwasser.",
+     {"augustus": "Date"}),
+    ("Coolblue stopte met bezorgen in Charleroi na de nieuwe regels.",
+     {"Coolblue": "Organization", "Charleroi": "Location"}),
+    ("De nieuwe postbode haalt de Vermeerstraat en de Vondelstraat "
+     "door elkaar.",
+     {"Vermeerstraat": "Location", "Vondelstraat": "Location"}),
+    ("We groeiden 11% in omzet en toch sloten ze de vestiging in "
+     "Tilburg.",
+     {"11%": "Percentage", "Tilburg": "Location"}),
+    ("Violist Szeryng speelde in Scheveningen in de stromende regen.",
+     {"Szeryng": "Person", "Scheveningen": "Location"}),
+    ("Een overnachting in het landhuis kost €145 in het hoogseizoen.",
+     {"€145": "Money"}),
+    ("De brandoefening is donderdag om 12:15.",
+     {"donderdag": "Date", "12:15": "Time"}),
+    ("Tata legde de hoogoven van Velsen stil voor onderhoud.",
+     {"Tata": "Organization", "Velsen": "Location"}),
+    ("Oude mevrouw Geertje zwoer dat ze de wolf bij de molen had "
+     "gezien.",
+     {"Geertje": "Person"}),
+    ("Van Vlieland naar Terschelling is het maar een uur varen.",
+     {"Vlieland": "Location", "Terschelling": "Location"}),
+    ("Het sociale tarief geeft grote gezinnen 25% korting.",
+     {"25%": "Percentage"}),
+    ("We leverden het project op 30/09/2025 op, na twee keer uitstel.",
+     {"30/09/2025": "Date"}),
+    ("Chef Boerma proefde de stoofpot en vroeg oma Aleida om het "
+     "recept.",
+     {"Boerma": "Person", "Aleida": "Person"}),
+    ("Exact nam tweehonderd ingenieurs aan in Delft.",
+     {"Exact": "Organization", "Delft": "Location"}),
+    ("Het wrak kwam bij eb bloot te liggen voor de kust van Urk.",
+     {"Urk": "Location"}),
+    ("Ik betaalde €35 voor een paraplu die dezelfde zaterdag al "
+     "kapot was.",
+     {"€35": "Money", "zaterdag": "Date"}),
+    ("De metrowerken in Brussel zijn volgens het consortium voor 85% "
+     "klaar.",
+     {"Brussel": "Location", "85%": "Percentage"}),
+    ("Smid Harmen Bijlsma smeedde de windwijzer van de kerktoren in "
+     "1931.",
+     {"Harmen": "Person", "Bijlsma": "Person", "1931": "Date"}),
+]

@@ -1,0 +1,117 @@
+"""Multilingual NER (VERDICT r4 #3): Spanish + Dutch taggers with
+per-language real-prose fixtures and language dispatch.
+
+Reference: OpenNLPModels.scala:48-70 ships en + es + nl NER binaries keyed
+by (language, entity type); NameEntityRecognizer here dispatches the same
+way — per-language averaged-perceptron artifacts selected by detected (or
+pinned) language.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from ner_real_fixture_es import REAL_TEXT_ES  # noqa: E402
+from ner_real_fixture_nl import REAL_TEXT_NL  # noqa: E402
+
+from transmogrifai_tpu.ops.ner import NameEntityRecognizer, ner_tokenize
+from transmogrifai_tpu.ops.ner_model import (artifact_path_for,
+                                             load_pretrained)
+
+FIXTURES = {"es": REAL_TEXT_ES, "nl": REAL_TEXT_NL}
+
+
+def _score(fixture, tag_fn):
+    tp = fp = fn = 0
+    for sent, gold in fixture:
+        pred = tag_fn(sent)
+        gold_pairs = {(t, e) for t, e in gold.items()}
+        pred_pairs = {(t, e) for t, ents in pred.items() for e in ents
+                      if e != "Misc"}
+        tp += len(gold_pairs & pred_pairs)
+        fp += len(pred_pairs - gold_pairs)
+        fn += len(gold_pairs - pred_pairs)
+    p = tp / max(tp + fp, 1)
+    r = tp / max(tp + fn, 1)
+    return p, r, 2 * p * r / max(p + r, 1e-9)
+
+
+class TestPerLanguageTaggers:
+    @pytest.mark.parametrize("lang", ["es", "nl"])
+    def test_artifact_ships(self, lang):
+        assert os.path.exists(artifact_path_for(lang))
+        tagger = load_pretrained(language=lang)
+        assert tagger is not None and tagger.language == lang
+
+    @pytest.mark.parametrize("lang", ["es", "nl"])
+    def test_real_prose_f1(self, lang):
+        """F1 >= 0.75 on >=100 hand-labeled real-prose sentences per
+        language (VERDICT r4 #3 Done criterion)."""
+        fixture = FIXTURES[lang]
+        assert len(fixture) >= 100
+        tagger = load_pretrained(language=lang)
+        p, r, f1 = _score(
+            fixture, lambda s: tagger.tag_to_entities(ner_tokenize(s)))
+        assert f1 >= 0.75, f"{lang}: F1 {f1:.3f} (P {p:.3f} R {r:.3f})"
+
+    @pytest.mark.parametrize("lang", ["es", "nl"])
+    def test_beats_english_tagger_on_own_language(self, lang):
+        """The per-language model must beat the English artifact on its
+        own fixture — the reason the reference ships es/nl models at all."""
+        fixture = FIXTURES[lang]
+        own = load_pretrained(language=lang)
+        en = load_pretrained(language="en")
+        _, _, f1_own = _score(
+            fixture, lambda s: own.tag_to_entities(ner_tokenize(s)))
+        _, _, f1_en = _score(
+            fixture, lambda s: en.tag_to_entities(ner_tokenize(s)))
+        assert f1_own > f1_en, (lang, f1_own, f1_en)
+
+
+class TestLanguageDispatch:
+    def test_auto_detects_and_tags(self):
+        from transmogrifai_tpu import Dataset, FeatureBuilder
+        from transmogrifai_tpu.data.dataset import Column
+        from transmogrifai_tpu.types import Text
+
+        texts = [
+            # Spanish: entity absent from every gazetteer, caught by the
+            # es model's honorific/context features
+            "La Sra. Irastorza llegó a Valparaíso el viernes por la tarde.",
+            # Dutch
+            "Mevr. Duyvestein bezocht Leeuwarden op woensdag.",
+            # English
+            "Mrs. Whitcombe arrived in Plymouth on Friday.",
+        ]
+        ds = Dataset({"t": Column.from_values(Text, texts)})
+        f = FeatureBuilder.of("t", Text).extract_field().as_predictor()
+        stage = NameEntityRecognizer()
+        stage.set_input(f)
+        out = stage.transform(ds)[stage.output_name].to_values()
+        assert "Person" in out[0].get("Irastorza", []), out[0]
+        assert "Location" in out[0].get("Valparaíso", []), out[0]
+        assert "Person" in out[1].get("Duyvestein", []), out[1]
+        assert "Location" in out[1].get("Leeuwarden", []), out[1]
+        assert "Person" in out[2].get("Whitcombe", []), out[2]
+
+    def test_pinned_language_overrides_detection(self):
+        from transmogrifai_tpu import Dataset, FeatureBuilder
+        from transmogrifai_tpu.data.dataset import Column
+        from transmogrifai_tpu.types import Text
+
+        ds = Dataset({"t": Column.from_values(
+            Text, ["El Sr. Ormaechea trabaja en Bilbao."])})
+        f = FeatureBuilder.of("t", Text).extract_field().as_predictor()
+        stage = NameEntityRecognizer(language="es")
+        stage.set_input(f)
+        out = stage.transform(ds)[stage.output_name].to_values()
+        assert "Person" in out[0].get("Ormaechea", []), out[0]
+
+    def test_unknown_language_falls_back_to_english(self):
+        stage = NameEntityRecognizer(language="auto")
+        # Finnish has no per-language tagger -> English artifact used
+        assert stage._resolve_language(
+            "nopea kettu hyppää aidan yli joka aamu") == "en"

@@ -206,6 +206,12 @@ class RuleNameEntityTagger:
         return tags
 
 
+def _tagger_languages():
+    from .ner_lang import TAGGER_LANGUAGES
+
+    return TAGGER_LANGUAGES
+
+
 class NameEntityRecognizer(UnaryTransformer):
     """Text -> MultiPickListMap of token -> entity types (NameEntityRecognizer.scala).
 
@@ -213,11 +219,18 @@ class NameEntityRecognizer(UnaryTransformer):
     mirroring the reference's sentence-wise tagging + foldLeft merge.
 
     Two tagger backends (the reference's OpenNLPNameEntityTagger role):
-    - ``"learned"`` (default): the shipped averaged-perceptron model
-      (ops/ner_model.py, artifact trained by tools/train_ner_tagger.py) —
+    - ``"learned"`` (default): a shipped averaged-perceptron model
+      (ops/ner_model.py, artifacts trained by tools/train_ner_tagger*.py) —
       generalizes to unseen names via shape/context features;
     - ``"rules"``: the deterministic rule + gazetteer tagger above.
     Falls back to rules if the learned artifact is absent.
+
+    ``language`` selects the per-language model the way the reference's
+    OpenNLPModels maps (language, entity) -> binary artifact
+    (OpenNLPModels.scala:48-70 ships en + es + nl NER): ``"auto"``
+    (default) detects per input text and dispatches to the es/nl taggers
+    when their artifacts are present, else English; an explicit code pins
+    the tagger.
     """
 
     input_types = (Text,)
@@ -225,32 +238,57 @@ class NameEntityRecognizer(UnaryTransformer):
 
     tagger = Param(default="learned",
                    validator=lambda v: v in ("learned", "rules"))
+    language = Param(
+        default="auto",
+        validator=lambda v: v == "auto" or v in _tagger_languages())
 
-    def _sentence_tagger(self):
+    def _tagger_for(self, language: str):
+        """Sentence tagger for one language (None = no learned artifact)."""
         if self.tagger == "learned":
             from .ner_model import load_pretrained
 
-            learned = load_pretrained()
+            learned = load_pretrained(language=language)
             if learned is not None:
-                return lambda sent: learned.tag_to_entities(ner_tokenize(sent))
+                return lambda sent: learned.tag_to_entities(
+                    ner_tokenize(sent))
+            if language != "en":  # missing per-language artifact -> English
+                return self._tagger_for("en")
         rules = RuleNameEntityTagger()
         return rules.tag
 
+    def _resolve_language(self, text: str) -> str:
+        """Detected language when it has a shipped tagger, else English."""
+        if self.language != "auto":
+            return str(self.language)
+        from .ner_lang import TAGGER_LANGUAGES
+
+        from ..utils.lang import detect_language
+
+        lang = detect_language(text)
+        return lang if lang in TAGGER_LANGUAGES else "en"
+
+    def _tag_text(self, text: str, taggers: Dict[str, object]
+                  ) -> Dict[str, Set[str]]:
+        lang = self._resolve_language(text or "")
+        tag = taggers.get(lang)
+        if tag is None:
+            tag = taggers[lang] = self._tagger_for(lang)
+        merged: Dict[str, Set[str]] = {}
+        # per-language abbreviation sets: the reference's per-language
+        # OpenNLP sentence models play the same role
+        for sent in split_sentences(text or "", language=lang):
+            for tok, ents in tag(sent).items():
+                merged.setdefault(tok, set()).update(ents)
+        return merged
+
     def transform_columns(self, cols: List[Column], dataset) -> Column:
-        tag = self._sentence_tagger()
+        taggers: Dict[str, object] = {}
         out = np.empty(len(cols[0]), dtype=object)
         for i, text in enumerate(cols[0].data):
-            merged: Dict[str, Set[str]] = {}
-            for sent in split_sentences(text or ""):
-                for tok, ents in tag(sent).items():
-                    merged.setdefault(tok, set()).update(ents)
-            out[i] = {k: sorted(v) for k, v in merged.items()}
+            out[i] = {k: sorted(v)
+                      for k, v in self._tag_text(text, taggers).items()}
         return Column(MultiPickListMap, out)
 
     def transform_values(self, values):
-        tag = self._sentence_tagger()
-        merged: Dict[str, Set[str]] = {}
-        for sent in split_sentences(values[0] or ""):
-            for tok, ents in tag(sent).items():
-                merged.setdefault(tok, set()).update(ents)
-        return {k: sorted(v) for k, v in merged.items()}
+        return {k: sorted(v)
+                for k, v in self._tag_text(values[0], {}).items()}

@@ -110,8 +110,21 @@ def _dictionary_feats(low: str) -> List[str]:
     return feats
 
 
-def token_features(tokens: Sequence[str], i: int, prev_tag: str) -> List[str]:
-    """Feature strings for token i (shared by training and inference)."""
+def token_features(tokens: Sequence[str], i: int, prev_tag: str,
+                   language: str = "en") -> List[str]:
+    """Feature strings for token i (shared by training and inference).
+
+    ``language`` swaps ONLY the dictionary layer (per-language gazetteers,
+    ops/ner_lang.py); the shape/affix/context features are language-neutral.
+    ``"en"`` keeps the exact historical feature stream — the shipped en
+    artifact depends on it."""
+    if language != "en":
+        from .ner_lang import dictionary_feats as _dict_lang
+
+        def dict_feats(lw):
+            return _dict_lang(lw, language)
+    else:
+        dict_feats = _dictionary_feats
     w = tokens[i]
     low = w.lower()
     prev = tokens[i - 1] if i > 0 else "<s>"
@@ -134,10 +147,10 @@ def token_features(tokens: Sequence[str], i: int, prev_tag: str) -> List[str]:
         f"w+next={low}|{nxt_low}",
         f"prev+w={prev_low}|{low}",
     ]
-    feats.extend(_dictionary_feats(low))
-    for df in _dictionary_feats(prev_low):
+    feats.extend(dict_feats(low))
+    for df in dict_feats(prev_low):
         feats.append(f"prev{df}")
-    for df in _dictionary_feats(nxt_low):
+    for df in dict_feats(nxt_low):
         feats.append(f"next{df}")
     if i == 0:
         feats.append("bos")
@@ -178,18 +191,20 @@ class PerceptronNameEntityTagger:
     NameEntityRecognizer output shape.
     """
 
-    def __init__(self, weights: np.ndarray):
+    def __init__(self, weights: np.ndarray, language: str = "en"):
         if weights.shape != (NUM_BUCKETS, len(TAG_SET)):
             raise ValueError(
                 f"NER weights must be {(NUM_BUCKETS, len(TAG_SET))}, "
                 f"got {weights.shape}")
         self.weights = weights.astype(np.float32)
+        self.language = language
 
     def tag(self, tokens: Sequence[str]) -> List[str]:
         prev_tag = "O"
         out = []
         for i in range(len(tokens)):
-            idx = hash_features(token_features(tokens, i, prev_tag))
+            idx = hash_features(token_features(tokens, i, prev_tag,
+                                               self.language))
             scores = self.weights[idx].sum(axis=0)
             prev_tag = TAG_SET[int(scores.argmax())]
             out.append(prev_tag)
@@ -203,25 +218,36 @@ class PerceptronNameEntityTagger:
         return tags
 
 
-_cached_tagger: Optional[PerceptronNameEntityTagger] = None
+_cached_taggers: Dict[str, PerceptronNameEntityTagger] = {}
 _load_lock = threading.Lock()
 
 
-def load_pretrained(path: Optional[str] = None) -> Optional[PerceptronNameEntityTagger]:
-    """The shipped tagger, or None when the artifact is absent (callers fall
-    back to the rule/gazetteer tagger)."""
-    global _cached_tagger
-    if path is None and _cached_tagger is not None:
-        return _cached_tagger
-    p = path or ARTIFACT_PATH
+def artifact_path_for(language: str) -> str:
+    """Shipped artifact path for a language ('en' keeps the historical
+    unsuffixed name) — the OpenNLPModels per-(language) file-map role."""
+    if language == "en":
+        return ARTIFACT_PATH
+    base, ext = os.path.splitext(ARTIFACT_PATH)
+    return f"{base}_{language}{ext}"
+
+
+def load_pretrained(path: Optional[str] = None, language: str = "en"
+                    ) -> Optional[PerceptronNameEntityTagger]:
+    """The shipped tagger for ``language``, or None when its artifact is
+    absent (callers fall back to the rule/gazetteer tagger).  Per-language
+    taggers cache independently (OpenNLPModels.scala:48-70 loads one model
+    per language the same way)."""
+    if path is None and language in _cached_taggers:
+        return _cached_taggers[language]
+    p = path or artifact_path_for(language)
     if not os.path.exists(p):
         return None
     with _load_lock:
-        if path is None and _cached_tagger is not None:
-            return _cached_tagger
+        if path is None and language in _cached_taggers:
+            return _cached_taggers[language]
         with np.load(p) as z:
             tagger = PerceptronNameEntityTagger(
-                z["weights"].astype(np.float32))
+                z["weights"].astype(np.float32), language=language)
         if path is None:
-            _cached_tagger = tagger
+            _cached_taggers[language] = tagger
     return tagger

@@ -166,15 +166,29 @@ _ABBREVIATIONS = frozenset({
     "e.g", "i.e", "u.s", "u.k",
 })
 
+#: per-language EXTRA abbreviation sets — kept out of the English default so
+#: short English words ("nr", "tel") never suppress English boundaries
+#: (r5 advisor); selected by split_sentences(language=...)
+_ABBREVIATIONS_LANG = {
+    "es": frozenset({"sra", "srta", "dra", "avda", "núm", "pág", "tel",
+                     "ud", "uds"}),
+    "nl": frozenset({"dhr", "mevr", "drs", "ir", "nr", "bv", "n.v", "b.v",
+                     "o.a"}),
+}
+
 _SENTENCE_END_RE = re.compile(r"([.!?]+)(\s+|$)")
 
 
-def split_sentences(text: Optional[str]) -> List[str]:
-    """Abbreviation-aware sentence splitter (OpenNLPSentenceSplitter role).
+def split_sentences(text: Optional[str], language: str = "en") -> List[str]:
+    """Abbreviation-aware sentence splitter (OpenNLPSentenceSplitter role —
+    the reference likewise ships per-language sentence models,
+    OpenNLPModels.scala:48-70).
 
     Splits on ./!/? followed by whitespace, except after known abbreviations
-    and single initials ("J. Doe" — but not the pronoun "I").
+    (the English base set plus ``language``'s extras) and single initials
+    ("J. Doe" — but not the pronoun "I").
     """
+    abbrevs = _ABBREVIATIONS | _ABBREVIATIONS_LANG.get(language, frozenset())
     if not text:
         return []
     sentences: List[str] = []
@@ -186,7 +200,7 @@ def split_sentences(text: Optional[str]) -> List[str]:
         low = last.lower().rstrip(".")
         if m.group(1) == ".":
             is_initial = len(last) == 1 and last.isupper() and last != "I"
-            if low in _ABBREVIATIONS or is_initial:
+            if low in abbrevs or is_initial:
                 continue  # abbreviation or initial, not a boundary
         chunk = text[start:end].strip()
         if chunk:
