@@ -1,0 +1,53 @@
+"""Measure the sklearn proxy sweep DIRECTLY at 1M rows (VERDICT r4 #6).
+
+The bench's ``vs_baseline`` denominator previously extrapolated from <=131k
+rows with measured per-family scaling exponents; the LR exponent pinned at
+the clamp, so part of the headline ratio was set by the clamp rather than a
+measurement.  This runs each family of the exact 11x3 fold-model sweep once
+at n=1,000,000 and writes ``baseline_1m.json`` at the repo root; bench.py
+uses the measured total as the denominator whenever the headline row count
+matches.
+
+Run (hours — sklearn GBT dominates):  python tools/baseline_1m_direct.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import bench as B
+
+
+def main():
+    n = B.TARGET_ROWS
+    x, y = B.synth(n, B.D, seed=1)
+    rng = np.random.default_rng(2)
+    folds = rng.integers(0, B.FOLDS, n)
+    out = {"n_rows": n, "d": B.D, "folds": B.FOLDS, "families": {}}
+    for fam in ("LR", "SVC", "RF", "GBT"):
+        t0 = time.perf_counter()
+        for est in B._proxy_family_models(fam, n):
+            for f in range(B.FOLDS):
+                tr = folds != f
+                est.fit(x[tr], y[tr])
+        dt = time.perf_counter() - t0
+        out["families"][fam] = round(dt, 2)
+        print(f"{fam}: {dt:.1f}s", flush=True)
+        # checkpoint after every family so a crash keeps partial results
+        out["total_seconds"] = round(sum(out["families"].values()), 2)
+        out["complete"] = len(out["families"]) == 4
+        with open(os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "baseline_1m.json"), "w") as fh:
+            json.dump(out, fh, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
