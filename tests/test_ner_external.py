@@ -1,0 +1,56 @@
+"""External-provenance NER + langid evaluation (VERDICT r4 #9).
+
+The fixture text (tests/ner_external_fixture.py) is transcribed from
+public-domain pre-1929 prose — the first eval set here whose sentences
+were not authored by this repo's builder.  The labels are still hand
+annotations, but the register, syntax, and entity inventory come from
+published literature (Doyle, Stoker, Verne, Dickens, Austen, ...).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from ner_external_fixture import EXTERNAL_LANGID, EXTERNAL_TEXT  # noqa: E402
+
+from transmogrifai_tpu.ops.ner import ner_tokenize
+from transmogrifai_tpu.ops.ner_model import load_pretrained
+from transmogrifai_tpu.utils.lang import detect_language
+
+
+def _score(fixture, tag_fn):
+    tp = fp = fn = 0
+    for sent, gold in fixture:
+        pred = tag_fn(sent)
+        gold_pairs = {(t, e) for t, e in gold.items()}
+        pred_pairs = {(t, e) for t, ents in pred.items() for e in ents
+                      if e != "Misc"}
+        tp += len(gold_pairs & pred_pairs)
+        fp += len(pred_pairs - gold_pairs)
+        fn += len(gold_pairs - pred_pairs)
+    p = tp / max(tp + fp, 1)
+    r = tp / max(tp + fn, 1)
+    return p, r, 2 * p * r / max(p + r, 1e-9)
+
+
+class TestExternalProvenanceNER:
+    def test_f1_on_public_domain_prose(self):
+        """F1 >= 0.78 on the transcribed public-domain fixture (VERDICT r4
+        #9 Done criterion — the bar rises with corpus provenance)."""
+        assert len(EXTERNAL_TEXT) >= 30
+        tagger = load_pretrained()
+        p, r, f1 = _score(
+            EXTERNAL_TEXT, lambda s: tagger.tag_to_entities(ner_tokenize(s)))
+        assert f1 >= 0.78, f"external F1 {f1:.3f} (P {p:.3f} R {r:.3f})"
+
+    def test_fixture_has_varied_entities(self):
+        kinds = {e for _, gold in EXTERNAL_TEXT for e in gold.values()}
+        assert {"Person", "Location", "Organization", "Date",
+                "Time"} <= kinds
+
+
+class TestExternalProvenanceLangid:
+    def test_public_domain_openings_detect(self):
+        """Every public-domain literary opening must identify correctly."""
+        for lang, text in EXTERNAL_LANGID:
+            assert detect_language(text) == lang, (lang, text[:40])

@@ -73,7 +73,10 @@ MONTHS = ["January", "February", "March", "April", "May", "June", "July",
           "August", "September", "October", "November", "December"]
 WEEKDAYS = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
             "Saturday", "Sunday"]
-HONORIFICS = ["Mr.", "Mrs.", "Ms.", "Dr.", "Prof."]
+HONORIFICS = ["Mr.", "Mrs.", "Ms.", "Dr.", "Prof.",
+              # Victorian/literary register (r5: external public-domain
+              # prose eval — Count Dracula, Miss Adler, Squire Trelawney)
+              "Miss", "Sir", "Lady", "Count", "Squire", "Madame"]
 ROLE_TITLES = ["Secretary", "Inspector", "Captain", "Professor", "Sergeant",
                "Senator", "Governor", "Mayor", "Judge", "Detective",
                "Minister", "Ambassador", "Councilwoman", "Colonel", "Madame"]
@@ -344,6 +347,51 @@ TEMPLATES = [
      {"city": "Location", "year": "Date"}),
     ("Rain stopped play at {venue} just before {ampm} on {weekday}.",
      {"venue": "Location", "ampm": "Time", "weekday": "Date"}),
+    # facility-suffix locations and literary register (r5: external
+    # public-domain eval — Waterloo Station, Briony Lodge, Saville Row)
+    ("We met at {last} {placeword} at a quarter past nine.",
+     {"last": "Location", "placeword": "Location"}),
+    ("{first} had left {last} {placeword} before {time}.",
+     {"first": "Person", "last": "Location", "placeword": "Location"}),
+    ("The {time} train from {last} {placeword} was late again.",
+     {"time": "Time", "last": "Location", "placeword": "Location"}),
+    ("He lived at No. 7 {last} {streetword} for many years.",
+     {"last": "Location", "streetword": "Location"}),
+    ("The {shipword} {orgname} was due at {city} on {weekday}.",
+     {"orgname": "Organization", "city": "Location", "weekday": "Date"}),
+    ("Passengers boarded the {shipword} {orgname} bound for {country}.",
+     {"orgname": "Organization", "country": "Location"}),
+    ("{hon} {last} wagered {money} that he would return by {month}.",
+     {"last": "Person", "money": "Money", "month": "Date"}),
+    ("{hon} {last} had directed me to the {orghead} {hotelword}.",
+     {"last": "Person", "orghead": "Organization",
+      "hotelword": "Organization"}),
+    ("They took rooms at the {orghead} {hotelword} near the harbour.",
+     {"orghead": "Organization", "hotelword": "Organization"}),
+    ("{hon} {last}, who was usually very late in the mornings, was "
+     "seated at the table.",
+     {"last": "Person"}),
+    # at/of-preposition locations and bare-surname subjects (r5 external)
+    ("The trains were stopping at {city} until a late hour on {weekday}.",
+     {"city": "Location", "weekday": "Date"}),
+    ("He had been ashore at {city} for three days before sailing.",
+     {"city": "Location"}),
+    ("She was a native of {city}, far away to the west.",
+     {"city": "Location"}),
+    ("He promised to carry the message to {venue} before {month}.",
+     {"venue": "Location", "month": "Date"}),
+    ("The {shipword} lay at anchor off {city} all through {month}.",
+     {"city": "Location", "month": "Date"}),
+    ("{last} had been hiding in his flat since {weekday}.",
+     {"last": "Person", "weekday": "Date"}),
+    ("{last} never painted out the old name above the door.",
+     {"last": "Person"}),
+    ("{last} found that the watch still kept {city} time.",
+     {"last": "Person", "city": "Location"}),
+    ("{first} gave a ball on {holeve} and spent but {money} on it.",
+     {"first": "Person", "holeve": "Date", "money": "Money"}),
+    ("The shops stayed shut from {holeve} until the new year.",
+     {"holeve": "Date"}),
     # agentive "by / led by / sponsored by / audit by" organizations
     ("Conference dinner sponsored by {orgname}, options confirmed.",
      {"orgname": "Organization"}),
@@ -489,7 +537,14 @@ def _fill(rng):
         "hon": HONORIFICS[rng.integers(len(HONORIFICS))],
         "role": ROLE_TITLES[rng.integers(len(ROLE_TITLES))],
         "opener": FILLER_OPENERS[rng.integers(len(FILLER_OPENERS))],
-        "streetword": ["Street", "Avenue", "Road", "Lane"][rng.integers(4)],
+        "streetword": ["Street", "Avenue", "Road", "Lane", "Row",
+                       "Square"][rng.integers(6)],
+        "placeword": ["Station", "Lodge", "Park", "Common", "Bridge",
+                      "Court"][rng.integers(6)],
+        "shipword": ["steamer", "ship", "liner", "schooner"][rng.integers(4)],
+        "hotelword": ["Hotel", "Inn", "Arms"][rng.integers(3)],
+        "holeve": ["Christmas Eve", "Easter Monday", "Michaelmas",
+                   "Whitsun", "New Year"][rng.integers(5)],
         "river": RIVERS[rng.integers(len(RIVERS))],
         "venue": VENUES[rng.integers(len(VENUES))],
         "plainnum": str(rng.integers(10, 9999)),
@@ -544,7 +599,7 @@ def _fill(rng):
     return tokens, tags
 
 
-def train(n_sentences=10000, epochs=8, seed=13):
+def train(n_sentences=16000, epochs=8, seed=13):
     rng = np.random.default_rng(seed)
     data = [_fill(rng) for _ in range(n_sentences)]
     w = np.zeros((NUM_BUCKETS, len(TAG_SET)), np.float64)
