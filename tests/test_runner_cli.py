@@ -261,3 +261,111 @@ class TestCliGen:
         _df(50).to_csv(csv, index=False)
         with pytest.raises(ValueError, match="response"):
             generate_project(csv, "nope", str(tmp_path / "p"))
+
+
+_HAZARD_SOURCE = '''\
+import jax.numpy as jnp
+
+
+class Sneaky:
+    def transform_columns(self, cols, dataset):
+        x = jnp.asarray(cols[0].data)
+        return float(jnp.sum(x))  # blocking host sync -> TM301
+'''
+
+_CLEAN_SOURCE = '''\
+import numpy as np
+
+
+class Fine:
+    def transform_columns(self, cols, dataset):
+        return np.cumsum(cols[0].data)
+'''
+
+
+class TestCliLint:
+    """``python -m transmogrifai_tpu.cli lint`` — prints typed diagnostics
+    and exits non-zero on findings (docs/static_analysis.md)."""
+
+    def _lint(self, *args):
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "transmogrifai_tpu.cli", "lint", *args],
+            env=env, capture_output=True, text=True, timeout=300)
+
+    def test_hazard_file_exits_nonzero_with_code(self, tmp_path):
+        p = tmp_path / "sneaky.py"
+        p.write_text(_HAZARD_SOURCE)
+        r = self._lint("--path", str(p))
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "TM301" in r.stdout
+        assert "fix:" in r.stdout
+
+    def test_no_target_exits_nonzero(self):
+        r = self._lint()  # neither --path nor --workflow: refuse, don't go green
+        assert r.returncode != 0
+        assert "nothing to lint" in r.stderr
+
+    def test_missing_path_exits_nonzero(self):
+        r = self._lint("--path", "/nonexistent/dir")
+        assert r.returncode != 0
+        assert "does not exist" in r.stderr
+
+    def test_syntax_error_file_reports_tm305_without_masking(self, tmp_path):
+        (tmp_path / "bad.py").write_text("def broken(:\n")
+        (tmp_path / "haz.py").write_text(_HAZARD_SOURCE)
+        r = self._lint("--path", str(tmp_path))
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "TM305" in r.stdout   # the unparseable file is a finding...
+        assert "TM301" in r.stdout   # ...and does not mask the real hazard
+
+    def test_clean_file_exits_zero(self, tmp_path):
+        p = tmp_path / "fine.py"
+        p.write_text(_CLEAN_SOURCE)
+        r = self._lint("--path", str(p))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "no issues found" in r.stdout
+
+    def test_json_output(self, tmp_path):
+        p = tmp_path / "sneaky.py"
+        p.write_text(_HAZARD_SOURCE)
+        r = self._lint("--path", str(p), "--json")
+        assert r.returncode == 1
+        blob = json.loads(r.stdout)
+        assert blob[0]["code"] == "TM301"
+        assert blob[0]["severity"] == "warning"
+
+    def test_workflow_mode_validates_dag(self, tmp_path):
+        wf_src = '''\
+from transmogrifai_tpu import FeatureBuilder, Workflow
+from transmogrifai_tpu.data.dataset import Column
+from transmogrifai_tpu.stages.base import BinaryTransformer
+from transmogrifai_tpu.types import Integral, OPVector, Real
+
+
+class LintDemoBadConcat(BinaryTransformer):
+    input_types = (Real, Integral)
+    output_type = OPVector
+
+    def device_transform(self, x, y):
+        from jax import lax
+        return lax.concatenate([x.reshape(-1, 1), y.reshape(-1, 1)], dimension=1)
+
+    def transform_columns(self, cols, dataset):
+        raise NotImplementedError
+
+
+def build():
+    a = FeatureBuilder.Real("a").extract_field().as_predictor()
+    n = FeatureBuilder.Integral("n").extract_field().as_predictor()
+    return Workflow().set_result_features(a.transform_with(LintDemoBadConcat(), n))
+'''
+        (tmp_path / "lintdemo.py").write_text(wf_src)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=f"{REPO_ROOT}{os.pathsep}{tmp_path}")
+        r = subprocess.run(
+            [sys.executable, "-m", "transmogrifai_tpu.cli", "lint",
+             "--workflow", "lintdemo:build", "--fail-on", "error"],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "TM204" in r.stdout

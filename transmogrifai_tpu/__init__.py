@@ -17,6 +17,9 @@ from .data.dataset import Column, Dataset
 from .workflow.workflow import Workflow, WorkflowModel
 from .ops.transmogrifier import transmogrify
 from .checkers.sanity import SanityChecker
+from .checkers.diagnostics import (  # noqa: F401 — opcheck static validation
+    DagCycleError, Diagnostic, DiagnosticReport, OpCheckError, Severity,
+)
 from .models.selector import (
     BinaryClassificationModelSelector,
     MultiClassificationModelSelector,
@@ -47,4 +50,6 @@ __all__ = [
     "RegressionModelSelector", "ModelSelector", "Evaluators", "DataReaders",
     "score_function", "export_standalone", "MicroBatchStreamingReader",
     "OffsetCheckpoint", "JsonlTailSource",
+    "Diagnostic", "DiagnosticReport", "Severity", "OpCheckError",
+    "DagCycleError",
 ]

@@ -1,0 +1,26 @@
+"""Checkers — data-driven (sanity) and static (opcheck) workflow validation.
+
+Reference: core/.../SanityChecker.scala for the data-driven checker; the
+static validator (opcheck/diagnostics) ports the compile-time type safety of
+the Scala feature DAG (SURVEY §1) as a pre-execution analysis pass.
+"""
+
+from .diagnostics import (
+    DIAGNOSTIC_CODES,
+    DagCycleError,
+    Diagnostic,
+    DiagnosticReport,
+    OpCheckError,
+    Severity,
+    make_diagnostic,
+)
+
+__all__ = [
+    "DIAGNOSTIC_CODES",
+    "DagCycleError",
+    "Diagnostic",
+    "DiagnosticReport",
+    "OpCheckError",
+    "Severity",
+    "make_diagnostic",
+]

@@ -1,0 +1,224 @@
+"""Typed diagnostics for the static workflow validator (checkers/opcheck.py).
+
+Reference: the compile-time type-safety guarantee TransmogrifAI advertises
+(SURVEY §1; features/.../FeatureLike.scala type parameters + OpWorkflow.scala
+:265-323 DAG validation) — invalid feature/stage compositions must be rejected
+*before* any data is touched, with actionable messages.  Re-designed here as a
+structured diagnostic system with stable codes, so tooling (CI lint gates, the
+``cli lint`` subcommand, editor integrations) can match on codes instead of
+message text.
+
+Code families:
+
+- ``TM1xx`` structural   — cycles, duplicate uids, orphaned wiring, selectors, serde
+- ``TM2xx`` type & shape — feature-type propagation and abstract device shapes
+- ``TM3xx`` JAX hazards  — host syncs, row loops, jit recompilation (AST lint)
+- ``TM4xx`` leakage      — label-dependent stages on the wrong side of CV
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+
+class Severity(enum.IntEnum):
+    """Ordered so gates can threshold (``sev >= Severity.WARNING``)."""
+
+    INFO = 10
+    WARNING = 20
+    ERROR = 30
+
+    def __str__(self) -> str:  # "error", not "Severity.ERROR", in CLI output
+        return self.name.lower()
+
+
+#: code -> (default severity, short title, default fix hint)
+DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
+    # -- structural ---------------------------------------------------------
+    "TM101": (Severity.ERROR, "cycle in feature DAG",
+              "break the cycle: a stage's inputs must not depend, transitively, "
+              "on its own output (check manual rewiring of _input_features)"),
+    "TM102": (Severity.ERROR, "duplicate stage uid",
+              "give each stage a unique uid; shared uids make scoring substitute "
+              "one fitted model for every stage with that uid"),
+    "TM103": (Severity.ERROR, "orphaned stage wiring",
+              "the stage was re-wired after this feature was created; rebuild the "
+              "feature via stage.get_output() so the DAG matches what will run"),
+    "TM104": (Severity.WARNING, "duplicate raw feature name",
+              "two distinct generator stages emit the same column name and will "
+              "silently read the same input column; rename one of them"),
+    "TM105": (Severity.ERROR, "multiple ModelSelectors",
+              "a workflow may contain at most one ModelSelector; split into "
+              "separate workflows or combine the model grids into one selector"),
+    "TM106": (Severity.WARNING, "stage not serde round-trippable",
+              "use module-level functions (or @register_function) for stage "
+              "callables and keep the class importable under its own name so "
+              "save/load can reconstruct it from STAGE_REGISTRY"),
+    # -- type & shape -------------------------------------------------------
+    "TM201": (Severity.ERROR, "input arity mismatch",
+              "wire the stage with set_input() using the declared number of "
+              "input features"),
+    "TM202": (Severity.ERROR, "input feature type mismatch",
+              "convert the feature to the declared input type (e.g. via a "
+              "vectorizer or map/cast stage) before this stage"),
+    "TM203": (Severity.ERROR, "output feature type mismatch",
+              "the feature's declared type no longer matches what the stage "
+              "will produce; re-derive the output via stage.get_output() after "
+              "changing stage params"),
+    "TM204": (Severity.ERROR, "device shape/dtype error",
+              "the stage's device transform fails shape/dtype checking under "
+              "jax.eval_shape; fix operand shapes/dtypes before launching a "
+              "device job"),
+    # -- JAX hazards (AST lint) ---------------------------------------------
+    "TM301": (Severity.WARNING, "host sync on device value",
+              "item()/float()/np.asarray on a jax value forces a device->host "
+              "transfer and blocks dispatch; keep the computation in jnp and "
+              "fetch once at the end"),
+    "TM302": (Severity.WARNING, "Python loop over rows",
+              "a per-row Python loop defeats columnar vectorization; rewrite "
+              "with vectorized numpy/jnp operations over the whole column"),
+    "TM303": (Severity.WARNING, "jax.jit inside hot path",
+              "jit-compiling inside transform/fit re-traces on every call; "
+              "move the jitted function to module level"),
+    "TM304": (Severity.WARNING, "jit recompilation hazard",
+              "a jit-decorated closure defined inside the function creates a "
+              "fresh cache entry per call; hoist it to module level so the "
+              "compiled program is reused"),
+    "TM305": (Severity.ERROR, "unparseable source file",
+              "fix the syntax error (or exclude the file from the lint path); "
+              "an unparseable file cannot be checked and must not silently "
+              "mask findings elsewhere"),
+    # -- leakage ------------------------------------------------------------
+    "TM401": (Severity.ERROR, "label leaks into feature path",
+              "a response(-derived) feature reaches the model's feature input "
+              "through a non-label slot; remove it from the predictor set"),
+    "TM402": (Severity.INFO, "label-dependent fit outside CV folds",
+              "label-dependent estimators upstream of the ModelSelector fit "
+              "once on all rows, so their fit leaks validation labels into the "
+              "CV estimate; use Workflow.with_workflow_cv() to re-fit them "
+              "inside every fold"),
+}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding: stable code + severity + location + actionable fix hint."""
+
+    code: str
+    severity: Severity
+    message: str
+    stage_uid: Optional[str] = None
+    location: Optional[str] = None  # "file.py:123" for AST-lint findings
+    fix_hint: str = ""
+
+    @property
+    def title(self) -> str:
+        return DIAGNOSTIC_CODES[self.code][1] if self.code in DIAGNOSTIC_CODES \
+            else self.code
+
+    def pretty(self) -> str:
+        where = self.stage_uid or self.location or "<workflow>"
+        lines = [f"{self.code} [{self.severity}] {where}: {self.message}"]
+        if self.fix_hint:
+            lines.append(f"       fix: {self.fix_hint}")
+        return "\n".join(lines)
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "severity": str(self.severity),
+            "stageUid": self.stage_uid,
+            "location": self.location,
+            "message": self.message,
+            "fixHint": self.fix_hint,
+        }
+
+
+def make_diagnostic(code: str, message: str, stage_uid: Optional[str] = None,
+                    location: Optional[str] = None,
+                    severity: Optional[Severity] = None,
+                    fix_hint: Optional[str] = None) -> Diagnostic:
+    """Build a Diagnostic, filling severity/fix hint from the code table."""
+    default_sev, _title, default_hint = DIAGNOSTIC_CODES.get(
+        code, (Severity.WARNING, code, ""))
+    return Diagnostic(
+        code=code,
+        severity=default_sev if severity is None else severity,
+        message=message,
+        stage_uid=stage_uid,
+        location=location,
+        fix_hint=default_hint if fix_hint is None else fix_hint,
+    )
+
+
+@dataclass
+class DiagnosticReport:
+    """Ordered collection of diagnostics with severity filters and rendering."""
+
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+
+    def __iter__(self) -> Iterator[Diagnostic]:
+        return iter(self.diagnostics)
+
+    def __len__(self) -> int:
+        return len(self.diagnostics)
+
+    def extend(self, diags: Iterable[Diagnostic]) -> None:
+        self.diagnostics.extend(diags)
+
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity >= Severity.ERROR]
+
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.WARNING]
+
+    def infos(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.INFO]
+
+    def by_code(self, code: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.code == code]
+
+    def at_least(self, severity: Severity) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity >= severity]
+
+    def pretty(self) -> str:
+        if not self.diagnostics:
+            return "opcheck: no issues found"
+        counts = (f"{len(self.errors())} error(s), {len(self.warnings())} "
+                  f"warning(s), {len(self.infos())} info")
+        body = "\n".join(d.pretty() for d in self.diagnostics)
+        return f"opcheck: {counts}\n{body}"
+
+    def to_dicts(self) -> List[dict]:
+        return [d.to_dict() for d in self.diagnostics]
+
+
+class OpCheckError(ValueError):
+    """Raised by the ``strict=True`` train gate on error-severity findings."""
+
+    def __init__(self, report: DiagnosticReport):
+        self.report = report
+        errs = report.errors()
+        super().__init__(
+            f"workflow validation failed with {len(errs)} error(s):\n"
+            + "\n".join(d.pretty() for d in errs))
+
+
+class DagCycleError(ValueError):
+    """Cyclic feature graph, carrying the TM101 diagnostic with the cycle path.
+
+    Raised by workflow/dag.py:compute_dag instead of looping/recursing forever
+    when a feature graph is cyclic.
+    """
+
+    def __init__(self, cycle_uids: List[str]):
+        self.cycle_uids = list(cycle_uids)
+        self.diagnostic = make_diagnostic(
+            "TM101",
+            "feature DAG contains a cycle through stages: "
+            + " -> ".join(self.cycle_uids),
+            stage_uid=self.cycle_uids[0] if self.cycle_uids else None,
+        )
+        super().__init__(f"[TM101] {self.diagnostic.message}")

@@ -280,7 +280,7 @@ class SanityChecker(BinaryEstimator):
 
         if spearman:
             yc_dev = jnp.pad(y_corr, (0, n_pad - n))
-            corr_sub = np.asarray(
+            corr_sub = np.asarray(  # opcheck: allow(TM301) single end-of-kernel fetch
                 _device_label_corr(xc_dev, yc_dev, mask_dev, float(n)))
         else:
             corr_sub = pearson_corr[corr_idx]
@@ -293,7 +293,8 @@ class SanityChecker(BinaryEstimator):
         full = None
         if not self.feature_label_corr_only and corr_idx:
             if len(corr_idx) <= self.max_features_for_full_corr:
-                full = np.asarray(_device_full_corr(xc_dev, mask_dev, float(n)))
+                full = np.asarray(  # opcheck: allow(TM301) single end-of-kernel fetch
+                    _device_full_corr(xc_dev, mask_dev, float(n)))
             else:
                 # wide path: column-shard the corr block over the mesh and
                 # build the gram matrix with a ppermute ring (parallel/wide.py
@@ -336,7 +337,8 @@ class SanityChecker(BinaryEstimator):
             # Indicator columns gather from the placed block on device.
             all_idx = [j for idxs in groups.values() for j in idxs]
             g_all = jnp.take(x_dev, jnp.asarray(all_idx), axis=1)
-            cont_all = np.asarray(_device_contingency(g_all, y_dev))
+            cont_all = np.asarray(  # opcheck: allow(TM301) single end-of-kernel fetch
+                _device_contingency(g_all, y_dev))
             off = 0
             for gkey, indices in groups.items():
                 cont = cont_all[off:off + len(indices)]

@@ -1,0 +1,114 @@
+"""``lint`` subcommand — static diagnostics from the command line.
+
+Reference: the reference CLI's pre-flight checks (cli/.../CliExec.scala role)
+combined with this port's static validator (checkers/opcheck.py, SURVEY §1):
+print typed TM-code diagnostics and exit non-zero, so CI can gate on them
+before any TPU time is spent.
+
+Two modes, combinable:
+
+- ``--path FILE_OR_DIR``  AST-lints python sources for JAX hazards (TM3xx) in
+  ``transform_columns``/``fit_columns``/``device_transform`` bodies
+  (``--all-functions`` widens to every function).
+- ``--workflow module:attr``  imports ``attr`` from ``module`` (a Workflow, a
+  zero-arg factory returning one, or a list of result features) and runs the
+  full analyzer suite over the DAG — no data is touched.
+
+Exit status: 1 when any finding reaches ``--fail-on`` (default: warning),
+else 0.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+from typing import List
+
+
+def add_lint_parser(sub) -> None:
+    p = sub.add_parser(
+        "lint", help="static DAG validation + JAX-hazard lint (exits non-zero "
+                     "on findings)")
+    p.add_argument("--path", action="append", default=[],
+                   help="python file or directory to AST-lint (repeatable)")
+    p.add_argument("--workflow", default=None, metavar="MODULE:ATTR",
+                   help="import a Workflow (or factory / result-feature list) "
+                        "and validate its DAG")
+    p.add_argument("--all-functions", action="store_true",
+                   help="lint every function, not just "
+                        "transform_columns/fit_columns/device_transform")
+    p.add_argument("--fail-on", choices=["info", "warning", "error"],
+                   default="warning",
+                   help="lowest severity that makes the exit status non-zero")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit diagnostics as JSON instead of text")
+
+
+def _python_files(path: str) -> List[str]:
+    if os.path.isfile(path):
+        return [path]
+    if not os.path.isdir(path):
+        raise SystemExit(f"lint: --path {path!r} does not exist")
+    out: List[str] = []
+    for root, _dirs, files in os.walk(path):
+        out.extend(os.path.join(root, f) for f in sorted(files)
+                   if f.endswith(".py"))
+    if not out:
+        # a gate that silently lints zero files would go green on a typo'd dir
+        raise SystemExit(f"lint: --path {path!r} contains no .py files")
+    return out
+
+
+def _resolve_workflow(spec: str):
+    """'pkg.module:attr' -> result feature list (accepts Workflow/factory)."""
+    from ..workflow.workflow import Workflow
+
+    if ":" not in spec:
+        raise SystemExit(f"--workflow expects MODULE:ATTR, got {spec!r}")
+    mod_name, attr = spec.split(":", 1)
+    obj = getattr(importlib.import_module(mod_name), attr)
+    if callable(obj) and not isinstance(obj, Workflow):
+        obj = obj()
+    if isinstance(obj, Workflow):
+        return obj.result_features, obj._workflow_cv
+    return list(obj), False
+
+
+def run_lint(ns) -> int:
+    from ..checkers.diagnostics import DiagnosticReport, Severity
+    from ..checkers.opcheck import (HAZARD_FUNCTION_NAMES, lint_file,
+                                    validate_result_features)
+
+    if not ns.workflow and not ns.path:
+        # a gate invoked with no target (flag lost in CI YAML quoting, say)
+        # must not go silently green
+        raise SystemExit("lint: nothing to lint — pass --path and/or --workflow")
+    report = DiagnosticReport()
+    if ns.workflow:
+        features, workflow_cv = _resolve_workflow(ns.workflow)
+        report.extend(validate_result_features(features,
+                                               workflow_cv=workflow_cv))
+    only = None if ns.all_functions else HAZARD_FUNCTION_NAMES
+    for path in ns.path:
+        for fname in _python_files(path):
+            try:
+                findings = lint_file(fname, only_names=only)
+            except (SyntaxError, ValueError, UnicodeDecodeError) as e:
+                # one unparseable file must not abort the lint of the rest
+                from ..checkers.diagnostics import make_diagnostic
+
+                report.extend([make_diagnostic(
+                    "TM305", f"cannot parse: {e}",
+                    location=f"{fname}:{getattr(e, 'lineno', 0) or 0}")])
+                continue
+            report.extend(f.to_diagnostic() for f in findings)
+
+    if ns.as_json:
+        import json
+
+        print(json.dumps(report.to_dicts(), indent=2))
+    else:
+        print(report.pretty())
+
+    threshold = Severity[ns.fail_on.upper()]
+    return 1 if report.at_least(threshold) else 0

@@ -85,17 +85,26 @@ class Feature:
 
     # -- traversal ----------------------------------------------------------
     def raw_features(self) -> List["Feature"]:
-        """All raw ancestors (deduplicated, stable order)."""
-        seen: Dict[str, Feature] = {}
-        self._collect_raw(seen)
-        return list(seen.values())
+        """All raw ancestors (deduplicated, stable depth-first order).
 
-    def _collect_raw(self, seen: Dict[str, "Feature"]) -> None:
-        if self.is_raw:
-            seen.setdefault(self.uid, self)
-        else:
-            for p in self.parents:
-                p._collect_raw(seen)
+        Iterative with a visited set: the recursive version re-walked shared
+        subtrees (exponential on diamond-heavy DAGs) and hit RecursionError on
+        deep chains; the visited set also keeps this traversal terminating on
+        cyclic graphs, which compute_dag then rejects with a TM101 diagnostic.
+        """
+        seen: Dict[str, Feature] = {}
+        visited: set = set()
+        stack: List[Feature] = [self]
+        while stack:
+            f = stack.pop()
+            if f.uid in visited:
+                continue
+            visited.add(f.uid)
+            if f.is_raw:
+                seen.setdefault(f.uid, f)
+            else:
+                stack.extend(reversed(f.parents))
+        return list(seen.values())
 
     def parent_stages(self) -> Dict["PipelineStage", int]:
         """Stage -> max distance from this feature.  Reference: FeatureLike.parentStages().

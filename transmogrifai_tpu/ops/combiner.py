@@ -17,6 +17,15 @@ class VectorsCombiner(SequenceTransformer):
     sequence_input_type = OPVector
     output_type = OPVector
 
+    def device_transform(self, *blocks):
+        """Device half of the concat, traceable for opcheck's jax.eval_shape
+        pass (and for layer fusion): strict lax.concatenate surfaces dtype
+        divergence between blocks statically."""
+        from jax import lax
+
+        return lax.concatenate([b if b.ndim == 2 else b.reshape(b.shape[0], 1)
+                                for b in blocks], dimension=1)
+
     def transform_columns(self, cols, dataset):
         metas = []
         for f, c in zip(self.inputs, cols):

@@ -315,7 +315,7 @@ class LDAModel(UnaryTransformer):
                         x[i, j] += 1.0
             gamma, _, _, _ = _e_step(jnp.asarray(x), jnp.asarray(self.topic_word),
                                      1.0 / self.k, self.inner_iter)
-            gamma = np.asarray(gamma)
+            gamma = np.asarray(gamma)  # opcheck: allow(TM301) single fetch of E-step result
             block = (gamma / gamma.sum(axis=1, keepdims=True)).astype(np.float32)
         meta_cols = [
             VectorColumnMetadata(f.name, f.ftype.__name__, grouping=f.name,

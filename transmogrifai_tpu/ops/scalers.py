@@ -72,6 +72,10 @@ class StandardScalerModel(UnaryTransformer):
         self.mean = mean
         self.std = std
 
+    def device_transform(self, x):
+        """Traceable device kernel (opcheck abstract eval / layer fusion)."""
+        return (x - self.mean) / self.std
+
     def transform_columns(self, cols, dataset):
         v = (cols[0].data.astype(np.float64) - self.mean) / self.std
         return Column(RealNN, v, np.ones(len(v), dtype=np.bool_))

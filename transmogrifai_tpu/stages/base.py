@@ -13,6 +13,7 @@ workflow engine fuses all device transformers in a layer into one jitted program
 from __future__ import annotations
 
 import itertools
+import weakref
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
 
 from ..features.feature import Feature
@@ -22,6 +23,13 @@ if TYPE_CHECKING:  # pragma: no cover
     from ..data.dataset import Column, Dataset
 
 _stage_uid_counter = itertools.count()
+
+#: uid -> live stage, for construction-time duplicate detection.  Weak values:
+#: a dead DAG releases its uids, so re-loading the same saved model twice (the
+#: generator stages round-trip through __init__ with their persisted uids) is
+#: legal as long as both copies agree on the class.
+_LIVE_STAGES: "weakref.WeakValueDictionary[str, PipelineStage]" = \
+    weakref.WeakValueDictionary()
 
 
 def stage_uid(cls_name: str) -> str:
@@ -86,7 +94,22 @@ class PipelineStage:
     def __init__(self, operation_name: Optional[str] = None, uid: Optional[str] = None, **params):
         self._param_values: Dict[str, Any] = {}
         self.operation_name = operation_name or _default_op_name(type(self).__name__)
+        if uid is not None:
+            # counter-generated uids are unique by construction; only an
+            # explicit uid can collide.  A same-class collision is legal
+            # (re-loading a saved model builds equivalent stages) and is
+            # caught at the DAG level if both land in one workflow; a
+            # different-class collision can only be corruption — scoring
+            # substitutes fitted models BY UID, so it would silently run the
+            # wrong model.
+            other = _LIVE_STAGES.get(uid)
+            if other is not None and type(other) is not type(self):
+                raise ValueError(
+                    f"[TM102] duplicate stage uid {uid!r}: already held by a "
+                    f"live {type(other).__name__}; uid-keyed scoring "
+                    "substitution would silently shadow one of the stages")
         self.uid = uid or stage_uid(type(self).__name__)
+        _LIVE_STAGES[self.uid] = self
         self._input_features: Tuple[Feature, ...] = ()
         self._output_feature: Optional[Feature] = None
         cls_params = self._class_params()
@@ -224,7 +247,16 @@ def _default_op_name(cls_name: str) -> str:
 # ---------------------------------------------------------------------------
 
 class Transformer(PipelineStage):
-    """A stage with no fit step: pure column function."""
+    """A stage with no fit step: pure column function.
+
+    Stages whose column kernel is pure jnp may additionally expose
+    ``device_transform(self, *arrays) -> array`` — the device half of
+    ``transform_columns`` as a traceable function of the input blocks.  The
+    static validator (checkers/opcheck.py) abstractly evaluates it with
+    ``jax.eval_shape`` on zero-cost shape/dtype specs, catching shape and
+    dtype incompatibilities before any data is touched; it is also the seam a
+    layer fuser can jit into a single XLA program.
+    """
 
     is_model: bool = False  # True when produced by an Estimator.fit
 

@@ -8,8 +8,9 @@ so their device transforms can fuse into a single XLA program.
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Dict, List, Sequence
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
 
+from ..checkers.diagnostics import DagCycleError
 from ..features.feature import Feature
 from ..features.generator import FeatureGeneratorStage
 
@@ -17,8 +18,59 @@ if TYPE_CHECKING:  # pragma: no cover
     from ..stages.base import PipelineStage
 
 
+def find_feature_cycle(result_features: Sequence[Feature]) -> Optional[List[Feature]]:
+    """First cycle reachable from the result features, or None if acyclic.
+
+    Iterative white/grey/black DFS over feature parents.  The distance walk in
+    ``compute_dag``/``Feature.parent_stages`` never terminates on a cyclic
+    graph (each lap around the cycle "improves" the distance), so acyclicity
+    must be established before scheduling.
+    """
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+    for root in result_features:
+        if color.get(root.uid, WHITE) != WHITE:
+            continue
+        color[root.uid] = GREY
+        path: List[Feature] = [root]
+        stack = [(root, iter(root.parents))]
+        while stack:
+            _node, it = stack[-1]
+            descended = False
+            for nxt in it:
+                c = color.get(nxt.uid, WHITE)
+                if c == GREY:  # back edge: slice the cycle out of the path
+                    i = next(i for i, f in enumerate(path) if f.uid == nxt.uid)
+                    return path[i:] + [nxt]
+                if c == WHITE:
+                    color[nxt.uid] = GREY
+                    path.append(nxt)
+                    stack.append((nxt, iter(nxt.parents)))
+                    descended = True
+                    break
+            if not descended:
+                done, _ = stack.pop()
+                path.pop()
+                color[done.uid] = BLACK
+    return None
+
+
+def cycle_stage_uids(cycle: Sequence[Feature]) -> List[str]:
+    """Stage uids along a feature cycle (falls back to feature names for raws)."""
+    return [f.origin_stage.uid if f.origin_stage is not None else f"feature:{f.name}"
+            for f in cycle]
+
+
 def compute_dag(result_features: Sequence[Feature]) -> List[List["PipelineStage"]]:
-    """Layered DAG of non-generator stages, dependency layers first."""
+    """Layered DAG of non-generator stages, dependency layers first.
+
+    Raises :class:`DagCycleError` (carrying the TM101 diagnostic with the
+    offending stage uids) when the feature graph is cyclic — the distance walk
+    below would otherwise loop without bound.
+    """
+    cycle = find_feature_cycle(result_features)
+    if cycle is not None:
+        raise DagCycleError(cycle_stage_uids(cycle))
     distances: Dict["PipelineStage", int] = {}
     for f in result_features:
         for stage, dist in f.parent_stages().items():

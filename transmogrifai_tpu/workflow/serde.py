@@ -266,10 +266,23 @@ def save_model(model, path: str) -> None:
                 features.append(feat)
 
     stages = []
+    seen_stages: Dict[str, PipelineStage] = {}
     for f in features:
         st = f.origin_stage
         if st is None:
             continue
+        prev = seen_stages.get(st.uid)
+        if prev is st:
+            continue  # same stage reached through another feature
+        if prev is not None:
+            # distinct origin stages sharing a uid: the loader keys stages by
+            # uid, so one would silently shadow the other (and scoring
+            # substitution would run the wrong model)
+            raise ValueError(
+                f"[TM102] duplicate stage uid {st.uid!r} in DAG "
+                f"({type(prev).__name__} vs {type(st).__name__}); refusing "
+                "to save a model that cannot round-trip")
+        seen_stages[st.uid] = st
         full = not isinstance(st, Estimator)
         stages.append(encode_stage(st, enc, full=full))
 
@@ -311,6 +324,15 @@ def load_model(path: str):
     npz = np.load(os.path.join(path, "arrays.npz"), allow_pickle=False)
     dec = _Decoder({k: npz[k] for k in npz.files})
 
+    uid_counts: Dict[str, int] = {}
+    for s in manifest["stages"]:
+        uid_counts[s["uid"]] = uid_counts.get(s["uid"], 0) + 1
+    dups = sorted(u for u, c in uid_counts.items() if c > 1)
+    if dups:
+        # a dict comprehension would silently keep the LAST state per uid and
+        # score with the wrong stage — fail loudly instead
+        raise ValueError(
+            f"[TM102] model manifest contains duplicate stage uid(s): {dups}")
     stage_states = {s["uid"]: s for s in manifest["stages"]}
     stages: Dict[str, PipelineStage] = {}
     features: Dict[str, Feature] = {}

@@ -93,8 +93,23 @@ class Workflow:
         seen_uids: Dict[str, object] = {}
         for stage in all_stages(self.result_features):
             if stage.uid in seen_uids and seen_uids[stage.uid] is not stage:
-                raise ValueError(f"Duplicate stage uid in DAG: {stage.uid}")
+                raise ValueError(f"[TM102] Duplicate stage uid in DAG: {stage.uid}")
             seen_uids[stage.uid] = stage
+
+    def validate(self) -> "DiagnosticReport":
+        """Static pre-execution validation — runs WITHOUT touching data.
+
+        Walks the DAG reached from the result features through every opcheck
+        analyzer family (structural, type/shape, JAX-hazard AST lint, label
+        leakage) and returns the typed :class:`DiagnosticReport`.  Shape/dtype
+        checking goes through ``jax.eval_shape`` on ``ShapeDtypeStruct`` specs,
+        so no device buffer is ever allocated.  See docs/static_analysis.md
+        for the diagnostic code table.
+        """
+        from ..checkers.opcheck import validate_result_features
+
+        return validate_result_features(self.result_features,
+                                        workflow_cv=self._workflow_cv)
 
     # -- data ----------------------------------------------------------------
     def raw_features(self) -> List[Feature]:
@@ -113,12 +128,23 @@ class Workflow:
 
     # -- training ------------------------------------------------------------
     def train(self, test_fraction: float = 0.0, seed: int = 42,
-              checkpointer=None) -> "WorkflowModel":
+              checkpointer=None, strict: bool = False) -> "WorkflowModel":
         """Fit the DAG.  ``checkpointer`` (a StageCheckpointer) persists each
         fitted stage as it completes and resumes from disk on re-run —
-        sweep-level resume for preemptible hardware (SURVEY §5.4)."""
+        sweep-level resume for preemptible hardware (SURVEY §5.4).
+
+        ``strict=True`` runs the static validator first and raises
+        :class:`OpCheckError` on any error-severity diagnostic, so a broken
+        DAG fails in milliseconds instead of minutes into a TPU job.
+        """
         if not self.result_features:
             raise ValueError("set_result_features before train()")
+        if strict:
+            report = self.validate()
+            if report.errors():
+                from ..checkers.diagnostics import OpCheckError
+
+                raise OpCheckError(report)
         raw = self.generate_raw_data()
 
         blacklist: List[str] = []
