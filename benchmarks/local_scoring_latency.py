@@ -2,9 +2,15 @@
 
 Builds a realistic fitted pipeline (transmogrify + SanityChecker + selected
 LR + GBT competing), binds ``score_function``, and reports single-record
-p50/p99 latency plus columnar batch throughput.
+p50/p99 latency plus columnar batch throughput.  A second, wider fixture
+(8 numeric + 6 categorical predictors — a realistic transmogrify vector)
+benchmarks the serve/ engine: compiled-plan batch-256 throughput vs the
+interpreted ``LocalScorer.batch`` path, plus micro-batcher latency
+percentiles (p50/p95/p99) and the batch-size histogram.
 
-Prints one JSON line.  Run:  python benchmarks/local_scoring_latency.py
+Prints one JSON line per section (``local_scoring_p50_ms`` then
+``serve_throughput_rps`` — the BENCH_serve shape).
+Run:  python benchmarks/local_scoring_latency.py
 """
 
 from __future__ import annotations
@@ -129,5 +135,118 @@ def main():
         f"bound + measured scheduler noise floor ({env_p99:.3f} ms)")
 
 
+def serve_bench():
+    """serve/ engine on a realistic wide vector: compiled plan vs interpreted.
+
+    Gates the tentpole acceptance: compiled-plan throughput at batch 256 must
+    be >= 5x the interpreted ``LocalScorer.batch`` throughput, with per-bucket
+    compilation happening at most once (compile-count probe).
+    """
+    from transmogrifai_tpu import (
+        BinaryClassificationModelSelector,
+        Dataset,
+        FeatureBuilder,
+        Workflow,
+        transmogrify,
+    )
+    from transmogrifai_tpu.local import score_function
+    from transmogrifai_tpu.models.logistic import LogisticRegression
+    from transmogrifai_tpu.models.trees import GradientBoostedTreesClassifier
+    from transmogrifai_tpu.serve import ScoringServer
+    from transmogrifai_tpu.types import PickList, Real, RealNN
+
+    rng = np.random.default_rng(11)
+    n = 2000
+    numeric = [f"x{i}" for i in range(8)]
+    categorical = [f"c{i}" for i in range(6)]
+    levels = [["red", "green", "blue"], ["a", "b", "c", "d"],
+              ["s", "m", "l", "xl", "xxl"], ["us", "eu", "apac"],
+              ["web", "ios", "android"], ["t1", "t2", "t3", "t4"]]
+    cols = {f: rng.normal(size=n).tolist() for f in numeric}
+    for f, lv in zip(categorical, levels):
+        cols[f] = rng.choice(lv, n).tolist()
+    cols["label"] = (rng.random(n) > 0.5).astype(float).tolist()
+    ds = Dataset.from_features(
+        cols, {**{f: Real for f in numeric},
+               **{f: PickList for f in categorical}, "label": RealNN})
+    label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+    feats = [FeatureBuilder.of(f, Real).extract_field().as_predictor()
+             for f in numeric] + \
+            [FeatureBuilder.of(f, PickList).extract_field().as_predictor()
+             for f in categorical]
+    checked = label.sanity_check(transmogrify(feats))
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, models=[
+            (LogisticRegression(), [{"reg_param": 0.01}]),
+            (GradientBoostedTreesClassifier(),
+             [{"num_rounds": 20, "max_depth": 3}]),
+        ])
+    pred = label.transform_with(sel, checked)
+    model = Workflow().set_input_dataset(ds) \
+        .set_result_features(label, pred).train()
+
+    def record():
+        r = {f: float(rng.normal()) for f in numeric}
+        for f, lv in zip(categorical, levels):
+            r[f] = str(rng.choice(lv))
+        return r
+
+    records = [record() for _ in range(256)]
+    scorer = score_function(model)
+    plan = model.serving_plan().warm()
+    assert scorer.batch(records) == plan.score(records), \
+        "serve/interpreted parity broke on the benchmark fixture"
+    compiles_after_warm = plan.compile_count
+
+    reps = 30
+    best_interp = best_serve = float("inf")
+    for _ in range(3):  # best-of-3 blocks: strip scheduler noise
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            scorer.batch(records)
+        best_interp = min(best_interp, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            plan.score(records)
+        best_serve = min(best_serve, time.perf_counter() - t0)
+    interp_rps = reps * len(records) / best_interp
+    serve_rps = reps * len(records) / best_serve
+
+    # micro-batcher latency percentiles + batch-size histogram: replay a
+    # request stream record by record through the server's submit path
+    server = ScoringServer(model, max_batch=256, max_wait_ms=2.0)
+    stream = [record() for _ in range(2000)]
+    futures = [server.submit(r) for r in stream]
+    for f in futures:
+        f.result()
+    metrics = server.metrics()
+    server.close()
+
+    out = {
+        "metric": "serve_throughput_rps",
+        "value": round(serve_rps, 1),
+        "unit": "records/s (CompiledScoringPlan.score, batch 256, wide "
+                "fixture: 8 numeric + 6 categorical)",
+        "interpreted_batch_rps": round(interp_rps, 1),
+        "speedup_vs_interpreted": round(serve_rps / interp_rps, 2),
+        "winner_model": model.summary().best_model_name,
+        "compile_count_after_warm": compiles_after_warm,
+        "compile_count_after_run": plan.compile_count,
+        "batcher_latency_p50_ms": metrics["batcher"]["latency_p50_ms"],
+        "batcher_latency_p95_ms": metrics["batcher"]["latency_p95_ms"],
+        "batcher_latency_p99_ms": metrics["batcher"]["latency_p99_ms"],
+        "batch_size_hist": metrics["batcher"]["batch_size_hist"],
+        "fused_stages": metrics["plan"]["fused_stages"],
+        "host_stages": metrics["plan"]["host_stages"],
+    }
+    print(json.dumps(out))
+    assert plan.compile_count == compiles_after_warm, \
+        "per-bucket compilation must happen at most once (warm covered all)"
+    assert serve_rps >= 5.0 * interp_rps, (
+        f"serve throughput {serve_rps:.0f} rps < 5x interpreted "
+        f"{interp_rps:.0f} rps")
+
+
 if __name__ == "__main__":
     main()
+    serve_bench()

@@ -1,0 +1,473 @@
+"""serve/ subsystem tests: compiled-plan parity, bucketed compilation,
+micro-batcher policies, TM5xx servability diagnostics, and the cli serve
+subcommand.
+
+Mirrors the reference's OpWorkflowModelLocalTest parity discipline
+(engine path == local path), extended to the compiled serving engine: all
+three scoring paths must agree BITWISE on the fixture workflow.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    FeatureBuilder,
+    Workflow,
+    transmogrify,
+)
+from transmogrifai_tpu.local import score_function
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.readers.files import DataReaders
+from transmogrifai_tpu.serve import (
+    BatcherClosedError,
+    CompiledScoringPlan,
+    MicroBatcher,
+    QueueFullError,
+    ScoringServer,
+    check_servability,
+)
+from transmogrifai_tpu.types import OPVector, Real, RealNN
+
+
+@pytest.fixture(scope="module")
+def model_and_records():
+    rng = np.random.default_rng(7)
+    n = 400
+    x1 = rng.normal(0, 1, n)
+    color = rng.choice(["red", "green", "blue"], n)
+    age = np.where(rng.random(n) < 0.15, None, rng.normal(40, 10, n))
+    y = (rng.random(n) < 1 / (1 + np.exp(-(1.5 * x1 + (color == "red"))))
+         ).astype(float)
+    records = [
+        {"label": float(y[i]), "x1": float(x1[i]), "color": str(color[i]),
+         "age": None if age[i] is None else float(age[i])}
+        for i in range(n)
+    ]
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    f_x1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    f_color = FeatureBuilder.PickList("color").extract_field().as_predictor()
+    f_age = FeatureBuilder.Real("age").extract_field().as_predictor()
+    vec = transmogrify([f_x1, f_color, f_age])
+    checked = label.sanity_check(vec)
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+    pred = label.transform_with(sel, checked)
+
+    import pandas as pd
+
+    df = pd.DataFrame(records)
+    model = (Workflow().set_result_features(label, pred)
+             .set_reader(DataReaders.Simple.dataframe(df))).train()
+    return model, records, df, label, pred
+
+
+class TestCompiledPlanParity:
+    def test_partition_shape(self, model_and_records):
+        model = model_and_records[0]
+        plan = model.serving_plan()
+        # vectorizers + combiner + sanity fuse; the winning model stays host
+        assert len(plan.device_stage_uids) == 4
+        assert len(plan.host_stage_uids) == 1
+        m = plan.metrics()
+        assert m["fused_stages"] == 4 and m["host_stages"] == 1
+
+    def test_three_way_bitwise_parity(self, model_and_records):
+        """LocalScorer.batch, WorkflowModel.score, CompiledScoringPlan.score
+        must agree bitwise (satellite acceptance)."""
+        model, records, df, label, pred = model_and_records
+        scorer = score_function(model)
+        plan = model.serving_plan()
+        local_out = scorer.batch(records[:64])
+        plan_out = plan.score(records[:64])
+        assert local_out == plan_out  # dict equality on floats IS bitwise
+
+        ds = DataReaders.Simple.dataframe(df.head(64)).generate_dataset(
+            [f for f in _raws(model)])
+        engine_vals = model.score(ds)[pred.name].to_values()
+        for row, eng in zip(plan_out, engine_vals):
+            assert row[pred.name] == eng
+
+    def test_parity_without_label(self, model_and_records):
+        model, records, df, label, pred = model_and_records
+        nolabel = [{k: v for k, v in r.items() if k != "label"}
+                   for r in records[:16]]
+        scorer = score_function(model)
+        plan = model.serving_plan()
+        a, b = scorer.batch(nolabel), plan.score(nolabel)
+        assert a == b
+        assert all("label" not in row for row in b)
+        # engine path scores the same label-less records identically
+        from transmogrifai_tpu.readers.base import rows_to_dataset
+
+        ds = rows_to_dataset(nolabel, _raws(model),
+                             allow_missing_response=True)
+        engine_vals = model.score(ds)[pred.name].to_values()
+        for row, eng in zip(b, engine_vals):
+            assert row[pred.name] == eng
+
+    def test_empty_batch_fast_paths(self, model_and_records):
+        model = model_and_records[0]
+        assert score_function(model).batch([]) == []
+        assert model.serving_plan().score([]) == []
+
+    def test_single_record_matches_batch(self, model_and_records):
+        model, records, *_ = model_and_records
+        plan = model.serving_plan()
+        assert plan.score(records[:1])[0] == plan.score(records[:8])[0]
+
+    def test_shared_raw_lift_wires_correct_operands(self):
+        """Two prefix stages consuming the SAME raw feature must both read
+        its operand (regression: the dedup once mis-indexed the second
+        consumer onto whichever entry was appended last)."""
+        from transmogrifai_tpu.ops.scalers import FillMissingWithMeanModel
+
+        fx = FeatureBuilder.Real("x").extract_field().as_predictor()
+        fy = FeatureBuilder.Real("y").extract_field().as_predictor()
+        m1 = FillMissingWithMeanModel(mean=1.0)
+        m1.set_input(fx)
+        m2 = FillMissingWithMeanModel(mean=2.0)
+        m2.set_input(fy)
+        m3 = FillMissingWithMeanModel(mean=3.0)  # x again, after y's lift
+        m3.set_input(fx)
+
+        class _Fitted:
+            result_features = [m1.get_output(), m2.get_output(),
+                               m3.get_output()]
+            fitted = {}
+
+        plan = CompiledScoringPlan(_Fitted(), min_bucket=4, max_bucket=8)
+        assert len(plan.device_stage_uids) == 3
+        out = plan.score([{"x": 10.0, "y": 20.0}, {"x": None, "y": None}])
+        assert out[0][m1.output_name] == 10.0
+        assert out[0][m2.output_name] == 20.0
+        assert out[0][m3.output_name] == 10.0  # x, not y
+        assert out[1] == {m1.output_name: 1.0, m2.output_name: 2.0,
+                          m3.output_name: 3.0}
+
+
+class TestBucketCompilation:
+    def test_compile_once_per_bucket(self, model_and_records):
+        from transmogrifai_tpu.serve.plan import _EXEC_CACHE, _EXEC_CACHE_LOCK
+
+        with _EXEC_CACHE_LOCK:  # isolate from other tests' cross-plan hits
+            _EXEC_CACHE.clear()
+        model = model_and_records[0]
+        plan = CompiledScoringPlan(model, min_bucket=8, max_bucket=64)
+        assert plan.compile_count == 0
+        rec = model_and_records[1]
+        plan.score(rec[:5])     # bucket 8
+        plan.score(rec[:7])     # same bucket: no new compile
+        assert plan.compile_count == 1
+        plan.score(rec[:20])    # bucket 32
+        assert plan.compile_count == 2
+        plan.score(rec[:30])    # bucket 32 again
+        assert plan.compile_count == 2
+        assert sorted(plan.metrics()["buckets_compiled"]) == [8, 32]
+
+    def test_executable_cache_shared_across_plans(self, model_and_records):
+        """Same fitted model -> same fingerprint -> zero fresh compiles."""
+        model = model_and_records[0]
+        p1 = CompiledScoringPlan(model, min_bucket=8, max_bucket=64).warm()
+        assert p1.compile_count >= 1
+        p2 = CompiledScoringPlan(model, min_bucket=8, max_bucket=64).warm()
+        assert p2.fingerprint == p1.fingerprint
+        assert p2.compile_count == 0
+        assert p2.score(model_and_records[1][:4]) == \
+            p1.score(model_and_records[1][:4])
+
+    def test_oversize_batch_chunks(self, model_and_records):
+        model, records, *_ = model_and_records
+        plan = CompiledScoringPlan(model, min_bucket=8, max_bucket=32)
+        out = plan.score(records[:100])  # 32+32+32+4
+        assert out == model.serving_plan().score(records[:100])
+        assert len(out) == 100
+
+    def test_warm_compiles_every_bucket(self, model_and_records):
+        model = model_and_records[0]
+        plan = CompiledScoringPlan(model, min_bucket=8, max_bucket=64)
+        plan.warm()
+        assert sorted(plan.metrics()["buckets_compiled"]) == [8, 16, 32, 64]
+        before = plan.compile_count
+        plan.score(model_and_records[1][:40])
+        assert plan.compile_count == before
+
+    def test_non_pow2_buckets_round_up_and_stay_warm(self, model_and_records):
+        """--min-bucket 10 must not leave a bucket warm() never compiles."""
+        model, records, *_ = model_and_records
+        plan = CompiledScoringPlan(model, min_bucket=10, max_bucket=100)
+        assert (plan.min_bucket, plan.max_bucket) == (16, 128)
+        plan.warm()
+        before = plan.compile_count
+        plan.score(records[:5])    # smallest bucket
+        plan.score(records[:100])  # largest bucket
+        assert plan.compile_count == before
+
+
+class TestJaxLeak:
+    def test_plain_converts_jax_arrays(self):
+        import jax.numpy as jnp
+
+        from transmogrifai_tpu.local.scoring import _plain
+
+        assert _plain(jnp.asarray(1.5)) == 1.5
+        assert _plain(jnp.asarray([1.0, 2.0])) == [1.0, 2.0]
+        assert isinstance(_plain(jnp.asarray(1.5)), float)
+        assert _plain(np.float64(2.0)) == 2.0
+        assert _plain("s") == "s"
+
+
+class TestMicroBatcher:
+    def test_flush_on_size(self):
+        batches = []
+
+        def fn(rs):
+            batches.append(len(rs))
+            return [{"ok": r["i"]} for r in rs]
+
+        with MicroBatcher(fn, max_batch=4, max_wait_ms=5000,
+                          max_queue=64) as mb:
+            futs = [mb.submit({"i": i}) for i in range(8)]
+            out = [f.result(timeout=10) for f in futs]
+        assert [o["ok"] for o in out] == list(range(8))
+        assert batches and max(batches) <= 4
+        assert sum(batches) == 8
+
+    def test_flush_on_deadline_with_concurrent_submitters(self):
+        """Satellite smoke: concurrent submitters, deadline flush, clean
+        drain — never reaching max_batch must not stall requests."""
+        def fn(rs):
+            return [r for r in rs]
+
+        mb = MicroBatcher(fn, max_batch=1000, max_wait_ms=20, max_queue=256)
+        results = []
+        lock = threading.Lock()
+
+        def submitter(i):
+            v = mb.score({"i": i}, timeout=10)
+            with lock:
+                results.append(v["i"])
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=submitter, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        elapsed = time.monotonic() - t0
+        assert sorted(results) == list(range(12))
+        assert elapsed < 5, "deadline flush must not wait for a full batch"
+        mb.shutdown(drain=True, timeout=10)
+        assert mb.queue_depth == 0
+        m = mb.metrics()
+        assert m["completed"] == 12 and m["queue_depth"] == 0
+        assert m["batches"] >= 1
+
+    def test_backpressure_rejects_when_full(self):
+        gate = threading.Event()
+
+        def fn(rs):
+            gate.wait(5)
+            return list(rs)
+
+        mb = MicroBatcher(fn, max_batch=1, max_wait_ms=1, max_queue=2)
+        try:
+            mb.submit({"i": 0})  # picked up by the flusher, blocks on gate
+            time.sleep(0.05)
+            mb.submit({"i": 1})
+            mb.submit({"i": 2})
+            with pytest.raises(QueueFullError):
+                mb.submit({"i": 3})
+            assert mb.metrics()["rejected"] == 1
+        finally:
+            gate.set()
+            mb.shutdown(drain=True, timeout=10)
+        assert mb.queue_depth == 0
+
+    def test_shutdown_rejects_new_submits(self):
+        mb = MicroBatcher(lambda rs: list(rs), max_batch=4, max_wait_ms=1)
+        mb.shutdown(drain=True, timeout=10)
+        with pytest.raises(BatcherClosedError):
+            mb.submit({})
+
+    def test_scorer_error_propagates_to_futures(self):
+        def fn(rs):
+            raise RuntimeError("boom")
+
+        with MicroBatcher(fn, max_batch=4, max_wait_ms=1) as mb:
+            fut = mb.submit({"i": 0})
+            with pytest.raises(RuntimeError, match="boom"):
+                fut.result(timeout=10)
+            assert mb.metrics()["failed"] == 1
+
+    def test_cancelled_future_does_not_kill_flusher(self):
+        """A client cancelling a pending future must not crash the flusher
+        thread and hang every subsequent request."""
+        gate = threading.Event()
+
+        def fn(rs):
+            gate.wait(5)
+            return list(rs)
+
+        mb = MicroBatcher(fn, max_batch=1, max_wait_ms=1, max_queue=8)
+        try:
+            mb.submit({"i": 0})        # occupies the flusher on the gate
+            time.sleep(0.05)
+            f1 = mb.submit({"i": 1})   # still pending in the queue
+            assert f1.cancel()
+            gate.set()
+            f2 = mb.submit({"i": 2})
+            assert f2.result(timeout=10) == {"i": 2}
+        finally:
+            gate.set()
+            mb.shutdown(drain=True, timeout=10)
+        assert mb.queue_depth == 0
+
+    def test_latency_percentiles_exported(self):
+        with MicroBatcher(lambda rs: list(rs), max_batch=8,
+                          max_wait_ms=1) as mb:
+            for i in range(20):
+                mb.score({"i": i}, timeout=10)
+            m = mb.metrics()
+        assert m["latency_p50_ms"] is not None
+        assert m["latency_p50_ms"] <= m["latency_p95_ms"] \
+            <= m["latency_p99_ms"]
+        assert m["batch_size_hist"]
+
+
+class TestScoringServer:
+    def test_end_to_end_submit_matches_plan(self, model_and_records):
+        model, records, *_ = model_and_records
+        with ScoringServer(model, max_batch=32, max_wait_ms=2,
+                           warm=False) as server:
+            futs = [server.submit(r) for r in records[:40]]
+            out = [f.result(timeout=30) for f in futs]
+            direct = server.score_batch(records[:40])
+            m = server.metrics()
+        assert out == direct
+        assert m["batcher"]["completed"] == 40
+        assert m["plan"]["scored_records"] >= 40
+        assert "compile_count" in m["plan"]
+
+    def test_model_serve_helper(self, model_and_records):
+        model, records, *_ = model_and_records
+        with model.serve(max_batch=16, max_wait_ms=2, warm=False) as server:
+            assert server.score(records[0], timeout=30) == \
+                server.score_batch([records[0]])[0]
+
+
+class TestServabilityValidator:
+    def test_fitted_model_is_clean(self, model_and_records):
+        model = model_and_records[0]
+        report = model.validate()
+        assert not report.by_code("TM501")
+        assert not report.errors()
+
+    def test_tm501_unfitted_estimator(self, model_and_records):
+        model = model_and_records[0]
+        report = check_servability(model.result_features, fitted={})
+        tm501 = report.by_code("TM501")
+        assert tm501 and all(d.severity.name == "ERROR" for d in tm501)
+        # and the plan constructor refuses to compile such a path
+        from transmogrifai_tpu.checkers.diagnostics import OpCheckError
+
+        class _Unfitted:
+            result_features = model.result_features
+            fitted = {}
+
+        with pytest.raises(OpCheckError, match="TM501"):
+            CompiledScoringPlan(_Unfitted())
+
+    def test_tm502_host_round_trip(self):
+        from transmogrifai_tpu.ops.scalers import (
+            FillMissingWithMeanModel,
+            StandardScalerModel,
+        )
+        from transmogrifai_tpu.stages.base import UnaryTransformer
+
+        class HostOpaque(UnaryTransformer):
+            """No device_transform: breaks the fused prefix."""
+
+            input_types = (RealNN,)
+            output_type = RealNN
+
+            def transform_columns(self, cols, dataset):
+                return cols[0]
+
+        raw = FeatureBuilder.Real("v").extract_field().as_predictor()
+        m1 = FillMissingWithMeanModel(mean=0.0)
+        m1.set_input(raw)
+        mid = HostOpaque()
+        mid.set_input(m1.get_output())
+        m2 = StandardScalerModel(mean=0.0, std=1.0)
+        m2.set_input(mid.get_output())
+        report = check_servability([m2.get_output()])
+        tm502 = report.by_code("TM502")
+        assert len(tm502) == 1 and tm502[0].stage_uid == mid.uid
+
+    def test_tm503_unbounded_vector_raw(self):
+        from transmogrifai_tpu.ops.combiner import VectorsCombiner
+
+        rv = FeatureBuilder.of("vec", OPVector).extract_field().as_predictor()
+        comb = VectorsCombiner()
+        comb.set_input(rv, rv)
+        report = check_servability([comb.get_output()])
+        assert report.by_code("TM503")
+        # the planner agrees: the combiner stays on host, no fused prefix
+        from transmogrifai_tpu.serve.plan import partition_scoring_stages
+
+        prefix, remainder, _ = partition_scoring_stages([comb])
+        assert not prefix and remainder == [comb]
+
+    def test_workflow_validate_serving_flag(self, model_and_records):
+        model = model_and_records[0]
+        wf = Workflow().set_result_features(*model.result_features)
+        report = wf.validate(serving=True)
+        # pre-train estimators are NOT TM501 errors without a fitted map
+        assert not report.by_code("TM501")
+
+
+class TestCliServe:
+    def test_cli_serve_smoke(self, model_and_records, tmp_path, capsys):
+        model, records, *_ = model_and_records
+        model_dir = str(tmp_path / "model")
+        model.save(model_dir)
+        rec_file = tmp_path / "records.jsonl"
+        nolabel = [{k: v for k, v in r.items() if k != "label"}
+                   for r in records[:20]]
+        rec_file.write_text(
+            "\n".join(json.dumps(r) for r in nolabel) + "\n")
+        out_file = tmp_path / "scores.jsonl"
+        metrics_file = tmp_path / "metrics.json"
+
+        from transmogrifai_tpu.cli.gen import main
+
+        rc = main(["serve", "--model", model_dir,
+                   "--records", str(rec_file),
+                   "--output", str(out_file),
+                   "--metrics-out", str(metrics_file),
+                   "--max-batch", "8", "--max-wait-ms", "1",
+                   "--min-bucket", "8", "--no-warm"])
+        assert rc == 0
+        rows = [json.loads(line) for line in
+                out_file.read_text().splitlines()]
+        assert len(rows) == 20
+        loaded = model.__class__.load(model_dir)
+        expected = loaded.serving_plan().score(nolabel)
+        assert rows == json.loads(json.dumps(expected))
+        metrics = json.loads(metrics_file.read_text())
+        assert metrics["batcher"]["completed"] == 20
+        assert metrics["plan"]["scored_records"] >= 20
+
+
+def _raws(model):
+    seen = {}
+    for f in model.result_features:
+        for r in f.raw_features():
+            seen.setdefault(r.uid, r)
+    return list(seen.values())

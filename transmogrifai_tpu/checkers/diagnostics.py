@@ -14,6 +14,9 @@ Code families:
 - ``TM2xx`` type & shape — feature-type propagation and abstract device shapes
 - ``TM3xx`` JAX hazards  — host syncs, row loops, jit recompilation (AST lint)
 - ``TM4xx`` leakage      — label-dependent stages on the wrong side of CV
+- ``TM5xx`` servability  — hazards for the compiled online-scoring path
+  (serve/plan.py): unfitted estimators, host round-trips splitting the fused
+  device prefix, unbounded shapes defeating padding-bucket compilation
 """
 
 from __future__ import annotations
@@ -90,6 +93,23 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "fix the syntax error (or exclude the file from the lint path); "
               "an unparseable file cannot be checked and must not silently "
               "mask findings elsewhere"),
+    # -- servability (serving path, opt-in via validate(serving=True)) ------
+    "TM501": (Severity.ERROR, "unfitted estimator in scoring path",
+              "train the workflow (or warm-start the missing stage) before "
+              "building a scoring plan; an estimator without a fitted model "
+              "cannot transform at request time"),
+    "TM502": (Severity.WARNING, "host stage forces a device round-trip",
+              "the stage sits between device-capable stages but has no "
+              "device_transform, so the fused scoring prefix must stop, copy "
+              "to host, and re-upload; implement device_transform (plus "
+              "encode_device_input for host-kind inputs) to keep the prefix "
+              "fused"),
+    "TM503": (Severity.WARNING, "unbounded feature shape breaks bucketing",
+              "the feature's device width is only known from the data (e.g. "
+              "a raw OPVector column), so padding buckets cannot amortize "
+              "compilation — every new width recompiles; fix the width "
+              "upstream (declare/enforce a constant vector width) or keep "
+              "its consumers on the host path"),
     # -- leakage ------------------------------------------------------------
     "TM401": (Severity.ERROR, "label leaks into feature path",
               "a response(-derived) feature reaches the model's feature input "

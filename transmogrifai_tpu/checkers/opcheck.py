@@ -67,11 +67,17 @@ _ABSTRACT_ROWS = 2
 # ---------------------------------------------------------------------------
 
 def validate_result_features(result_features: Sequence[Feature],
-                             workflow_cv: bool = False) -> DiagnosticReport:
+                             workflow_cv: bool = False,
+                             serving: bool = False,
+                             fitted=None) -> DiagnosticReport:
     """Run every analyzer over the DAG reached from ``result_features``.
 
     Touches no data: type propagation walks declared FeatureTypes and the
     shape/dtype pass uses ``jax.eval_shape`` on ``ShapeDtypeStruct`` specs.
+
+    ``serving=True`` adds the TM5xx servability analyzers
+    (serve/validator.py); ``fitted`` (uid -> fitted transformer) switches
+    them to scoring-path mode, where an unfitted estimator is a TM501 error.
     """
     from ..workflow.dag import all_stages
     from .diagnostics import DagCycleError
@@ -90,6 +96,10 @@ def validate_result_features(result_features: Sequence[Feature],
     report.extend(check_shapes(stages, generators))
     report.extend(check_jax_hazards(stages))
     report.extend(check_leakage(result_features, stages, workflow_cv))
+    if serving:
+        from ..serve.validator import check_servability
+
+        report.extend(check_servability(result_features, fitted=fitted))
     return report
 
 
@@ -331,14 +341,28 @@ def check_shapes(stages: Sequence[Any],
     ``device_transform(*arrays)`` method (the fused jnp column kernel) is
     traced abstractly on its input specs — shape/dtype incompatibilities
     surface here as TM204 without allocating a single device buffer.
+
+    Vector widths that are only known after fitting (a vectorizer's vocab
+    size, say) propagate as *placeholders*, and stages fed by a placeholder
+    width are NOT abstractly evaluated: width-sensitive kernels (the sanity
+    checker's kept-slot gather) would otherwise fail against a fabricated
+    width and report phantom TM204s.  The tradeoff is reduced dtype coverage
+    downstream of unfitted vectorizers; fitted scoring DAGs re-check at
+    serve-plan compile time.
     """
     import jax
 
     diags: List[Diagnostic] = []
     specs: Dict[str, Any] = {}
+    #: feature uids whose VECTOR width is a placeholder (data-dependent or
+    #: derived from one) — evaluating a width-sensitive kernel (an index
+    #: gather, say) against a made-up width would report phantom TM204s
+    placeholder: Set[str] = set()
     for g in generators:
         out = g.get_output()
         specs[out.uid] = _feature_spec(out.ftype)
+        if out.ftype.kind is ColumnKind.VECTOR:
+            placeholder.add(out.uid)
 
     for st in stages:
         out = getattr(st, "_output_feature", None)
@@ -349,22 +373,35 @@ def check_shapes(stages: Sequence[Any],
         # (data-dependent) widths keep the placeholder width of 1
         widths = [int(s.shape[1]) for s in in_specs
                   if s is not None and len(s.shape) == 2]
+        widths_known = widths and all(s is not None for s in in_specs) \
+            and not any(f.uid in placeholder for f in st.inputs)
         out_width = sum(widths) if out.ftype.kind is ColumnKind.VECTOR \
-            and widths and all(s is not None for s in in_specs) else 1
+            and widths_known else 1
         out_spec = _feature_spec(out.ftype, width=out_width)
+        if out.ftype.kind is ColumnKind.VECTOR and not widths_known:
+            placeholder.add(out.uid)
 
         device_fn = getattr(st, "device_transform", None)
-        if callable(device_fn) and in_specs and \
-                all(s is not None for s in in_specs):
+        # stages may restrict device_transform to a subset of input slots
+        # (e.g. a model's optional label slot is never wired at serve time)
+        slots = getattr(st, "device_input_slots", None)
+        if slots is None:
+            dev_slots = list(range(len(st.inputs)))
+        else:
+            dev_slots = [i for i in slots if i < len(st.inputs)]
+        dev_specs = [in_specs[i] for i in dev_slots]
+        if callable(device_fn) and dev_specs and \
+                all(s is not None for s in dev_specs) and \
+                not any(st.inputs[i].uid in placeholder for i in dev_slots):
             try:
-                traced = jax.eval_shape(device_fn, *in_specs)
+                traced = jax.eval_shape(device_fn, *dev_specs)
             except Exception as e:
                 msg = str(e).split("\n")[0]
                 diags.append(make_diagnostic(
                     "TM204",
                     f"{type(st).__name__}.device_transform fails abstract "
                     f"evaluation on input specs "
-                    f"{[(tuple(s.shape), str(s.dtype)) for s in in_specs]}: "
+                    f"{[(tuple(s.shape), str(s.dtype)) for s in dev_specs]}: "
                     f"{msg}",
                     stage_uid=st.uid))
             else:

@@ -457,6 +457,16 @@ class SanityCheckerModel(Transformer):
     def _is_label_slot(self, feature, features) -> bool:
         return feature is features[0]
 
+    #: scoring only reads the feature vector — the device plan never wires
+    #: the (absent-at-serve-time) label slot
+    device_input_slots = (1,)
+
+    def device_transform(self, vec):
+        """Kept-slot gather — the device half of the drop transformer."""
+        import jax.numpy as jnp
+
+        return vec[:, jnp.asarray(self.kept_indices)]
+
     def transform(self, dataset):
         # label is absent at scoring time — only the feature vector is needed
         vec = dataset[self.inputs[1].name]
@@ -465,7 +475,10 @@ class SanityCheckerModel(Transformer):
 
     def transform_columns(self, cols, dataset):
         vec = cols[1]
-        data = vec.data[:, self.kept_indices]
+        # ascontiguousarray: axis-1 fancy indexing yields an F-ordered array,
+        # and BLAS kernels downstream sum in a layout-dependent order — a
+        # C-ordered block keeps engine/local/serve scoring bitwise identical
+        data = np.ascontiguousarray(vec.data[:, self.kept_indices])
         meta = (vec.meta.select(self.kept_indices, self.output_name)
                 if vec.meta is not None else None)
         return Column.vector(data, meta)

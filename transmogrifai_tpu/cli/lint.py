@@ -37,6 +37,11 @@ def add_lint_parser(sub) -> None:
     p.add_argument("--all-functions", action="store_true",
                    help="lint every function, not just "
                         "transform_columns/fit_columns/device_transform")
+    p.add_argument("--serving", action="store_true",
+                   help="add the TM5xx servability analyzers (host "
+                        "round-trips in the fused scoring prefix, unbounded "
+                        "shapes breaking padding buckets) to --workflow "
+                        "validation")
     p.add_argument("--fail-on", choices=["info", "warning", "error"],
                    default="warning",
                    help="lowest severity that makes the exit status non-zero")
@@ -86,8 +91,9 @@ def run_lint(ns) -> int:
     report = DiagnosticReport()
     if ns.workflow:
         features, workflow_cv = _resolve_workflow(ns.workflow)
-        report.extend(validate_result_features(features,
-                                               workflow_cv=workflow_cv))
+        report.extend(validate_result_features(
+            features, workflow_cv=workflow_cv,
+            serving=getattr(ns, "serving", False)))
     only = None if ns.all_functions else HAZARD_FUNCTION_NAMES
     for path in ns.path:
         for fname in _python_files(path):

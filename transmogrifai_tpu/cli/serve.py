@@ -1,0 +1,104 @@
+"""``serve`` subcommand — drive the in-process scoring server from the CLI.
+
+Reference role: the reference CLI's scoring entry points (CliExec.scala run
+types) combined with this port's serving engine (serve/, docs/serving.md).
+There is no HTTP or stdio protocol here — :class:`ScoringServer` is an
+in-process API; this subcommand loads a saved model, replays a JSONL record
+stream through the micro-batcher (every record goes through ``submit``, so
+batching/backpressure/deadline policies are exercised exactly as a real
+embedding would), writes one JSON result per line, and emits the merged
+plan + batcher counters as a final JSON metrics object.
+
+Run::
+
+    python -m transmogrifai_tpu.cli serve --model ./model \\
+        --records requests.jsonl --output scores.jsonl --metrics-out m.json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def add_serve_parser(sub) -> None:
+    p = sub.add_parser(
+        "serve", help="score a JSONL record stream through the micro-batched "
+                      "in-process serving engine")
+    p.add_argument("--model", required=True,
+                   help="saved WorkflowModel directory (model.save(path))")
+    p.add_argument("--records", required=True,
+                   help="JSONL file of records to score ('-' for stdin)")
+    p.add_argument("--output", default="-",
+                   help="JSONL results destination (default: stdout)")
+    p.add_argument("--metrics-out", default=None,
+                   help="write the metrics JSON here instead of stderr")
+    p.add_argument("--max-batch", type=int, default=256,
+                   help="flush-on-size threshold (default 256)")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="flush-on-deadline for the oldest queued request "
+                        "(default 2 ms)")
+    p.add_argument("--max-queue", type=int, default=4096,
+                   help="admission-control queue bound (default 4096)")
+    p.add_argument("--min-bucket", type=int, default=8,
+                   help="smallest power-of-two padding bucket (default 8)")
+    p.add_argument("--no-warm", action="store_true",
+                   help="skip ahead-of-time bucket compilation")
+
+
+def _read_records(path: str) -> List[Dict[str, Any]]:
+    fh = sys.stdin if path == "-" else open(path)
+    try:
+        records = [json.loads(line) for line in fh if line.strip()]
+    finally:
+        if fh is not sys.stdin:
+            fh.close()
+    if not records:
+        raise SystemExit(f"serve: no records in {path!r}")
+    return records
+
+
+def run_serve(ns) -> int:
+    from ..serve import ScoringServer
+    from ..workflow.workflow import WorkflowModel
+
+    model = WorkflowModel.load(ns.model)
+    records = _read_records(ns.records)
+
+    from collections import deque
+
+    from ..serve import QueueFullError
+
+    with ScoringServer(model, max_batch=ns.max_batch,
+                       max_wait_ms=ns.max_wait_ms, max_queue=ns.max_queue,
+                       min_bucket=ns.min_bucket,
+                       warm=not ns.no_warm) as server:
+        futures: deque = deque()
+        results = []
+        for r in records:
+            while True:
+                try:
+                    futures.append(server.submit(r))
+                    break
+                except QueueFullError:
+                    # backpressure: wait for the oldest in-flight request
+                    results.append(futures.popleft().result())
+        results.extend(f.result() for f in futures)
+        metrics = server.metrics()
+
+    out = sys.stdout if ns.output == "-" else open(ns.output, "w")
+    try:
+        for r in results:
+            out.write(json.dumps(r) + "\n")
+    finally:
+        if out is not sys.stdout:
+            out.close()
+
+    blob = json.dumps(metrics, indent=2, default=str)
+    if ns.metrics_out:
+        with open(ns.metrics_out, "w") as fh:
+            fh.write(blob + "\n")
+    else:
+        print(blob, file=sys.stderr)
+    return 0

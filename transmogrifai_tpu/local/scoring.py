@@ -53,6 +53,8 @@ class LocalScorer:
     def batch(self, records: Sequence[Mapping[str, Any]]) -> List[Dict[str, Any]]:
         from ..readers.base import extract_columns
 
+        if not records:  # nothing to score: skip the zero-row Dataset walk
+            return []
         # label may legitimately be absent at inference time — the model
         # stages never read it (engine parity: scoring without a label)
         ds = Dataset(extract_columns(
@@ -75,11 +77,15 @@ class LocalScorer:
 
 
 def _plain(v: Any):
-    """Numpy scalars/arrays -> plain python for the Map[String,Any] contract."""
+    """Numpy/JAX scalars & arrays -> plain python for the Map[String,Any]
+    contract — a device array must never leak to a serving caller."""
     if isinstance(v, np.generic):
         return v.item()
     if isinstance(v, np.ndarray):
         return v.tolist()
+    if type(v).__module__.partition(".")[0] in ("jax", "jaxlib"):
+        arr = np.asarray(v)  # jax.Array (device output) -> host
+        return arr.item() if arr.ndim == 0 else arr.tolist()
     return v
 
 

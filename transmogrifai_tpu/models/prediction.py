@@ -60,17 +60,17 @@ class PredictionColumn(Column):
         return np.ones(len(self), dtype=np.bool_)
 
     def to_values(self, ftype=None) -> List[dict]:
-        out = []
-        for i in range(len(self)):
-            m = {Prediction.PredictionName: float(self.pred[i])}
-            if self.raw is not None:
-                for j in range(self.raw.shape[1]):
-                    m[f"{Prediction.RawPredictionName}_{j}"] = float(self.raw[i, j])
-            if self.prob is not None:
-                for j in range(self.prob.shape[1]):
-                    m[f"{Prediction.ProbabilityName}_{j}"] = float(self.prob[i, j])
-            out.append(m)
-        return out
+        # the serving hot path materializes this per batch: build the key
+        # tuple once and zip rows out of the already-stacked block instead
+        # of formatting keys and indexing columns per row
+        keys = [Prediction.PredictionName]
+        if self.raw is not None:
+            keys += [f"{Prediction.RawPredictionName}_{j}"
+                     for j in range(self.raw.shape[1])]
+        if self.prob is not None:
+            keys += [f"{Prediction.ProbabilityName}_{j}"
+                     for j in range(self.prob.shape[1])]
+        return [dict(zip(keys, row)) for row in self.data.tolist()]
 
     def take(self, indices: np.ndarray) -> "PredictionColumn":
         return PredictionColumn(

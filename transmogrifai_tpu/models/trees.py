@@ -933,30 +933,39 @@ class _TreeEnsembleModelBase(PredictionModelBase):
         return np.asarray(s[:n0], dtype=np.float64) + base[None, :]
 
     def _margin_host(self, x: np.ndarray) -> np.ndarray:
-        """Pure-numpy traversal (exact parity with the device path)."""
+        """Pure-numpy traversal (exact parity with the device path).
+
+        Node lookups go through flat 1-D fancy indexing on raveled tree
+        arrays — identical arithmetic to the per-axis ``take_along_axis``
+        formulation but ~3x cheaper per step, which matters because this is
+        the serving hot path for tree winners (micro-batches stay on host,
+        _HOST_PREDICT_MAX_ROWS).
+        """
         n, d = x.shape
         binned = np.empty((n, d), np.int32)
         for j in range(d):
             binned[:, j] = np.searchsorted(self.edges[j], x[:, j], side="right")
         binned[~np.isfinite(x)] = self.n_bins
         feat = self.trees["feat"]          # (T, m)
-        thr = self.trees["thr_bin"]
-        miss = self.trees["miss_left"]
-        leaf = self.trees["is_leaf"]
+        T, m = feat.shape
+        featf = np.ascontiguousarray(feat).ravel()
+        thrf = np.ascontiguousarray(self.trees["thr_bin"]).ravel()
+        missf = np.ascontiguousarray(self.trees["miss_left"]).ravel()
+        leaff = np.ascontiguousarray(self.trees["is_leaf"]).ravel()
         value = self.trees["value"]        # (T, m, K)
-        T = feat.shape[0]
+        valuef = np.ascontiguousarray(value).reshape(T * m, -1)
+        off = (np.arange(T, dtype=np.int32) * m)[:, None]      # (T, 1)
+        binnedf = binned.ravel()
+        rowsd = np.arange(n, dtype=np.int32) * d               # (n,)
         node = np.zeros((T, n), np.int32)
-        rows = np.arange(n)
         for _ in range(self.max_depth):
-            nf = np.take_along_axis(feat, node, 1)              # (T, n)
-            nb = binned[rows[None, :], nf]
-            nmiss = np.take_along_axis(miss, node, 1)
-            nthr = np.take_along_axis(thr, node, 1)
-            go_left = np.where(nb == self.n_bins, nmiss, nb <= nthr)
-            child = np.where(go_left, 2 * node + 1, 2 * node + 2)
-            node = np.where(np.take_along_axis(leaf, node, 1), node, child)
+            g = off + node                                     # (T, n) global
+            nb = binnedf[rowsd + featf[g]]
+            go_left = np.where(nb == self.n_bins, missf[g], nb <= thrf[g])
+            node = np.where(leaff[g], node,
+                            np.where(go_left, 2 * node + 1, 2 * node + 2))
         # (T, n, K) leaf values summed over trees
-        vals = value[np.arange(T)[:, None], node]
+        vals = valuef[off + node]
         return vals.sum(axis=0).astype(np.float64)
 
     @property

@@ -50,6 +50,15 @@ def _emit(values: np.ndarray, isnan: Optional[np.ndarray], meta: VectorMetadata)
     return Column.vector(out, meta)
 
 
+def _device_interleave(values, isnan):
+    """jnp half of ``_emit``: [v0, n0, v1, n1, ...] slot interleave."""
+    import jax.numpy as jnp
+
+    n, N = values.shape
+    return jnp.stack([values, isnan.astype(values.dtype)],
+                     axis=2).reshape(n, 2 * N)
+
+
 class NumericVectorizer(SequenceEstimator):
     """Impute (mean/mode/constant) + optional null indicators for nullable numerics."""
 
@@ -90,6 +99,18 @@ class NumericVectorizerModel(Transformer):
         self.fills = np.asarray(fills, dtype=np.float64)
         self.track_nulls = track_nulls
 
+    def device_transform(self, *xs):
+        """Impute + null-indicator as one traceable kernel; operands are the
+        canonical float32-with-NaN lifts of each numeric input column."""
+        import jax.numpy as jnp
+
+        x = jnp.stack(xs, axis=1)
+        nan = jnp.isnan(x)
+        filled = jnp.where(nan, jnp.asarray(self.fills.astype(np.float32)), x)
+        if not self.track_nulls:
+            return filled
+        return _device_interleave(filled, nan)
+
     def transform_columns(self, cols, dataset):
         x = _stack_f64(cols)
         nan = np.isnan(x)
@@ -104,6 +125,11 @@ class RealNNVectorizer(SequenceTransformer):
     sequence_input_type = RealNN
     output_type = OPVector
 
+    def device_transform(self, *xs):
+        import jax.numpy as jnp
+
+        return jnp.stack(xs, axis=1)
+
     def transform_columns(self, cols, dataset):
         x = np.column_stack([c.data.astype(np.float64) for c in cols])
         return _emit(x, None, _numeric_meta(self, track_nulls=False))
@@ -116,6 +142,18 @@ class BinaryVectorizer(SequenceTransformer):
     output_type = OPVector
 
     track_nulls = Param(default=True)
+
+    def device_transform(self, *xs):
+        """Operands are float32 with NaN for missing; missing becomes 0 with
+        an (optional) null-indicator slot, matching the host path exactly."""
+        import jax.numpy as jnp
+
+        x = jnp.stack(xs, axis=1)
+        absent = jnp.isnan(x)
+        vals = jnp.where(absent, 0.0, x)
+        if not self.track_nulls:
+            return vals
+        return _device_interleave(vals, absent)
 
     def transform_columns(self, cols, dataset):
         n = len(cols[0])

@@ -33,6 +33,9 @@ from ..utils.vector_metadata import (
 TOP_K_DEFAULT = 20          # Transmogrifier.scala:52-90 TopK
 MIN_SUPPORT_DEFAULT = 10    # MinSupport
 MAX_CARDINALITY = 500
+#: per-slot bound on the serving code memo — high-cardinality junk values
+#: must not grow an unbounded cache inside a long-lived scoring process
+_CODE_MEMO_MAX = 65536
 
 
 def clean_text_value(v: str) -> str:
@@ -93,6 +96,75 @@ class OneHotVectorizerModel(Transformer):
         self.vocabs = vocabs
         self.clean_text = clean_text
         self.track_nulls = track_nulls
+
+    # -- device scoring path (serve/plan.py fused prefix) --------------------
+    def _slot_width(self, slot: int) -> int:
+        return len(self.vocabs[slot]) + 1 + (1 if self.track_nulls else 0)
+
+    def device_lifts_input(self, slot: int) -> bool:
+        return True  # text levels encode to int32 codes on host
+
+    def device_input_spec(self, slot: int):
+        return (), "int32"
+
+    def encode_device_input(self, slot: int, col):
+        """Text column -> int32 level codes: vocab index, k=OTHER, k+1=null
+        (-1 when nulls are untracked: out-of-range one_hot rows stay zero,
+        matching the host path's all-zero row).
+
+        Raw values memoize their code per slot — categorical domains are
+        small, so steady-state serving encodes each level with one dict hit
+        instead of re-cleaning the string every request.
+        """
+        memo = self._code_memo(slot)
+        try:
+            codes = [memo.get(v, -2) for v in col.data]
+        except TypeError:  # unhashable junk value (list/dict payload):
+            codes = [-2] * len(col.data)  # let the typed path reject it below
+        if -2 in codes:  # unseen raw values: compute once, remember
+            vocab = self.vocabs[slot]
+            index = {lv: i for i, lv in enumerate(vocab)}
+            k = len(vocab)
+            null_code = k + 1 if self.track_nulls else -1
+            for i, c in enumerate(codes):
+                if c != -2:
+                    continue
+                raw = v = col.data[i]
+                if v is not None and type(v) is not str:  # noqa: E721
+                    # light serving columns skip per-value conversion; the
+                    # declared input type still rejects junk payloads here
+                    v = self.inputs[slot].ftype._convert(v)
+                if v is None or v == "":
+                    c = null_code
+                else:
+                    c = index.get(clean_text_value(v) if self.clean_text
+                                  else v, k)
+                codes[i] = c
+                if len(memo) < _CODE_MEMO_MAX:
+                    try:
+                        memo[raw] = c
+                    except TypeError:  # unhashable but convertible payload
+                        pass
+        return np.asarray(codes, dtype=np.int32)
+
+    def _code_memo(self, slot: int):
+        memos = getattr(self, "_code_memos", None)
+        if memos is None:
+            memos = self._code_memos = {}
+        memo = memos.get(slot)
+        if memo is None:
+            memo = memos[slot] = {}
+        return memo
+
+    def device_transform(self, *codes):
+        """One-hot scatter of the precomputed level codes, one block per
+        input feature — the device half of ``transform_columns``."""
+        import jax
+        import jax.numpy as jnp
+
+        blocks = [jax.nn.one_hot(c, self._slot_width(slot), dtype=jnp.float32)
+                  for slot, c in enumerate(codes)]
+        return blocks[0] if len(blocks) == 1 else jnp.concatenate(blocks, axis=1)
 
     def _meta(self) -> VectorMetadata:
         cols: List[VectorColumnMetadata] = []
@@ -161,6 +233,12 @@ class MultiPickListVectorizer(_OneHotFitMixin, SequenceEstimator):
 class MultiPickListVectorizerModel(OneHotVectorizerModel):
     sequence_input_type = OPSet
     output_type = OPVector
+
+    # multi-hot rows can't encode as one level code per row — stay on host
+    device_transform = None
+
+    def device_lifts_input(self, slot: int) -> bool:
+        return False
 
     def transform_columns(self, cols, dataset):
         n = len(cols[0])

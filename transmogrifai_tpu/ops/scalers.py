@@ -39,6 +39,12 @@ class FillMissingWithMeanModel(UnaryTransformer):
         super().__init__(**kw)
         self.mean = mean
 
+    def device_transform(self, x):
+        """Traceable impute kernel (operand: float32 with NaN for missing)."""
+        import jax.numpy as jnp
+
+        return jnp.where(jnp.isnan(x), jnp.float32(self.mean), x)
+
     def transform_columns(self, cols, dataset):
         v = cols[0].values_f64()
         filled = np.where(np.isnan(v), self.mean, v)

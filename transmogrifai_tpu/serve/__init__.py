@@ -1,0 +1,26 @@
+"""serve — micro-batching online scoring engine with a compiled-plan cache.
+
+Reference role: the production half of the reference's ``local`` module
+(OpWorkflowModelLocal/MLeap serving), rebuilt around this port's device
+protocol: a fitted DAG partitions into a jit-fused device prefix plus a host
+remainder (:class:`~.plan.CompiledScoringPlan`), requests flow through an
+adaptive bounded queue (:class:`~.batcher.MicroBatcher`, Clipper-style
+flush-on-size/deadline), and :class:`~.server.ScoringServer` composes both
+behind an in-process API with plain-dict metrics.  ``serve/validator.py``
+contributes the TM5xx servability diagnostics; see docs/serving.md.
+"""
+
+from .batcher import BatcherClosedError, MicroBatcher, QueueFullError
+from .plan import CompiledScoringPlan, compile_plan
+from .server import ScoringServer
+from .validator import check_servability
+
+__all__ = [
+    "BatcherClosedError",
+    "CompiledScoringPlan",
+    "MicroBatcher",
+    "QueueFullError",
+    "ScoringServer",
+    "check_servability",
+    "compile_plan",
+]

@@ -1,0 +1,212 @@
+"""MicroBatcher — adaptive request batching in front of a compiled plan.
+
+Reference: Clipper's adaptive micro-batching layer (Crankshaw et al.,
+NSDI'17 §4.3) — the standard fix for single-request scoring wasting a
+compiled model's batch throughput.  Requests enqueue into a bounded queue and
+a single flusher thread drains them in batches under two policies:
+
+- **flush-on-size**: a full ``max_batch`` flushes immediately;
+- **flush-on-deadline**: otherwise the batch flushes when the OLDEST queued
+  request has waited ``max_wait_ms`` (bounded tail latency — a lone request
+  never waits for peers that may not come).
+
+Backpressure is admission control: a full queue rejects ``submit`` with
+:class:`QueueFullError` instead of buffering unboundedly (callers shed load
+or retry with jitter).  ``shutdown(drain=True)`` stops admission, drains the
+queue in full batches with no deadline waits, and joins the flusher.
+
+Counters (submissions, rejections, batch-size histogram, queue depth, and a
+bounded latency reservoir for p50/p95/p99) export as a plain dict — the
+benchmark/CLI surface, no metrics dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+#: bounded reservoir of completed-request latencies (seconds)
+_LATENCY_WINDOW = 4096
+
+
+class QueueFullError(RuntimeError):
+    """Admission rejected: the request queue is at capacity (backpressure)."""
+
+
+class BatcherClosedError(RuntimeError):
+    """submit() after shutdown began."""
+
+
+class _Request:
+    __slots__ = ("record", "future", "t_enqueue")
+
+    def __init__(self, record: Mapping[str, Any]):
+        self.record = record
+        self.future: Future = Future()
+        self.t_enqueue = time.monotonic()
+
+
+class MicroBatcher:
+    """Bounded request queue + flusher thread over a batch scoring function.
+
+    ``score_batch`` is any ``records -> results`` callable returning one
+    result per record in order (``CompiledScoringPlan.score`` in production,
+    anything list-shaped in tests).
+    """
+
+    def __init__(self, score_batch: Callable[[List[Any]], Sequence[Any]],
+                 max_batch: int = 256, max_wait_ms: float = 2.0,
+                 max_queue: int = 4096):
+        if max_batch < 1 or max_queue < 1:
+            raise ValueError("max_batch and max_queue must be >= 1")
+        self._score = score_batch
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.max_queue = int(max_queue)
+
+        self._pending: "deque[_Request]" = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._open = True
+        self._counters = {"submitted": 0, "rejected": 0, "completed": 0,
+                          "failed": 0, "batches": 0}
+        self._batch_sizes: Dict[int, int] = {}
+        self._latencies: "deque[float]" = deque(maxlen=_LATENCY_WINDOW)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="transmogrifai-microbatcher")
+        self._thread.start()
+
+    # -- client API ----------------------------------------------------------
+    def submit(self, record: Mapping[str, Any]) -> Future:
+        """Enqueue one record; resolves to its result dict.
+
+        Raises :class:`QueueFullError` when the queue is at capacity and
+        :class:`BatcherClosedError` after shutdown began.
+        """
+        req = _Request(record)
+        with self._wake:
+            if not self._open:
+                raise BatcherClosedError("MicroBatcher is shut down")
+            if len(self._pending) >= self.max_queue:
+                self._counters["rejected"] += 1
+                raise QueueFullError(
+                    f"request queue at capacity ({self.max_queue}); "
+                    "shed load or retry")
+            self._counters["submitted"] += 1
+            self._pending.append(req)
+            self._wake.notify_all()
+        return req.future
+
+    def score(self, record: Mapping[str, Any],
+              timeout: Optional[float] = None) -> Any:
+        """Synchronous single-record convenience: submit + wait."""
+        return self.submit(record).result(timeout)
+
+    def __call__(self, record: Mapping[str, Any]) -> Any:
+        return self.score(record)
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop admission; drain (or fail) queued requests; join the flusher."""
+        with self._wake:
+            self._open = False
+            if not drain:
+                while self._pending:
+                    req = self._pending.popleft()
+                    if req.future.set_running_or_notify_cancel():
+                        req.future.set_exception(BatcherClosedError(
+                            "batcher shut down before flush"))
+                        # client-cancelled requests don't count as failed —
+                        # same accounting as the flusher's claim filter
+                        self._counters["failed"] += 1
+            self._wake.notify_all()
+        self._thread.join(timeout)
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=True)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def metrics(self) -> Dict[str, Any]:
+        """Counters as a plain dict (benchmark/CLI export surface)."""
+        with self._lock:
+            out: Dict[str, Any] = dict(self._counters)
+            out["queue_depth"] = len(self._pending)
+            out["batch_size_hist"] = {str(k): v for k, v in
+                                      sorted(self._batch_sizes.items())}
+            lats = sorted(self._latencies)
+        for q, name in ((0.50, "latency_p50_ms"), (0.95, "latency_p95_ms"),
+                        (0.99, "latency_p99_ms")):
+            out[name] = round(
+                lats[min(int(len(lats) * q), len(lats) - 1)] * 1e3, 4) \
+                if lats else None
+        out["max_batch"] = self.max_batch
+        out["max_wait_ms"] = self.max_wait_s * 1e3
+        out["max_queue"] = self.max_queue
+        return out
+
+    # -- flusher -------------------------------------------------------------
+    def _take_batch(self) -> Optional[List[_Request]]:
+        """Block until a flush condition holds; None means flusher exit."""
+        with self._wake:
+            while self._open and not self._pending:
+                self._wake.wait()  # submit()/shutdown() notify
+            if not self._pending:  # wait loop only exits empty when closed
+                return None
+            if self._open:
+                deadline = self._pending[0].t_enqueue + self.max_wait_s
+                while self._open and len(self._pending) < self.max_batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._wake.wait(remaining)
+            # shutdown drains immediately, full batches at a time
+            take = min(self.max_batch, len(self._pending))
+            return [self._pending.popleft() for _ in range(take)]
+
+    def _run(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            # claim every future before scoring: a client-side cancel() on a
+            # still-pending future would otherwise make the later
+            # set_result/set_exception raise InvalidStateError and kill the
+            # flusher thread, hanging all subsequent requests
+            batch = [r for r in batch if r.future.set_running_or_notify_cancel()]
+            if not batch:
+                continue
+            try:
+                results = self._score([r.record for r in batch])
+                if len(results) != len(batch):
+                    raise RuntimeError(
+                        f"score_batch returned {len(results)} results for "
+                        f"{len(batch)} records")
+            except Exception as e:  # noqa: BLE001 - failures go to futures
+                with self._lock:
+                    self._counters["failed"] += len(batch)
+                    self._counters["batches"] += 1
+                    size = len(batch)
+                    self._batch_sizes[size] = self._batch_sizes.get(size, 0) + 1
+                for r in batch:
+                    r.future.set_exception(e)
+                continue
+            now = time.monotonic()
+            with self._lock:
+                self._counters["completed"] += len(batch)
+                self._counters["batches"] += 1
+                size = len(batch)
+                self._batch_sizes[size] = self._batch_sizes.get(size, 0) + 1
+                for r in batch:
+                    self._latencies.append(now - r.t_enqueue)
+            for r, res in zip(batch, results):
+                r.future.set_result(res)

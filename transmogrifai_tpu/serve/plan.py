@@ -1,0 +1,547 @@
+"""CompiledScoringPlan — the fitted DAG compiled for online serving.
+
+Reference role: OpWorkflowModelLocal.scala:93-200 binds a fitted model into a
+record closure for engine-free serving (the MLeap path); Clipper (Crankshaw
+et al., NSDI'17) showed that a compiled model behind an adaptive micro-batcher
+is how that closure survives production traffic.  This port compiles the
+scoring DAG once and amortizes it across requests:
+
+1. **partition** — the topologically ordered fitted stages split into a
+   maximal *device prefix* (stages exposing the ``device_transform`` protocol
+   whose operands are reachable from raw features or other prefix stages) and
+   a *host remainder* (everything else, run through the ordinary columnar
+   ``transform`` path).
+2. **fuse** — the whole prefix traces into ONE jitted XLA program; operands
+   enter either as canonical numeric lifts (float32, NaN for missing) or via
+   per-stage host encodings (``encode_device_input``, e.g. categorical level
+   codes for the one-hot pivot).
+3. **bucket** — batches pad to power-of-two row buckets, so the jit cache
+   sees a handful of shapes instead of one per batch size; executables are
+   compiled ahead-of-time per bucket and cached process-wide keyed by
+   ``(plan fingerprint, bucket)``, where the fingerprint hashes the fitted
+   stage *content* (a different model never reuses another model's program).
+
+Padding correctness leans on the device-transform contract in stages/base.py:
+kernels are row-local, so padded rows are garbage-in/garbage-out and the plan
+slices them off before anything reads the result.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import threading
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..checkers.diagnostics import OpCheckError
+from ..data.dataset import Column, Dataset
+from ..features.feature import Feature, _NamedExtract
+from ..features.generator import FeatureGeneratorStage
+from ..types import ColumnKind, NonNullableEmptyException
+from ..workflow.dag import compute_dag
+from ..workflow.fit import _resolve
+
+#: kinds with a canonical device lift: float32 rows, NaN where the validity
+#: mask is off.  VECTOR is deliberately absent — a raw vector column's width
+#: is only known from the data, which defeats bucket compilation (TM503).
+DEVICE_LIFT_KINDS = frozenset(
+    {ColumnKind.FLOAT, ColumnKind.INT, ColumnKind.BOOL, ColumnKind.GEO})
+
+#: process-wide AOT executable cache: (plan fingerprint, bucket) -> compiled.
+#: Bounded FIFO — serving processes host a handful of live models, not many.
+_EXEC_CACHE: Dict[Tuple[str, int], Any] = {}
+_EXEC_CACHE_MAX = 64
+_EXEC_CACHE_LOCK = threading.Lock()
+
+#: unique fingerprints for plans whose stage state cannot be hashed
+_UNSHARED_TOKENS = itertools.count()
+
+
+def device_slots(runner) -> Tuple[int, ...]:
+    """Input slots a runner's ``device_transform`` consumes (default: all)."""
+    slots = getattr(runner, "device_input_slots", None)
+    if slots is None:
+        return tuple(range(len(runner.inputs)))
+    return tuple(slots)
+
+
+def resolve_scoring_stages(result_features: Sequence[Feature],
+                           fitted: Mapping[str, Any]):
+    """Topologically ordered fitted runners for the scoring path.
+
+    Raises ValueError when an estimator has no fitted model (the condition
+    the TM501 servability diagnostic reports ahead of time).
+    """
+    runners = []
+    for layer in compute_dag(result_features):
+        for stage in layer:
+            runner = _resolve(stage, dict(fitted))
+            if runner is None:
+                raise ValueError(
+                    f"[TM501] Stage {stage.uid} is an unfitted estimator; "
+                    "cannot compile a scoring plan")
+            runners.append(runner)
+    return runners
+
+
+def partition_scoring_stages(runners: Sequence[Any]):
+    """Split topo-ordered runners into (device prefix, host remainder).
+
+    A runner joins the prefix when it exposes ``device_transform`` and every
+    device-slot input is either another prefix output, or a raw feature with
+    a canonical lift / stage-provided encoding.  Returns
+    ``(prefix, remainder, device_uids)`` with ``device_uids`` the feature
+    uids materialized on device.
+    """
+    device_uids: set = set()
+    prefix: List[Any] = []
+    remainder: List[Any] = []
+    for runner in runners:
+        fn = getattr(runner, "device_transform", None)
+        ok = callable(fn) and len(runner.inputs) > 0
+        if ok:
+            for slot in device_slots(runner):
+                f = runner.inputs[slot]
+                if f.uid in device_uids:
+                    continue
+                if isinstance(f.origin_stage, FeatureGeneratorStage) and (
+                        f.ftype.kind in DEVICE_LIFT_KINDS
+                        or runner.device_lifts_input(slot)):
+                    continue
+                ok = False
+                break
+        if ok:
+            prefix.append(runner)
+            device_uids.add(runner.get_output().uid)
+        else:
+            remainder.append(runner)
+    return prefix, remainder, device_uids
+
+
+def _bucket_for(n: int, min_bucket: int, max_bucket: int) -> int:
+    b = max(int(min_bucket), 1 << max(0, (int(n) - 1)).bit_length())
+    return min(b, max_bucket)
+
+
+def _pad_rows(arr: np.ndarray, bucket: int) -> np.ndarray:
+    n = arr.shape[0]
+    if n == bucket:
+        return arr
+    pad = np.zeros((bucket - n,) + arr.shape[1:], dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def _lift_builder(gen: FeatureGeneratorStage) -> Callable:
+    """records -> canonical float32 device operand for a raw numeric/geo
+    feature, mirroring extract -> Column.from_values -> values_f64 exactly
+    (conversion and non-nullable checks included) minus the per-value
+    FeatureType/Column object construction."""
+    ftype = gen.ftype
+    conv = ftype._convert
+    nullable = ftype.is_nullable
+    fn = gen.extract_fn
+    key = fn.key if isinstance(fn, _NamedExtract) else None
+    name = gen.raw_name
+
+    def extract(records):
+        if key is not None:
+            try:  # dict records: direct field reads, no wrapper frame
+                return [r.get(key) for r in records]
+            except AttributeError:
+                pass
+        return [fn(r) for r in records]
+
+    if ftype.kind is ColumnKind.GEO:
+        def build_geo(records):
+            out = np.zeros((len(records), 3), dtype=np.float32)
+            for i, v in enumerate(extract(records)):
+                v = conv(v)
+                if v is not None and len(v) == 3:
+                    out[i] = v
+            return out
+        return build_geo
+
+    def build(records):
+        vals = extract(records)
+        if None in vals:  # C-level scan; missing values are the rare case
+            if not nullable:
+                raise NonNullableEmptyException(
+                    f"{ftype.__name__} feature {name!r} cannot be empty")
+            vals = [np.nan if v is None else v for v in vals]
+        try:
+            out = np.asarray(vals, dtype=np.float32)
+        except (TypeError, ValueError):
+            # unusual payloads (FeatureType wrappers, decimals, ...): the
+            # ftype's own conversion decides, with its own error messages
+            return np.asarray([np.nan if (c := conv(v)) is None else c
+                               for v in vals], dtype=np.float32)
+        if str in set(map(type, vals)):  # np parses "1.2"; the typed path
+            for v in vals:               # must reject it instead
+                conv(v)
+        return out
+    return build
+
+
+def _light_column(gen: FeatureGeneratorStage, records) -> Column:
+    """Object-array column for encoder-only inputs: plain extraction, no
+    per-value FeatureType/Column conversion.  str/None values are exactly
+    what the full path produces (Text kinds pass them through); anything
+    else is rejected by the consuming encoder via the ftype's _convert."""
+    fn = gen.extract_fn
+    raw = None
+    if isinstance(fn, _NamedExtract):
+        try:
+            raw = [r.get(fn.key) for r in records]
+        except AttributeError:
+            raw = None
+    if raw is None:
+        raw = [fn(r) for r in records]
+    return Column(gen.ftype, np.array(raw, dtype=object))
+
+
+class CompiledScoringPlan:
+    """Fitted workflow model compiled into a bucketed fused scoring program.
+
+    ``plan.score(records)`` is the batch entry point (the MicroBatcher's
+    flush function); output is the same ``Map[String,Any]`` per record that
+    ``LocalScorer.batch`` produces — the two paths agree bitwise for plans
+    whose prefix stages are selection/scatter kernels (see docs/serving.md).
+    """
+
+    def __init__(self, model, min_bucket: int = 8, max_bucket: int = 1024,
+                 strict: bool = True):
+        if max_bucket < min_bucket or min_bucket < 1:
+            raise ValueError(f"bad bucket range [{min_bucket}, {max_bucket}]")
+        # round both ends up to powers of two: every bucket score() can pick
+        # must be one warm() compiles, or the compile-once guarantee breaks
+        self.min_bucket = 1 << (int(min_bucket) - 1).bit_length()
+        self.max_bucket = 1 << (int(max_bucket) - 1).bit_length()
+        self._model = model
+        self.result_features: List[Feature] = list(model.result_features)
+
+        if strict:
+            from .validator import check_servability
+
+            report = check_servability(self.result_features,
+                                       fitted=model.fitted)
+            if report.errors():
+                raise OpCheckError(report)
+
+        self._runners = resolve_scoring_stages(self.result_features,
+                                               model.fitted)
+        self._prefix, self._remainder, self._device_uids = \
+            partition_scoring_stages(self._runners)
+
+        self._generators = self._collect_generators()
+        self._build_entries()
+        self._build_wiring()
+        self._fingerprint = self._compute_fingerprint()
+
+        self._executables: Dict[int, Any] = {}
+        self.compile_count = 0
+        self._counters = {"scored_records": 0, "scored_batches": 0,
+                          "bucket_batches": {}}
+        self._lock = threading.Lock()
+        # serializes bucket compilation: concurrent score paths (batcher
+        # flusher + direct score_batch callers) must not compile the same
+        # bucket twice nor race the compile_count probe
+        self._compile_lock = threading.Lock()
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def fingerprint(self) -> str:
+        return self._fingerprint
+
+    @property
+    def device_stage_uids(self) -> List[str]:
+        return [s.uid for s in self._prefix]
+
+    @property
+    def host_stage_uids(self) -> List[str]:
+        return [s.uid for s in self._remainder]
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = {k: (dict(v) if isinstance(v, dict) else v)
+                        for k, v in self._counters.items()}
+        with self._compile_lock:  # don't race an in-flight bucket compile
+            compile_count = self.compile_count
+            buckets = sorted(self._executables)
+        counters.update({
+            "compile_count": compile_count,
+            "buckets_compiled": buckets,
+            "fused_stages": len(self._prefix),
+            "host_stages": len(self._remainder),
+        })
+        return counters
+
+    # -- construction helpers ------------------------------------------------
+    def _collect_generators(self) -> List[FeatureGeneratorStage]:
+        seen: Dict[str, FeatureGeneratorStage] = {}
+        for f in self.result_features:
+            for raw in f.raw_features():
+                st = raw.origin_stage
+                if isinstance(st, FeatureGeneratorStage):
+                    seen.setdefault(st.uid, st)
+        return list(seen.values())
+
+    def _build_entries(self) -> None:
+        """Entry operand table for the fused program.
+
+        Entries are either ``("lift", feature_uid)`` — the canonical float32
+        lift of a raw numeric/geo feature, shared by every consumer — or
+        ``("enc", stage_uid, slot)`` — a stage-specific host encoding (each
+        encoding stage owns its view of the raw column).
+        """
+        by_uid = {g.get_output().uid: g for g in self._generators}
+        entry_keys: List[tuple] = []
+        entry_index: Dict[tuple, int] = {}  # key -> position in entry_keys
+        self._entry_specs: List[Tuple[tuple, str]] = []
+        self._entry_lifts: Dict[tuple, Callable] = {}
+        self._entry_encoders: Dict[tuple, Tuple[Any, int, str]] = {}
+        self._slot_sources: Dict[Tuple[str, int], tuple] = {}
+
+        for runner in self._prefix:
+            for slot in device_slots(runner):
+                f = runner.inputs[slot]
+                if f.uid in self._device_uids:
+                    self._slot_sources[(runner.uid, slot)] = ("env", f.uid)
+                    continue
+                gen = by_uid[f.uid]
+                if f.ftype.kind in DEVICE_LIFT_KINDS \
+                        and not runner.device_lifts_input(slot):
+                    key = ("lift", f.uid)
+                    if key not in entry_index:  # shared lifts dedup by uid
+                        entry_index[key] = len(entry_keys)
+                        entry_keys.append(key)
+                        self._entry_lifts[key] = _lift_builder(gen)
+                        trailing = (3,) if f.ftype.kind is ColumnKind.GEO \
+                            else ()
+                        self._entry_specs.append((trailing, "float32"))
+                else:
+                    key = ("enc", runner.uid, slot)
+                    entry_index[key] = len(entry_keys)
+                    entry_keys.append(key)
+                    self._entry_encoders[key] = (runner, slot, gen.raw_name)
+                    trailing, dtype = runner.device_input_spec(slot)
+                    self._entry_specs.append((tuple(trailing), dtype))
+                self._slot_sources[(runner.uid, slot)] = \
+                    ("entry", entry_index[key])
+        self._entry_keys = entry_keys
+
+    def _build_wiring(self) -> None:
+        """Flatten the prefix into (runner, operand sources, out uid) rows and
+        pick which device outputs must materialize back to host columns."""
+        self._wiring: List[Tuple[Any, List[tuple], str]] = []
+        for runner in self._prefix:
+            srcs = [self._slot_sources[(runner.uid, slot)]
+                    for slot in device_slots(runner)]
+            self._wiring.append((runner, srcs, runner.get_output().uid))
+
+        needed: Dict[str, Feature] = {}
+        for runner in self._remainder:
+            for f in runner.inputs:
+                if f.uid in self._device_uids:
+                    needed.setdefault(f.uid, f)
+        for f in self.result_features:
+            if f.uid in self._device_uids:
+                needed.setdefault(f.uid, f)
+        self._out_features = list(needed.values())
+        self._out_uids = [f.uid for f in self._out_features]
+
+        # raw host columns the host path still needs: remainder-stage inputs
+        # and raw result features (the label column, when supplied)
+        host_needed: Dict[str, FeatureGeneratorStage] = {}
+        for runner in self._remainder:
+            for f in runner.inputs:
+                st = f.origin_stage
+                if isinstance(st, FeatureGeneratorStage):
+                    host_needed.setdefault(f.name, st)
+        for f in self.result_features:
+            st = f.origin_stage
+            if isinstance(st, FeatureGeneratorStage):
+                host_needed.setdefault(f.name, st)
+        self._host_raw = list(host_needed.items())
+        # encoder inputs not otherwise needed on host skip the full
+        # Column.from_values conversion — a light object column suffices
+        self._encoder_light: Dict[str, FeatureGeneratorStage] = {}
+        for runner, slot, raw_name in self._entry_encoders.values():
+            if raw_name not in host_needed:
+                self._encoder_light[raw_name] = next(
+                    g for g in self._generators if g.raw_name == raw_name)
+
+    def _fused(self, *entries):
+        env: Dict[str, Any] = {}
+        for runner, srcs, out_uid in self._wiring:
+            ops = [env[key] if tag == "env" else entries[key]
+                   for tag, key in srcs]
+            env[out_uid] = runner.device_transform(*ops)
+        return tuple(env[u] for u in self._out_uids)
+
+    def _compute_fingerprint(self) -> str:
+        """Content hash of the fused program: prefix stage state + wiring.
+
+        Two plans with equal fingerprints trace to identical XLA programs
+        (stage constants are baked into the trace), so the process-wide
+        executable cache may share compilations between them.
+        """
+        from ..stages.base import Estimator
+        from ..workflow.serde import _Encoder, encode_stage
+
+        enc = _Encoder()
+        try:
+            payload = {
+                "stages": [encode_stage(s, enc, full=not isinstance(s, Estimator))
+                           for s in self._prefix],
+                "entries": [list(k) for k in self._entry_keys],
+                "specs": [[list(t), d] for t, d in self._entry_specs],
+                "outs": self._out_uids,
+            }
+            h = hashlib.sha256(
+                json.dumps(payload, sort_keys=True, default=repr).encode())
+            for key in sorted(enc.arrays):
+                arr = np.ascontiguousarray(enc.arrays[key])
+                h.update(f"{key}:{arr.shape}:{arr.dtype}".encode())
+                h.update(arr.tobytes())
+            return h.hexdigest()
+        except Exception:
+            # non-serializable stage state: no cross-plan sharing, the plan
+            # still caches its own executables under a token no other plan
+            # can ever produce (a process counter — NOT id(), whose values
+            # recycle after GC and would let a later plan inherit a dead
+            # plan's executables from the process-wide cache)
+            return f"unshared-{next(_UNSHARED_TOKENS)}"
+
+    # -- compilation ---------------------------------------------------------
+    def _ensure_compiled(self, bucket: int):
+        compiled = self._executables.get(bucket)
+        if compiled is not None:
+            return compiled
+        with self._compile_lock:
+            compiled = self._executables.get(bucket)  # lost the race: done
+            if compiled is not None:
+                return compiled
+            key = (self._fingerprint, bucket)
+            with _EXEC_CACHE_LOCK:
+                compiled = _EXEC_CACHE.get(key)
+            if compiled is None:
+                import jax
+
+                specs = [jax.ShapeDtypeStruct((bucket,) + trailing,
+                                              np.dtype(dtype))
+                         for trailing, dtype in self._entry_specs]
+                compiled = jax.jit(self._fused).lower(*specs).compile()
+                self.compile_count += 1
+                with _EXEC_CACHE_LOCK:
+                    _EXEC_CACHE[key] = compiled
+                    while len(_EXEC_CACHE) > _EXEC_CACHE_MAX:
+                        _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
+            self._executables[bucket] = compiled
+        return compiled
+
+    def warm(self, buckets: Optional[Sequence[int]] = None) -> "CompiledScoringPlan":
+        """Pre-compile executables for ``buckets`` (default: every power of
+        two in [min_bucket, max_bucket]) so first requests never pay XLA."""
+        if not self._prefix:
+            return self
+        if buckets is None:
+            buckets, b = [], self.min_bucket
+            while b <= self.max_bucket:
+                buckets.append(b)
+                b *= 2
+        for b in buckets:
+            self._ensure_compiled(_bucket_for(b, self.min_bucket,
+                                              self.max_bucket))
+        return self
+
+    # -- scoring -------------------------------------------------------------
+    def score(self, records: Sequence[Mapping[str, Any]]) -> List[Dict[str, Any]]:
+        """Batch scoring: fused device prefix + host remainder.
+
+        Output contract is identical to ``LocalScorer.batch``: one plain
+        ``{result feature name: python value}`` dict per input record.
+        """
+        n = len(records)
+        if n == 0:
+            return []
+        if n > self.max_bucket:
+            out: List[Dict[str, Any]] = []
+            for i in range(0, n, self.max_bucket):
+                out.extend(self.score(records[i:i + self.max_bucket]))
+            return out
+
+        from ..readers.base import extract_columns
+
+        host_cols = extract_columns(records, self._host_raw,
+                                    allow_missing_response=True)
+
+        cols: Dict[str, Column] = dict(host_cols)
+        if self._prefix:
+            enc_cols = dict(host_cols)
+            for raw_name, gen in self._encoder_light.items():
+                enc_cols[raw_name] = _light_column(gen, records)
+            entries = []
+            for key in self._entry_keys:
+                if key[0] == "lift":
+                    entries.append(self._entry_lifts[key](records))
+                else:
+                    runner, slot, raw_name = self._entry_encoders[key]
+                    col = enc_cols.get(raw_name)
+                    if col is None:  # a response-typed encoder input only
+                        raise ValueError(
+                            f"raw feature {raw_name!r} is required by "
+                            f"{runner.uid} but absent from the records")
+                    entries.append(np.asarray(
+                        runner.encode_device_input(slot, col)))
+            bucket = _bucket_for(n, self.min_bucket, self.max_bucket)
+            compiled = self._ensure_compiled(bucket)
+            outs = compiled(*[_pad_rows(a, bucket) for a in entries])
+            for f, dev in zip(self._out_features, outs):
+                cols[f.name] = self._materialize(f, np.asarray(dev)[:n])
+
+        ds = Dataset(cols)
+        for runner in self._remainder:
+            ds = runner.transform(ds)
+
+        from ..local.scoring import _plain
+        from ..models.prediction import PredictionColumn
+
+        out = [{} for _ in records]
+        for f in self.result_features:
+            if f.name not in ds:
+                continue
+            col = ds[f.name]
+            name = f.name
+            if isinstance(col, PredictionColumn):
+                # already {str: float} dicts — no per-value conversion needed
+                for row, v in zip(out, col.to_values()):
+                    row[name] = v
+            else:
+                for row, v in zip(out, col.to_values()):
+                    row[name] = _plain(v)
+        with self._lock:
+            self._counters["scored_records"] += n
+            self._counters["scored_batches"] += 1
+            if self._prefix:
+                bb = self._counters["bucket_batches"]
+                bb[bucket] = bb.get(bucket, 0) + 1
+        return out
+
+    @staticmethod
+    def _materialize(f: Feature, arr: np.ndarray) -> Column:
+        if f.ftype.kind is ColumnKind.VECTOR:
+            return Column.vector(arr)
+        if f.ftype.kind in (ColumnKind.FLOAT, ColumnKind.INT, ColumnKind.BOOL):
+            return Column(f.ftype, arr.astype(np.float64),
+                          np.ones(arr.shape[0], dtype=np.bool_))
+        return Column(f.ftype, arr)
+
+
+def compile_plan(model, min_bucket: int = 8, max_bucket: int = 1024,
+                 strict: bool = True) -> CompiledScoringPlan:
+    """Compile a fitted WorkflowModel for online serving."""
+    return CompiledScoringPlan(model, min_bucket=min_bucket,
+                               max_bucket=max_bucket, strict=strict)

@@ -1,0 +1,73 @@
+"""ScoringServer — the in-process online scoring engine.
+
+Reference role: the reference serves fitted models through MLeap behind a
+request loop; this port's equivalent is a compiled plan (serve/plan.py)
+behind a micro-batcher (serve/batcher.py), exposed as a plain in-process
+object — no HTTP, no stdio protocol — so any transport (gRPC handler, WSGI
+view, queue consumer) can embed it.  ``cli serve`` drives the same API from
+the command line for smoke runs and benchmarks.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+from .batcher import MicroBatcher
+from .plan import CompiledScoringPlan
+
+
+class ScoringServer:
+    """Compiled plan + micro-batcher with a merged metrics surface.
+
+    - ``submit(record) -> Future`` — asynchronous, micro-batched (the
+      production request path; rejects with QueueFullError under pressure).
+    - ``score(record)`` — synchronous convenience over ``submit``.
+    - ``score_batch(records)`` — bypasses the queue straight into the plan
+      (bulk/offline callers that already hold a batch).
+    - ``metrics()`` — plan + batcher counters as one plain dict.
+    """
+
+    def __init__(self, model, max_batch: int = 256, max_wait_ms: float = 2.0,
+                 max_queue: int = 4096, min_bucket: int = 8,
+                 max_bucket: Optional[int] = None, warm: bool = True):
+        if max_bucket is None:
+            # every flushed batch must fit one bucket, so a single fused call
+            # serves the largest flush the batcher can produce
+            max_bucket = max(1 << (max(max_batch, 1) - 1).bit_length(),
+                             min_bucket)
+        self.plan = CompiledScoringPlan(model, min_bucket=min_bucket,
+                                        max_bucket=max_bucket)
+        if warm:
+            self.plan.warm()
+        self.batcher = MicroBatcher(self.plan.score, max_batch=max_batch,
+                                    max_wait_ms=max_wait_ms,
+                                    max_queue=max_queue)
+
+    # -- request paths -------------------------------------------------------
+    def submit(self, record: Mapping[str, Any]) -> Future:
+        return self.batcher.submit(record)
+
+    def score(self, record: Mapping[str, Any],
+              timeout: Optional[float] = None) -> Dict[str, Any]:
+        return self.batcher.score(record, timeout=timeout)
+
+    def score_batch(self, records: Sequence[Mapping[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+        return self.plan.score(records)
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        self.batcher.shutdown(drain=drain, timeout=timeout)
+
+    def __enter__(self) -> "ScoringServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- observability -------------------------------------------------------
+    def metrics(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"plan": self.plan.metrics(),
+                               "batcher": self.batcher.metrics()}
+        return out

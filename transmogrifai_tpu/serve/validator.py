@@ -1,0 +1,104 @@
+"""Servability validator — TM5xx diagnostics for the compiled scoring path.
+
+Reference role: OpWorkflowModelLocal refuses stages it cannot convert at
+load time rather than failing mid-request; this port folds the same guarantee
+into the opcheck diagnostic system (checkers/diagnostics.py) so serving
+hazards surface from ``workflow.validate(serving=True)``, ``cli lint
+--serving``, and ``CompiledScoringPlan`` construction with stable codes:
+
+- **TM501** (error): an estimator in the scoring path has no fitted model —
+  the plan cannot transform at request time.  Only reported when a ``fitted``
+  mapping is supplied (an untrained Workflow is legitimately all-estimators).
+- **TM502** (warning): a stage without ``device_transform`` consumes a
+  device-capable stage's output AND feeds a device-capable consumer — the
+  fused prefix must stop, round-trip through host, and re-upload.
+- **TM503** (warning): a raw feature whose device width is only known from
+  the data (an OPVector column) feeds a device-capable stage; padding buckets
+  amortize the row axis only, so every new width forces a recompile and the
+  planner keeps such consumers on host.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+from ..checkers.diagnostics import Diagnostic, DiagnosticReport, make_diagnostic
+from ..features.feature import Feature
+from ..features.generator import FeatureGeneratorStage
+from ..stages.base import Estimator
+from ..types import ColumnKind
+
+
+def check_servability(result_features: Sequence[Feature],
+                      fitted: Optional[Mapping[str, Any]] = None
+                      ) -> DiagnosticReport:
+    """Run the TM5xx analyzers over the DAG reached from ``result_features``.
+
+    ``fitted`` (uid -> fitted transformer) switches the validator into
+    scoring-path mode: estimators resolve to their models and missing models
+    become TM501 errors.
+    """
+    from ..workflow.dag import all_stages
+    from .plan import device_slots, partition_scoring_stages
+
+    report = DiagnosticReport()
+    stages = all_stages(result_features)
+
+    # resolve each DAG stage to what would actually run at request time
+    resolved: List[Any] = []
+    for st in stages:
+        runner = fitted.get(st.uid) if fitted is not None else None
+        if runner is None:
+            if fitted is not None and isinstance(st, Estimator):
+                report.extend([make_diagnostic(
+                    "TM501",
+                    f"estimator {type(st).__name__} ({st.uid}) has no fitted "
+                    "model in the scoring path",
+                    stage_uid=st.uid)])
+            runner = st
+        resolved.append(runner)
+
+    _prefix, remainder, device_uids = partition_scoring_stages(resolved)
+
+    # TM502 — host stage sandwiched between device-capable stages
+    consumers: Dict[str, List[Any]] = {}
+    for r in resolved:
+        for f in r.inputs:
+            consumers.setdefault(f.uid, []).append(r)
+    for r in remainder:
+        takes_device = any(f.uid in device_uids for f in r.inputs)
+        if not takes_device:
+            continue
+        out_uid = r.get_output().uid
+        feeds_device = any(
+            callable(getattr(c, "device_transform", None))
+            for c in consumers.get(out_uid, ()))
+        if feeds_device:
+            report.extend([make_diagnostic(
+                "TM502",
+                f"{type(r).__name__} ({r.uid}) has no device_transform but "
+                "sits between device-capable stages; the fused scoring "
+                "prefix breaks here and pays a device->host->device "
+                "round-trip per batch",
+                stage_uid=r.uid)])
+
+    # TM503 — data-dependent device width entering the compiled path
+    seen_raw: set = set()
+    for r in resolved:
+        if not callable(getattr(r, "device_transform", None)):
+            continue
+        for slot in device_slots(r):
+            if slot >= len(r.inputs):
+                continue
+            f = r.inputs[slot]
+            if not isinstance(f.origin_stage, FeatureGeneratorStage):
+                continue
+            if f.ftype.kind is ColumnKind.VECTOR and f.uid not in seen_raw:
+                seen_raw.add(f.uid)
+                report.extend([make_diagnostic(
+                    "TM503",
+                    f"raw feature {f.name!r} is an OPVector whose width is "
+                    f"only known from the data; {type(r).__name__} ({r.uid}) "
+                    "cannot join the bucketed fused prefix and stays on host",
+                    stage_uid=r.uid)])
+    return report

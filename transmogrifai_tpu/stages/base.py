@@ -254,11 +254,52 @@ class Transformer(PipelineStage):
     ``transform_columns`` as a traceable function of the input blocks.  The
     static validator (checkers/opcheck.py) abstractly evaluates it with
     ``jax.eval_shape`` on zero-cost shape/dtype specs, catching shape and
-    dtype incompatibilities before any data is touched; it is also the seam a
-    layer fuser can jit into a single XLA program.
+    dtype incompatibilities before any data is touched; it is also the seam
+    the serving compiler (serve/plan.py) jits into a single XLA program.
+
+    Device-transform contract (what the serving fuser relies on):
+
+    - **row-local**: output row ``i`` depends only on input rows ``i`` — the
+      fused plan pads batches to a power-of-two bucket and slices the result,
+      so padding rows must not bleed into real rows (no cross-row reductions).
+    - **static shape**: the output's trailing shape is a function of the
+      fitted stage state only, never of the batch's values — padding buckets
+      only amortize the *row* dimension.
+    - operands arrive as the canonical device lift of each input column
+      (numeric kinds: float32 with NaN for missing; vector/geo kinds: the
+      float32 block) unless the stage overrides ``encode_device_input``.
     """
 
     is_model: bool = False  # True when produced by an Estimator.fit
+
+    #: input slots ``device_transform`` consumes, in operand order; ``None``
+    #: means all inputs.  Stages with an optional label slot (e.g.
+    #: SanityCheckerModel) restrict to the slots read at scoring time.
+    device_input_slots: Optional[Tuple[int, ...]] = None
+
+    def device_lifts_input(self, slot: int) -> bool:
+        """True when this stage lifts host-kind input ``slot`` to a device
+        operand itself via :meth:`encode_device_input` (e.g. a categorical
+        pivot encoding text to int32 level codes).  Numeric/vector/geo kinds
+        lift by the default rule and need no stage support."""
+        return False
+
+    def encode_device_input(self, slot: int, col: "Column"):
+        """Host column -> device operand ndarray for input ``slot``.
+
+        Only called when :meth:`device_lifts_input` returns True for the
+        slot.  The returned array's leading axis is the row axis (so the
+        serving fuser can pad it to the batch bucket)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} declares no device encoding for slot {slot}")
+
+    def device_input_spec(self, slot: int):
+        """(trailing_shape, dtype_str) of the encoded operand for ``slot``.
+
+        Used to build zero-cost ShapeDtypeStructs for ahead-of-time bucket
+        compilation; the default matches ``encode_device_input`` emitting one
+        int32 code per row."""
+        return (), "int32"
 
     def transform_columns(self, cols: List["Column"], dataset: "Dataset") -> "Column":
         raise NotImplementedError

@@ -30,7 +30,10 @@ from .dag import all_stages
 FORMAT_VERSION = 1
 
 _SKIP_ATTRS = {"_param_values", "_input_features", "_output_feature",
-               "operation_name", "uid"}
+               "operation_name", "uid",
+               # runtime-only serving caches (rebuilt on demand; excluding
+               # them also keeps serve-plan fingerprints stable as they fill)
+               "_code_memos"}
 
 
 class _Encoder:
@@ -293,6 +296,7 @@ def save_model(model, path: str) -> None:
         "versionInfo": version_info(),  # build provenance (VersionInfo.scala role)
         "resultFeatureUids": [f.uid for f in model.result_features],
         "blacklist": list(model.blacklist),
+        "workflowCv": bool(getattr(model, "workflow_cv", False)),
         "features": [
             {
                 "uid": f.uid,
@@ -390,4 +394,5 @@ def load_model(path: str):
         result_features=result_features,
         fitted=fitted,
         blacklist=manifest.get("blacklist", []),
+        workflow_cv=manifest.get("workflowCv", False),
     )

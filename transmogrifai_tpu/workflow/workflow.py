@@ -96,7 +96,7 @@ class Workflow:
                 raise ValueError(f"[TM102] Duplicate stage uid in DAG: {stage.uid}")
             seen_uids[stage.uid] = stage
 
-    def validate(self) -> "DiagnosticReport":
+    def validate(self, serving: bool = False) -> "DiagnosticReport":
         """Static pre-execution validation — runs WITHOUT touching data.
 
         Walks the DAG reached from the result features through every opcheck
@@ -105,11 +105,17 @@ class Workflow:
         checking goes through ``jax.eval_shape`` on ``ShapeDtypeStruct`` specs,
         so no device buffer is ever allocated.  See docs/static_analysis.md
         for the diagnostic code table.
+
+        ``serving=True`` adds the TM5xx servability analyzers (host
+        round-trips splitting the fused scoring prefix, unbounded shapes
+        defeating padding buckets); unfitted-estimator TM501 checks need a
+        fitted model — use :meth:`WorkflowModel.validate` for those.
         """
         from ..checkers.opcheck import validate_result_features
 
         return validate_result_features(self.result_features,
-                                        workflow_cv=self._workflow_cv)
+                                        workflow_cv=self._workflow_cv,
+                                        serving=serving)
 
     # -- data ----------------------------------------------------------------
     def raw_features(self) -> List[Feature]:
@@ -254,6 +260,7 @@ class Workflow:
             fitted=fitted,
             blacklist=blacklist,
             rff_summary=rff_summary,
+            workflow_cv=self._workflow_cv,
         )
         # the fitted model inherits the workflow's reader (reference: OpWorkflowModel
         # shares OpWorkflowCore state); override with set_reader for a scoring source
@@ -270,11 +277,15 @@ class WorkflowModel:
     """A fitted workflow: score/evaluate/save, summaries and insights."""
 
     def __init__(self, result_features: Sequence[Feature], fitted: Dict[str, Transformer],
-                 blacklist: Sequence[str] = (), rff_summary=None):
+                 blacklist: Sequence[str] = (), rff_summary=None,
+                 workflow_cv: bool = False):
         self.result_features = list(result_features)
         self.fitted = dict(fitted)
         self.blacklist = list(blacklist)
         self.rff_summary = rff_summary
+        #: whether the producing workflow re-fit label-dependent stages per
+        #: fold (with_workflow_cv) — validate() suppresses TM402 when so
+        self.workflow_cv = workflow_cv
         self._reader = None
 
     def set_reader(self, reader) -> "WorkflowModel":
@@ -391,6 +402,41 @@ class WorkflowModel:
         from ..local.scoring import score_function
 
         return score_function(self)
+
+    # -- serving (serve/, docs/serving.md) -----------------------------------
+    def validate(self, serving: bool = True) -> "DiagnosticReport":
+        """Static validation of the FITTED model, scoring-path aware.
+
+        Same analyzer suite as :meth:`Workflow.validate` but estimators
+        resolve through the fitted models, so a missing fit is a TM501
+        error and the TM502/TM503 servability analyzers see the stages that
+        will actually run at request time.
+        """
+        from ..checkers.opcheck import validate_result_features
+
+        return validate_result_features(self.result_features,
+                                        workflow_cv=self.workflow_cv,
+                                        serving=serving, fitted=self.fitted)
+
+    def serving_plan(self, min_bucket: int = 8, max_bucket: int = 1024,
+                     strict: bool = True):
+        """Compile this model for online scoring
+        (:class:`~transmogrifai_tpu.serve.CompiledScoringPlan`): maximal
+        jit-fused device prefix + host remainder, specialized per
+        power-of-two padding bucket."""
+        from ..serve import compile_plan
+
+        return compile_plan(self, min_bucket=min_bucket,
+                            max_bucket=max_bucket, strict=strict)
+
+    def serve(self, **kwargs):
+        """In-process scoring server over this model
+        (:class:`~transmogrifai_tpu.serve.ScoringServer`): compiled plan
+        behind a Clipper-style micro-batcher.  Close it (or use as a context
+        manager) to drain the request queue cleanly."""
+        from ..serve import ScoringServer
+
+        return ScoringServer(self, **kwargs)
 
     # -- persistence ---------------------------------------------------------
     def save(self, path: str) -> None:
