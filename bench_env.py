@@ -9,14 +9,12 @@ set explicitly post-import.
 
 import os
 
-_CACHE_DIR = os.path.expanduser("~/.cache/transmogrifai_tpu/xla")
+_CACHE_DIR = os.path.expanduser(
+    os.environ.get("TMOG_XLA_CACHE_DIR", "~/.cache/transmogrifai_tpu/xla"))
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
 
-import jax  # noqa: E402
+# the perf package owns the persistent-cache wiring (idempotent; honors
+# TMOG_PERSISTENT_CACHE=0); importing it registers the compile probe too
+from transmogrifai_tpu.perf import enable_persistent_cache  # noqa: E402
 
-try:
-    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-except Exception:  # pragma: no cover - older jax without these knobs
-    pass
+enable_persistent_cache(_CACHE_DIR)

@@ -34,3 +34,26 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _compile_budget_report():
+    """Print the suite-wide compile budget at session end: total backend
+    compiles/seconds and the sweep executable-cache hit rate.  The sweep
+    cache is process-wide, so test modules fitting same-bucket sweeps share
+    warm executables — the hit counters make that visible per run."""
+    yield
+    try:
+        from transmogrifai_tpu.perf import compile_snapshot, \
+            program_cache_stats
+
+        snap = compile_snapshot()
+        prog = program_cache_stats()
+        sys.stderr.write(
+            f"\n[perf] suite compile budget: {snap.backend_compiles} backend "
+            f"compiles, {snap.compile_seconds:.1f}s compiling; sweep "
+            f"executable cache: {prog['programs_compiled']} compiled, "
+            f"{prog['cache_hits']} hits, "
+            f"{snap.persistent_cache_hits} persistent-cache hits\n")
+    except Exception:
+        pass

@@ -213,6 +213,8 @@ class TestAvscCli:
         assert '"x": "Real"' in main_py
         assert '"id": "ID"' in main_py
 
+    @pytest.mark.slow  # full generated-project train; Avro reading is
+    # covered by the reader tests above, CLI train by test_runner_cli
     def test_gen_from_avro_input_trains(self, tmp_path):
         """gen --input data.avro: the generated project reads Avro through
         DataReaders.Simple.avro and trains end-to-end."""

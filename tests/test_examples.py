@@ -20,6 +20,8 @@ class TestExamples:
         assert 0.80 <= metrics["auPR"] <= 0.99, metrics["auPR"]
         assert metrics["auROC"] > 0.85
 
+    @pytest.mark.slow  # example-app train; multiclass selector training
+    # is covered in tier-1 by test_trees.py::TestMulticlass
     def test_iris_app_train_and_score(self, tmp_path):
         from iris_app import OpIris
 
@@ -43,6 +45,8 @@ class TestExamples:
                               "--write-location", str(tmp_path / "scores")])
         assert res2.run_type.value == "score"
 
+    @pytest.mark.slow  # example-app train; regression selector training
+    # is covered in tier-1 by test_models_selector.py
     def test_boston_app_train(self, tmp_path):
         from boston_app import OpBoston
 

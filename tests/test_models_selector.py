@@ -339,6 +339,9 @@ class TestTwoClassUnderMulticlassSelector:
     tree families (binary fast paths emit 1-D payloads; multiclass_error accepts
     them)."""
 
+    @pytest.mark.slow  # full multiclass default-grid sweep (~35s); the
+    # binary-payload-under-multiclass-metric finiteness invariant is
+    # pinned in tier-1 by test_trees.py::TestMulticlass
     def test_all_families_finite(self):
         from transmogrifai_tpu.models.selector import MultiClassificationModelSelector
 

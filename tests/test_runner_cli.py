@@ -231,6 +231,8 @@ class TestCliGen:
                       "x": range(90)}).to_csv(csv, index=False)
         assert detect_problem_kind(csv, "y").value == "multiclass"
 
+    @pytest.mark.slow  # full generated-project train; the e2e CLI train
+    # path is covered in tier-1 by test_generate_and_run_project
     def test_string_label_project_trains(self, tmp_path):
         """String-labeled response: generator must label-encode, not crash at train."""
         from transmogrifai_tpu.cli import generate_project

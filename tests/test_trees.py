@@ -289,6 +289,8 @@ class TestMulticlass:
             scores = est.cv_sweep(x, y, tw, vw, [{}], cv.evaluator.metric_fn())
             assert np.isfinite(scores).all(), type(est).__name__
 
+    @pytest.mark.slow  # full multiclass selector competition (~30s);
+    # per-family multiclass CV finiteness stays tier-1 above
     def test_multiclass_selector_competes(self, tri_data):
         """≥3 model families must produce finite CV metrics in the multiclass
         selector (VERDICT r1 #1 done-criterion)."""

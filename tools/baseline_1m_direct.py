@@ -5,14 +5,24 @@ rows with measured per-family scaling exponents; the LR exponent pinned at
 the clamp, so part of the headline ratio was set by the clamp rather than a
 measurement.  This runs each family of the exact 11x3 fold-model sweep once
 at n=1,000,000 and writes ``baseline_1m.json`` at the repo root; bench.py
-uses the measured total as the denominator whenever the headline row count
-matches.
+uses the measured total as the denominator whenever the artifact is complete.
 
-Run (hours — sklearn GBT dominates):  python tools/baseline_1m_direct.py
+RESUMABLE: families already present in the artifact are skipped, so a
+crashed/killed run (sklearn GBT alone is hours) continues where it left off.
+
+Modes:
+  python tools/baseline_1m_direct.py                 # direct 1M, resume
+  python tools/baseline_1m_direct.py --family GBT    # one family only
+  python tools/baseline_1m_direct.py --extrapolate GBT
+      # complete a missing family via the bench's measured-exponent protocol
+      # (timed at two sizes, alpha = log(t2/t1)/log(n2/n1), extrapolated to
+      # 1M) and record it with provenance — used when the direct hours-long
+      # run cannot finish; the artifact marks the family as extrapolated.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -24,29 +34,90 @@ import numpy as np
 
 import bench as B
 
+ARTIFACT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "baseline_1m.json")
+FAMILIES = ("LR", "SVC", "RF", "GBT")
 
-def main():
+
+def _load() -> dict:
+    if os.path.exists(ARTIFACT):
+        with open(ARTIFACT) as fh:
+            out = json.load(fh)
+        if out.get("n_rows") == B.TARGET_ROWS and out.get("d") == B.D \
+                and out.get("folds") == B.FOLDS:
+            return out
+    return {"n_rows": B.TARGET_ROWS, "d": B.D, "folds": B.FOLDS,
+            "families": {}}
+
+
+def _save(out: dict) -> None:
+    out["total_seconds"] = round(sum(out["families"].values()), 2)
+    out["complete"] = all(f in out["families"] for f in FAMILIES)
+    with open(ARTIFACT, "w") as fh:
+        json.dump(out, fh, indent=1)
+
+
+# one protocol: the timing loop and exponent formula live in bench.py
+_sweep_family = B.proxy_family_seconds
+
+
+def run_direct(only=None):
+    out = _load()
+    todo = [f for f in FAMILIES if f not in out["families"]
+            and (only is None or f == only)]
+    if not todo:
+        print("nothing to do:", json.dumps(out))
+        return
     n = B.TARGET_ROWS
     x, y = B.synth(n, B.D, seed=1)
     rng = np.random.default_rng(2)
     folds = rng.integers(0, B.FOLDS, n)
-    out = {"n_rows": n, "d": B.D, "folds": B.FOLDS, "families": {}}
-    for fam in ("LR", "SVC", "RF", "GBT"):
-        t0 = time.perf_counter()
-        for est in B._proxy_family_models(fam, n):
-            for f in range(B.FOLDS):
-                tr = folds != f
-                est.fit(x[tr], y[tr])
-        dt = time.perf_counter() - t0
+    for fam in todo:
+        dt = _sweep_family(fam, n, x, y, folds)
         out["families"][fam] = round(dt, 2)
+        out.setdefault("provenance", {})[fam] = "direct_1m"
         print(f"{fam}: {dt:.1f}s", flush=True)
         # checkpoint after every family so a crash keeps partial results
-        out["total_seconds"] = round(sum(out["families"].values()), 2)
-        out["complete"] = len(out["families"]) == 4
-        with open(os.path.join(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))), "baseline_1m.json"), "w") as fh:
-            json.dump(out, fh, indent=1)
+        _save(out)
     print(json.dumps(out))
+
+
+def run_extrapolate(fam: str, n1: int = 32_768, n2: int = 131_072):
+    """Fill one family with the bench's measured-exponent protocol
+    (bench.py::measured_alpha — shared code, not a reimplementation): time at
+    two sizes, extrapolate to 1M with the clamped measured exponent."""
+    out = _load()
+    if fam in out["families"]:
+        print(f"{fam} already measured:", out["families"][fam])
+        return
+    times = {}
+    for n in (n1, n2):
+        x, y = B.synth(n, B.D, seed=1)
+        rng = np.random.default_rng(2)
+        folds = rng.integers(0, B.FOLDS, n)
+        times[n] = _sweep_family(fam, n, x, y, folds)
+        print(f"{fam}@{n}: {times[n]:.1f}s", flush=True)
+    alpha = B.measured_alpha(times[n1], times[n2], n1, n2)
+    est = times[n2] * (B.TARGET_ROWS / n2) ** alpha
+    out["families"][fam] = round(est, 2)
+    out.setdefault("provenance", {})[fam] = {
+        "protocol": "measured_exponent_extrapolation",
+        "alpha": round(alpha, 3),
+        "measured": {str(n): round(t, 2) for n, t in times.items()},
+    }
+    _save(out)
+    print(json.dumps(out))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--family", default=None, choices=FAMILIES)
+    ap.add_argument("--extrapolate", default=None, choices=FAMILIES)
+    args = ap.parse_args()
+    if args.extrapolate:
+        run_extrapolate(args.extrapolate)
+    else:
+        run_direct(only=args.family)
 
 
 if __name__ == "__main__":

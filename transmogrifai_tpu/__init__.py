@@ -35,6 +35,7 @@ from .readers.joined import (  # noqa: F401
 from .readers.streaming import (  # noqa: F401
     JsonlTailSource, MicroBatchStreamingReader, OffsetCheckpoint,
 )
+from . import perf  # noqa: F401 — compile probe + persistent compilation cache
 from .ops import bucketizers  # noqa: F401 — registers decision-tree bucketizer stages
 from .ops import misc  # noqa: F401 — registers misc value transformers + scalers
 from .ops import embeddings as _embeddings  # noqa: F401 — registers Word2Vec/LDA

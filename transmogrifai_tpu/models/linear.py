@@ -83,8 +83,14 @@ class LinearRegression(PredictionEstimatorBase):
             train_w, val_w)
         xd = _device_prepare(xd_raw, jnp.int32(n0), has_intercept=has_icpt,
                              standardize=False)
-        betas = _ridge_sweep(xd, yd, twd, regs, has_intercept=has_icpt)
-        return eval_linear_sweep(xd, yd, betas, vwd, metric_fn=metric_fn)
+        from ..perf.programs import run_cached
+
+        betas = run_cached(_ridge_sweep, xd, yd, twd, regs,
+                           statics=dict(has_intercept=has_icpt),
+                           label="LinearRegression/ridge_sweep")
+        return run_cached(eval_linear_sweep, xd, yd, betas, vwd,
+                          statics=dict(metric_fn=metric_fn),
+                          label="LinearRegression/eval_sweep")
 
 
 class LinearRegressionModel(PredictionModelBase):

@@ -320,29 +320,37 @@ class LogisticRegression(PredictionEstimatorBase):
         k, d1 = train_w.shape[0], int(xd.shape[1])
         has_icpt = bool(self.fit_intercept)
         parts = []
+        from ..perf.programs import run_cached
         from .base import place_grid
 
         if l2_idx:
             regs = place_grid(np.asarray([l1l2[i][1] for i in l2_idx],
                                          dtype=np.float32))
-            parts.append((l2_idx, _irls_sweep(xd, yd, train_w, regs, self.max_iter,
-                                              has_intercept=has_icpt)))
+            parts.append((l2_idx, run_cached(
+                _irls_sweep, xd, yd, train_w, regs,
+                statics=dict(max_iter=int(self.max_iter),
+                             has_intercept=has_icpt),
+                label="LogisticRegression/irls_sweep")))
         if en_idx:
             l1s = place_grid(np.asarray([l1l2[i][0] for i in en_idx],
                                         dtype=np.float32))
             l2s = place_grid(np.asarray([l1l2[i][1] for i in en_idx],
                                         dtype=np.float32))
-            parts.append((en_idx, _fista_sweep(
-                xd, yd, train_w, l1s, l2s, max(10 * self.max_iter, 300),
-                has_intercept=has_icpt)))
+            parts.append((en_idx, run_cached(
+                _fista_sweep, xd, yd, train_w, l1s, l2s,
+                statics=dict(max_iter=max(10 * int(self.max_iter), 300),
+                             has_intercept=has_icpt),
+                label="LogisticRegression/fista_sweep")))
         betas = jnp.zeros((len(grids), k, d1), dtype=jnp.float32)
         for idx, b in parts:
             betas = betas.at[jnp.asarray(idx)].set(b)
 
         from .base import eval_linear_sweep
 
-        return eval_linear_sweep(
-            xd, yd, betas, val_w, metric_fn=metric_fn, link="sigmoid")
+        return run_cached(
+            eval_linear_sweep, xd, yd, betas, val_w,
+            statics=dict(metric_fn=metric_fn, link="sigmoid"),
+            label="LogisticRegression/eval_sweep")
 
 
 class LogisticRegressionModel(PredictionModelBase):

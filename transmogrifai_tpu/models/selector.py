@@ -127,6 +127,20 @@ class ModelSelector(PredictionEstimatorBase):
         self.train_evaluators = list(train_evaluators)
 
     def fit_columns(self, cols, dataset):
+        from ..perf.timers import PhaseRecorder, phase, record_phases
+
+        # every fit records its own phase profile (a few dozen spans — cheap);
+        # ``last_fit_profile`` is how bench.py reports the per-phase breakdown
+        # of the ONE real fit instead of re-running the sweep in isolation.
+        # record_phases nests: an ambient recorder (workflow fit) sees the
+        # same spans.
+        profile = PhaseRecorder()
+        with record_phases(profile):
+            fitted = self._fit_columns_profiled(cols, dataset, phase)
+        self.last_fit_profile = profile
+        return fitted
+
+    def _fit_columns_profiled(self, cols, dataset, phase):
         label, vec = cols
         # asarray, NOT astype: when the stored block is already float32 this
         # preserves the object identity, so the content-stamp memo hits and
@@ -135,18 +149,22 @@ class ModelSelector(PredictionEstimatorBase):
         x = np.asarray(vec.data, np.float32)
         y = np.asarray(label.data, np.float32)
 
-        base_w, prep_summary = (
-            self.splitter.prepare(y) if self.splitter is not None
-            else (np.ones_like(y, dtype=np.float32), None)
-        )
-        if "__sample_weight__" in dataset:
-            base_w = base_w * dataset["__sample_weight__"].data.astype(np.float32)
+        with phase("prep"):
+            base_w, prep_summary = (
+                self.splitter.prepare(y) if self.splitter is not None
+                else (np.ones_like(y, dtype=np.float32), None)
+            )
+            if "__sample_weight__" in dataset:
+                base_w = base_w * dataset["__sample_weight__"].data.astype(
+                    np.float32)
 
         # workflow-level CV pre-seeds the validation result (in-fold feature
         # engineering done by Workflow.train; reference ModelSelector receives
         # the BestEstimator from OpWorkflow.fitStages the same way)
-        result: ValidationResult = getattr(self, "_preselected", None) \
-            or self.validator.validate(self.models, x, y, base_w)
+        result: ValidationResult = getattr(self, "_preselected", None)
+        if result is None:
+            with phase("validate"):
+                result = self.validator.validate(self.models, x, y, base_w)
         # EVERY candidate failed: there is no meaningful winner — selecting
         # among all-NaN metrics and silently refitting would ship an
         # arbitrary model (reference: robust-to-failing-models stops at
@@ -164,7 +182,8 @@ class ModelSelector(PredictionEstimatorBase):
         best_eval = result.best
         best_est = next(e for e, _ in self.models if e.uid == best_eval.model_uid)
         final_est = best_est.copy().set_params(**best_eval.grid)
-        best_model = final_est._fit_arrays(x, y, base_w)
+        with phase("refit"):
+            best_model = final_est._fit_arrays(x, y, base_w)
 
         # Train/holdout evaluation: device fast path when the model can score
         # on the shared placement AND the evaluator can consume device
@@ -202,11 +221,12 @@ class ModelSelector(PredictionEstimatorBase):
             return ev.evaluate_arrays(y.astype(np.float64), pred_col(), w=w)
 
         train_eval: Dict[str, float] = {}
-        for ev in ([self.validator.evaluator] + self.train_evaluators):
-            try:
-                train_eval.update(evaluate(ev, None))
-            except Exception:
-                pass
+        with phase("train_eval"):
+            for ev in ([self.validator.evaluator] + self.train_evaluators):
+                try:
+                    train_eval.update(evaluate(ev, None))
+                except Exception:
+                    pass
 
         # holdout metrics on rows the splitter reserved out of training
         # (reference test-set evaluation)
@@ -214,11 +234,12 @@ class ModelSelector(PredictionEstimatorBase):
         hmask = getattr(self.splitter, "holdout_mask", None)
         if hmask is not None and hmask.any():
             hw = hmask.astype(np.float64)
-            for ev in ([self.validator.evaluator] + self.train_evaluators):
-                try:
-                    holdout_eval.update(evaluate(ev, hw))
-                except Exception:
-                    pass
+            with phase("holdout_eval"):
+                for ev in ([self.validator.evaluator] + self.train_evaluators):
+                    try:
+                        holdout_eval.update(evaluate(ev, hw))
+                    except Exception:
+                        pass
 
         summary = ModelSelectorSummary(
             validation_type=type(self.validator).__name__,

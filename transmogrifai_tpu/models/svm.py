@@ -135,9 +135,14 @@ class LinearSVC(PredictionEstimatorBase):
         y_pm = np.where(y32 > 0.5, 1.0, -1.0).astype(np.float32)
         xd, (yd, ypmd), tw, vw, _ = sweep_placements(
             x32, [y32, y_pm], train_w, val_w)
-        return _svc_cv_program(
-            xd, yd, ypmd, tw, vw,
-            regs, int(self.max_iter), bool(self.fit_intercept), metric_fn)
+        from ..perf.programs import run_cached
+
+        return run_cached(
+            _svc_cv_program, xd, yd, ypmd, tw, vw, regs,
+            statics=dict(max_iter=int(self.max_iter),
+                         has_intercept=bool(self.fit_intercept),
+                         metric_fn=metric_fn),
+            label="LinearSVC/cv_program")
 
 
 class LinearSVCModel(PredictionModelBase):

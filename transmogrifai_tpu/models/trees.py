@@ -1188,15 +1188,19 @@ class _GBTBase(_TreeEstimatorBase):
     def _sweep_folds(self, binned, x, y, train_w, val_w, metric_fn,
                      weights01=False):
         from ..parallel.mesh import DATA_AXIS, place_cached
+        from ..perf.programs import run_cached
 
         objective, num_class, _ = self._resolved(y, np.ones_like(y))
         yd = place_cached(np.asarray(y, np.float32), (DATA_AXIS,))
-        return _gbt_cv_program(
-            binned, yd, train_w, val_w,
-            jax.random.PRNGKey(int(self.seed)), objective=objective,
-            num_class=num_class,
-            metric_fn=metric_fn, **self._fit_config(), **self._fit_dynamics(),
-        )
+        return run_cached(
+            _gbt_cv_program,
+            binned, yd, train_w, val_w, jax.random.PRNGKey(int(self.seed)),
+            kwargs=self._fit_dynamics(),
+            statics=dict(objective=objective, num_class=num_class,
+                         metric_fn=metric_fn, **self._fit_config()),
+            key_extras=dict(mat_binoh=_GBT_MAT_BINOH,
+                            hist_chunk=_HIST_CHUNK),
+            label=f"{type(self).__name__}/cv_program")
 
 
 def _class_count(y: np.ndarray, declared) -> int:
@@ -1337,17 +1341,25 @@ class _ForestBase(_TreeEstimatorBase):
         masks = place_spec(np.asarray(self._masks(x.shape[1])),
                            (MODEL_AXIS, None))
         boot = place_spec(boot, (MODEL_AXIS, DATA_AXIS))
-        return _forest_cv_program(
+        from ..perf.programs import run_cached
+
+        return run_cached(
+            _forest_cv_program,
             binned, place_cached(np.asarray(y, np.float32), (DATA_AXIS,)),
             place_cached(self._y_cols(y), (DATA_AXIS,)),
             train_w, val_w, masks, boot,
-            int(self.max_depth), int(self.n_bins), jnp.float32(self.reg_lambda),
-            jnp.float32(self.min_child_weight), classification=self.classification,
-            metric_fn=metric_fn,
-            # grad/hess = fold_w x poisson counts x one-hot targets: exact
-            # int8 when fold weights are 0/1 and targets are class indicators
-            int_exact=weights01 and self.classification,
-        )
+            kwargs=dict(reg_lambda=jnp.float32(self.reg_lambda),
+                        min_child_weight=jnp.float32(self.min_child_weight)),
+            statics=dict(max_depth=int(self.max_depth),
+                         n_bins=int(self.n_bins),
+                         classification=self.classification,
+                         metric_fn=metric_fn,
+                         # grad/hess = fold_w x poisson counts x one-hot
+                         # targets: exact int8 when fold weights are 0/1 and
+                         # targets are class indicators
+                         int_exact=weights01 and self.classification),
+            key_extras=dict(fold_vmap=_RF_FOLD_VMAP, hist_chunk=_HIST_CHUNK),
+            label=f"{type(self).__name__}/cv_program")
 
 
 class RandomForestClassifier(_ForestBase):

@@ -237,13 +237,16 @@ class CrossValidator:
         # Futures pool; VERDICT r2 #1b).
         import logging
 
+        from ..perf.timers import phase
+
         log = logging.getLogger(__name__)
         dispatched = []
         for est, grids in models:
             grids = grids or [{}]
             try:
-                gather = est.cv_sweep_async(x, y, train_w, val_w, grids,
-                                            metric_fn)
+                with phase(f"cv.dispatch.{type(est).__name__}"):
+                    gather = est.cv_sweep_async(x, y, train_w, val_w, grids,
+                                                metric_fn)
             except Exception as e:  # robust to failing models (SURVEY §5.3)
                 log.warning("model %s failed in CV dispatch (%s); excluded "
                             "from selection", type(est).__name__, e)
@@ -251,7 +254,11 @@ class CrossValidator:
             dispatched.append((est, grids, gather))
 
         # Phase 2 — gather: one blocking fetch per family, in dispatch order,
-        # after all programs are in flight.
+        # after all programs are in flight.  The per-family gather span is the
+        # family's residual device time after every earlier family drained —
+        # in-order queue semantics make the SUM of dispatch+gather spans the
+        # true device-side cost of the sweep (bench reads these spans instead
+        # of re-running each family in isolation).
         evaluations: List[ModelEvaluation] = []
         failed_models: List[str] = []
         for est, grids, gather in dispatched:
@@ -259,7 +266,8 @@ class CrossValidator:
                 scores = np.full((len(grids), self.num_folds), np.nan)
             else:
                 try:
-                    scores = np.asarray(gather())
+                    with phase(f"cv.gather.{type(est).__name__}"):
+                        scores = np.asarray(gather())
                 except Exception as e:
                     log.warning("model %s failed in CV (%s); excluded from "
                                 "selection", type(est).__name__, e)

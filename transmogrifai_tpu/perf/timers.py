@@ -1,0 +1,272 @@
+"""Nestable phase timers + a process-wide XLA compile probe.
+
+Phase timers: the selector fit, the cross-validator, and the workflow fit
+loop wrap their phases in ``phase("name")``.  Spans land in every active
+``PhaseRecorder`` (recorders nest — the selector records its own fit profile
+while a caller's ambient recorder captures the same spans), so the ONE real
+fit yields the per-phase breakdown that ``bench.py`` used to obtain by
+re-running the whole sweep ~2 extra times.
+
+Compile probe: ``jax.monitoring`` emits an event per backend compilation
+(``/jax/core/compile/backend_compile_duration``) and per persistent-cache
+hit/miss.  A module-level listener accumulates them; ``measure_compiles``
+yields a live delta object, which is how tests assert "the second fit of the
+default sweep performs 0 new XLA compilations".
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+# ---------------------------------------------------------------------------
+# Phase timers
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Span:
+    """One timed phase execution.  ``path`` is the dotted nesting path."""
+
+    name: str
+    path: str
+    start: float
+    seconds: float
+
+
+class PhaseRecorder:
+    """Collects spans; ``report()`` aggregates seconds by dotted path.
+
+    Paths are RELATIVE to the recorder's activation point: a recorder opened
+    inside ``phase("fit.modelSelector")`` records the selector's "validate"
+    span as ``validate``, while an outer recorder sees the same span as
+    ``fit.modelSelector.validate`` — so consumers (bench's selector
+    breakdown) parse stable paths regardless of how deep the fit ran.
+    """
+
+    def __init__(self):
+        self.spans: List[Span] = []
+        #: phase-stack depth when this recorder was activated
+        self._base = 0
+
+    def add(self, span: Span) -> None:
+        self.spans.append(span)
+
+    def report(self, round_to: int = 4) -> Dict[str, float]:
+        """{dotted path: total seconds} over all recorded spans."""
+        out: Dict[str, float] = {}
+        for s in self.spans:
+            out[s.path] = out.get(s.path, 0.0) + s.seconds
+        return {k: round(v, round_to) for k, v in out.items()}
+
+    def total(self, path: str) -> float:
+        """Summed seconds of spans recorded at exactly ``path``.
+
+        Exact-path only: a parent span's time already includes its nested
+        children, so summing the subtree would double-count."""
+        return sum(s.seconds for s in self.spans if s.path == path)
+
+
+#: stack of active recorders (outermost first) — spans land in ALL of them
+_RECORDERS: "contextvars.ContextVar[tuple]" = contextvars.ContextVar(
+    "transmogrifai_tpu_perf_recorders", default=())
+#: current nesting path of open phases
+_PHASE_STACK: "contextvars.ContextVar[tuple]" = contextvars.ContextVar(
+    "transmogrifai_tpu_perf_phase_stack", default=())
+
+
+def current_recorder() -> Optional[PhaseRecorder]:
+    """Innermost active recorder, or None."""
+    stack = _RECORDERS.get()
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def record_phases(recorder: Optional[PhaseRecorder] = None):
+    """Activate a PhaseRecorder for the duration of the block.
+
+    Nesting is additive: an inner ``record_phases`` does not hide the outer
+    one — spans recorded inside land in both.
+    """
+    rec = recorder if recorder is not None else PhaseRecorder()
+    rec._base = len(_PHASE_STACK.get())
+    token = _RECORDERS.set(_RECORDERS.get() + (rec,))
+    try:
+        yield rec
+    finally:
+        _RECORDERS.reset(token)
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Time a phase.  No-op (zero overhead beyond a contextvar read) when no
+    recorder is active.  Phases nest: ``phase("fit")`` inside
+    ``phase("validate")`` records as path ``validate.fit``."""
+    recorders = _RECORDERS.get()
+    if not recorders:
+        yield
+        return
+    stack = _PHASE_STACK.get()
+    token = _PHASE_STACK.set(stack + (name,))
+    parts = stack + (name,)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        _PHASE_STACK.reset(token)
+        for rec in recorders:
+            rel = parts[rec._base:]  # path relative to the recorder's base
+            if rel:
+                rec.add(Span(name=name, path=".".join(rel), start=t0,
+                             seconds=dt))
+
+
+# ---------------------------------------------------------------------------
+# Compile probe
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CompileStats:
+    """Cumulative XLA compilation counters (process-wide since import)."""
+
+    backend_compiles: int = 0
+    compile_seconds: float = 0.0
+    trace_seconds: float = 0.0          # jaxpr trace + MLIR lowering
+    persistent_cache_hits: int = 0
+    persistent_cache_misses: int = 0
+    events: Dict[str, int] = field(default_factory=dict)
+
+    def snapshot(self) -> "CompileStats":
+        return CompileStats(
+            backend_compiles=self.backend_compiles,
+            compile_seconds=self.compile_seconds,
+            trace_seconds=self.trace_seconds,
+            persistent_cache_hits=self.persistent_cache_hits,
+            persistent_cache_misses=self.persistent_cache_misses,
+            events=dict(self.events),
+        )
+
+    def minus(self, other: "CompileStats") -> "CompileStats":
+        return CompileStats(
+            backend_compiles=self.backend_compiles - other.backend_compiles,
+            compile_seconds=self.compile_seconds - other.compile_seconds,
+            trace_seconds=self.trace_seconds - other.trace_seconds,
+            persistent_cache_hits=(self.persistent_cache_hits
+                                   - other.persistent_cache_hits),
+            persistent_cache_misses=(self.persistent_cache_misses
+                                     - other.persistent_cache_misses),
+            events={k: v - other.events.get(k, 0)
+                    for k, v in self.events.items()
+                    if v - other.events.get(k, 0)},
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "backend_compiles": self.backend_compiles,
+            "compile_seconds": round(self.compile_seconds, 3),
+            "trace_seconds": round(self.trace_seconds, 3),
+            "persistent_cache_hits": self.persistent_cache_hits,
+            "persistent_cache_misses": self.persistent_cache_misses,
+        }
+
+
+_GLOBAL = CompileStats()
+_LOCK = threading.Lock()
+_REGISTERED = False
+
+#: monitoring event names (jax >= 0.4.x); counts land in ``events`` verbatim
+_EV_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+_EV_TRACE = "/jax/core/compile/jaxpr_trace_duration"
+_EV_LOWER = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+_EV_CACHE_HIT = "/jax/compilation_cache/cache_hits"
+_EV_CACHE_MISS = "/jax/compilation_cache/cache_misses"
+
+
+def _on_event(name: str, **kw) -> None:
+    with _LOCK:
+        _GLOBAL.events[name] = _GLOBAL.events.get(name, 0) + 1
+        if name == _EV_CACHE_HIT:
+            _GLOBAL.persistent_cache_hits += 1
+        elif name == _EV_CACHE_MISS:
+            _GLOBAL.persistent_cache_misses += 1
+
+
+def _on_duration(name: str, secs: float, **kw) -> None:
+    with _LOCK:
+        _GLOBAL.events[name] = _GLOBAL.events.get(name, 0) + 1
+        if name == _EV_BACKEND_COMPILE:
+            _GLOBAL.backend_compiles += 1
+            _GLOBAL.compile_seconds += secs
+        elif name in (_EV_TRACE, _EV_LOWER):
+            _GLOBAL.trace_seconds += secs
+
+
+def _ensure_registered() -> None:
+    """Register the jax.monitoring listeners once.  Listeners are global and
+    live for the process; they cost a dict update per compile event."""
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    with _LOCK:
+        if _REGISTERED:
+            return
+        try:
+            from jax import monitoring
+        except Exception:  # pragma: no cover — jax without monitoring
+            _REGISTERED = True
+            return
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _REGISTERED = True
+
+
+_ensure_registered()
+
+
+def compile_snapshot() -> CompileStats:
+    """A copy of the cumulative process-wide compile counters."""
+    _ensure_registered()
+    with _LOCK:
+        return _GLOBAL.snapshot()
+
+
+class _CompileDelta:
+    """Live view over compiles since ``measure_compiles`` entered; attributes
+    resolve lazily so reads after the with-block see the final delta."""
+
+    def __init__(self, base: CompileStats):
+        self._base = base
+
+    def _delta(self) -> CompileStats:
+        return compile_snapshot().minus(self._base)
+
+    @property
+    def backend_compiles(self) -> int:
+        return self._delta().backend_compiles
+
+    @property
+    def compile_seconds(self) -> float:
+        return self._delta().compile_seconds
+
+    @property
+    def persistent_cache_hits(self) -> int:
+        return self._delta().persistent_cache_hits
+
+    @property
+    def persistent_cache_misses(self) -> int:
+        return self._delta().persistent_cache_misses
+
+    def to_dict(self) -> Dict[str, Any]:
+        return self._delta().to_dict()
+
+
+@contextlib.contextmanager
+def measure_compiles():
+    """Yield a delta object tracking XLA compilations inside (and after) the
+    block: ``with measure_compiles() as c: fit(); assert c.backend_compiles == 0``."""
+    yield _CompileDelta(compile_snapshot())

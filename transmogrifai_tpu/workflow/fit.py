@@ -70,18 +70,29 @@ def _resolve(stage: PipelineStage, fitted: Dict[str, Transformer]) -> Transforme
 def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer],
                    on_fit=None) -> Dataset:
     """Fit/transform an explicit stage list (topological order) — the single
-    fit/transform loop shared by fit_dag and the workflow-CV passes."""
+    fit/transform loop shared by fit_dag and the workflow-CV passes.
+
+    Each stage's fit/transform also lands as a perf phase span (no-op unless
+    a ``perf.timers.record_phases`` recorder is active — bench and callers
+    profiling a train get per-stage wall time from the one real fit)."""
+    from ..perf.timers import phase
+
+    def _name(s) -> str:
+        return getattr(s, "operation_name", None) or type(s).__name__
+
     for stage in stages:
         runner = _resolve(stage, fitted)
         if runner is None:
-            with stage_timer(stage, "fit", dataset) as finish:
+            with phase(f"fit.{_name(stage)}"), \
+                    stage_timer(stage, "fit", dataset) as finish:
                 model = stage.fit(dataset)
                 finish(None)
             fitted[stage.uid] = model
             runner = model
             if on_fit is not None:
                 on_fit(model)
-        with stage_timer(runner, "transform", dataset) as finish:
+        with phase(f"transform.{_name(runner)}"), \
+                stage_timer(runner, "transform", dataset) as finish:
             dataset = runner.transform(dataset)
             finish(dataset)
     return dataset
