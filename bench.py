@@ -300,6 +300,75 @@ def bench_sklearn_proxy(n_rows: int):
     return N_FOLD_MODELS / total, alphas
 
 
+def bench_transform(n_rows: int):
+    """Fused-planner vs interpreted feature-prep throughput (rows/sec) on the
+    wide fixture (8 numeric with missing values + 6 categorical predictors —
+    the local_scoring_latency serve shape), plus the compile-reuse check.
+
+    Both paths are warmed once (the fused path's first call pays its XLA
+    compile, amortized by the executable/persistent caches in production),
+    then timed over the same fitted DAG.  Gate: fused >= 3x interpreted.
+    """
+    from transmogrifai_tpu import FeatureBuilder, Workflow, transmogrify
+    from transmogrifai_tpu.data.dataset import Column, Dataset
+    from transmogrifai_tpu.perf import measure_compiles
+    from transmogrifai_tpu.types import PickList, Real, RealNN
+    from transmogrifai_tpu.workflow.fit import transform_dag
+
+    n = int(n_rows)
+    rng = np.random.default_rng(12)
+    cols = {}
+    ftypes = {}
+    for i in range(8):
+        vals = rng.normal(size=n)
+        mask = rng.random(n) > 0.1
+        cols[f"num{i}"] = Column(Real, vals, mask)
+        ftypes[f"num{i}"] = Real
+    levels = [f"lv{j}" for j in range(20)]
+    for i in range(6):
+        data = np.array([None if rng.random() < 0.05
+                         else levels[rng.integers(0, len(levels))]
+                         for _ in range(n)], dtype=object)
+        cols[f"cat{i}"] = Column(PickList, data)
+        ftypes[f"cat{i}"] = PickList
+    z = cols["num0"].data - cols["num1"].data
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float64)
+    cols["label"] = Column(RealNN, y, np.ones(n, dtype=np.bool_))
+    ds = Dataset(cols)
+
+    label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+    feats = [FeatureBuilder.of(f"num{i}", Real).extract_field().as_predictor()
+             for i in range(8)] + \
+            [FeatureBuilder.of(f"cat{i}", PickList).extract_field()
+             .as_predictor() for i in range(6)]
+    checked = label.sanity_check(transmogrify(feats))
+    model = (Workflow().set_input_dataset(ds)
+             .set_result_features(label, checked)).train()
+    features, fitted = model.result_features, model.fitted
+
+    def timed(fused, reps):
+        transform_dag(ds, features, fitted, fused=fused)  # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            transform_dag(ds, features, fitted, fused=fused)
+        return (time.perf_counter() - t0) / reps
+
+    dt_interp = timed(False, 2)
+    dt_fused = timed(None, 3)
+    with measure_compiles() as probe:  # steady state: no recompiles
+        transform_dag(ds, features, fitted)
+        warm_compiles = probe.backend_compiles
+    speedup = dt_interp / max(dt_fused, 1e-9)
+    return {
+        "rows": n,
+        "fused_rows_per_sec": round(n / dt_fused, 1),
+        "interpreted_rows_per_sec": round(n / dt_interp, 1),
+        "speedup": round(speedup, 2),
+        "gate_3x": bool(speedup >= 3.0),
+        "warm_transform_backend_compiles": warm_compiles,
+    }
+
+
 def bench_irls_mfu(n_rows: int, device_kind: str):
     """Achieved TFLOP/s (+ fraction of bf16 peak) of the IRLS CV sweep kernel."""
     import jax
@@ -461,6 +530,7 @@ _EMITTED = False
 #: and the final JSON always land inside the driver's timeout
 _SECTION_FLOORS = {
     "baseline": 60.0,
+    "transform": 45.0,
     "irls_mfu": 60.0,
     "tree_hist": 60.0,
     "tree_hist_batched": 90.0,
@@ -591,6 +661,15 @@ def main(argv=None):
         _OUT["vs_baseline"] = round(_OUT["value"] / baseline, 2) \
             if baseline > 0 else None
         _OUT["baseline_scaling_exponents"] = alphas
+
+    # the transform fixture needs >= ~50k rows for a stable ratio (at tiny n
+    # the 2-rep interpreted timing jitters 2-3x and fixed dispatch overheads
+    # mask the fusion win); still a handful of seconds in smoke
+    tr = _run_section(
+        "transform", budget,
+        lambda: bench_transform(min(max(n_rows, 50_000), 250_000)))
+    if tr is not None:
+        _OUT["transform"] = tr
 
     mfu = _run_section(
         "irls_mfu", budget,

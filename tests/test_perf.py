@@ -347,3 +347,10 @@ class TestBenchSmoke:
         # the breakdown came from recorded phases, not isolated re-runs
         assert "families_secs" in parsed["phase_breakdown"]
         assert parsed["warm_fit_backend_compiles"] == 0
+        # fused transform planner section: >= 3x interpreted prep throughput
+        # on the wide fixture, steady state compiles nothing (ISSUE 4)
+        assert secs["transform"]["status"] == "ok", secs["transform"]
+        tr = parsed["transform"]
+        assert tr["speedup"] >= 3.0, tr
+        assert tr["gate_3x"] is True
+        assert tr["warm_transform_backend_compiles"] == 0

@@ -110,6 +110,11 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "compilation — every new width recompiles; fix the width "
               "upstream (declare/enforce a constant vector width) or keep "
               "its consumers on the host path"),
+    "TM504": (Severity.INFO, "fused transform planner split",
+              "informational: how the transform planner partitions this DAG "
+              "into the jit-fused device prefix and the per-stage host "
+              "remainder; widen the prefix by implementing device_transform "
+              "on the listed host stages"),
     # -- leakage ------------------------------------------------------------
     "TM401": (Severity.ERROR, "label leaks into feature path",
               "a response(-derived) feature reaches the model's feature input "

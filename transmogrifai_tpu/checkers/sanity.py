@@ -467,6 +467,14 @@ class SanityCheckerModel(Transformer):
 
         return vec[:, jnp.asarray(self.kept_indices)]
 
+    def device_state(self):
+        # kept count rides the state SHAPE: the fold-batched planner stacks
+        # folds only when they kept the same number of slots
+        return (np.asarray(self.kept_indices, np.int32),)
+
+    def device_transform_stateful(self, state, vec):
+        return vec[:, state[0]]
+
     def transform(self, dataset):
         # label is absent at scoring time — only the feature vector is needed
         vec = dataset[self.inputs[1].name]

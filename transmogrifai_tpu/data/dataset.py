@@ -241,7 +241,31 @@ class Dataset:
         return Dataset({n: c for n, c in self._columns.items() if n not in drop})
 
     def take(self, indices: np.ndarray) -> "Dataset":
-        return Dataset({n: c.take(indices) for n, c in self._columns.items()})
+        """Row subset by position — one fancy-indexing pass per column.
+
+        The indices normalize to one shared intp array (numpy would otherwise
+        re-coerce a Python list per column), Column/Dataset construction skips
+        re-validation (every taken column has len(indices) rows by
+        construction), and the per-fold CV loop leans on this being cheap.
+        """
+        idx = np.asarray(indices)  # zero-copy for ndarray inputs
+        if idx.dtype != np.bool_ and idx.dtype != np.intp:
+            # one shared coercion; bool masks keep numpy's mask semantics
+            idx = idx.astype(np.intp)
+        cols: Dict[str, Column] = {}
+        for n, c in self._columns.items():
+            if type(c) is not Column:  # subclasses (PredictionColumn) carry
+                cols[n] = c.take(idx)  # extra state their own take preserves
+                continue
+            col = Column.__new__(Column)
+            col.ftype = c.ftype
+            col.data = c.data[idx]
+            col.mask = c.mask[idx] if c.mask is not None else None
+            col.meta = c.meta
+            cols[n] = col
+        out = Dataset.__new__(Dataset)
+        out._columns = cols
+        return out
 
     def concat(self, other: "Dataset") -> "Dataset":
         if set(self.names) != set(other.names):

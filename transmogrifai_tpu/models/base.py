@@ -8,6 +8,7 @@ a param pytree; predict is a jitted batched function.  Estimators that implement
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Any, Dict, List, Optional
 
@@ -108,30 +109,81 @@ def eval_metric(payload, y, w, *, metric_fn):
     return metric_fn(payload, y, w)
 
 
-@partial(jax.jit, static_argnames=("metric_fn", "link"))
-def eval_linear_sweep(xd, yd, betas, vw, *, metric_fn, link="identity"):
-    """Metric per (grid, fold) for linear-family sweeps — one cached program.
+def _replicator(mesh):
+    """Constraint replicating an operand over ``mesh`` (identity when None).
 
-    betas: (g, k, d); vw: (k, n).  ``link`` maps margins to scores
-    ("identity" for regression/SVM margins, "sigmoid" for logistic probs).
+    The sort-based AUC metrics miscompile under GSPMD when the sort dimension
+    is sharded over a mesh axis while the batch dimensions stay replicated
+    (observed on a (data=4, model=2) mesh: auPR values near -n instead of
+    [0, 1]).  A sort needs the full row axis on every participant anyway, so
+    the eval programs pin their metric inputs to replicated — the all-gather
+    this forces is the collective a correct sharded sort would pay regardless.
     """
-    margins = jnp.einsum("nd,gkd->gkn", xd, betas)
-    scores = jax.nn.sigmoid(margins) if link == "sigmoid" else margins
-    per_fold = jax.vmap(lambda s, w_: metric_fn(s, yd, w_), in_axes=(0, 0))
-    return jax.vmap(lambda ps: per_fold(ps, vw), in_axes=0)(scores)
+    if mesh is None:
+        return lambda a: a
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    return lambda a: jax.lax.with_sharding_constraint(a, rep)
 
 
-@partial(jax.jit, static_argnames=("metric_fn",))
-def eval_softmax_sweep(xd, yd, bs, vw, *, metric_fn):
-    """Metric per (grid, fold) for multiclass sweeps — one cached program.
+@functools.lru_cache(maxsize=None)
+def _eval_linear_sweep_for(mesh):
+    """Per-mesh jitted linear eval program.
 
-    bs: (g, k, d, C) per-(grid, fold) softmax weights; the metric receives the
-    (n, C) probability matrix (multiclass payload convention).
+    One closure per mesh: the replication constraint bakes the mesh into the
+    trace, so sharing one jitted function across meshes would poison the jit
+    trace cache; ``run_cached`` keys on the ambient mesh already, and the
+    per-mesh function identity keeps the plain jit cache honest too.
     """
-    logits = jnp.einsum("nd,gkdc->gknc", xd, bs)
-    probs = jax.nn.softmax(logits, axis=-1)
-    per_fold = jax.vmap(lambda p, w_: metric_fn(p, yd, w_), in_axes=(0, 0))
-    return jax.vmap(lambda ps: per_fold(ps, vw), in_axes=0)(probs)
+    rep = _replicator(mesh)
+
+    @partial(jax.jit, static_argnames=("metric_fn", "link"))
+    def eval_linear_sweep(xd, yd, betas, vw, *, metric_fn, link="identity"):
+        """Metric per (grid, fold) for linear-family sweeps — one cached
+        program.  betas: (g, k, d); vw: (k, n).  ``link`` maps margins to
+        scores ("identity" for regression/SVM margins, "sigmoid" for logistic
+        probs)."""
+        margins = jnp.einsum("nd,gkd->gkn", xd, betas)
+        scores = jax.nn.sigmoid(margins) if link == "sigmoid" else margins
+        scores, yr, vwr = rep(scores), rep(yd), rep(vw)
+        per_fold = jax.vmap(lambda s, w_: metric_fn(s, yr, w_), in_axes=(0, 0))
+        return jax.vmap(lambda ps: per_fold(ps, vwr), in_axes=0)(scores)
+
+    return eval_linear_sweep
+
+
+@functools.lru_cache(maxsize=None)
+def _eval_softmax_sweep_for(mesh):
+    """Per-mesh jitted multiclass eval program (see _eval_linear_sweep_for)."""
+    rep = _replicator(mesh)
+
+    @partial(jax.jit, static_argnames=("metric_fn",))
+    def eval_softmax_sweep(xd, yd, bs, vw, *, metric_fn):
+        """Metric per (grid, fold) for multiclass sweeps — one cached
+        program.  bs: (g, k, d, C) per-(grid, fold) softmax weights; the
+        metric receives the (n, C) probability matrix."""
+        logits = jnp.einsum("nd,gkdc->gknc", xd, bs)
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs, yr, vwr = rep(probs), rep(yd), rep(vw)
+        per_fold = jax.vmap(lambda p, w_: metric_fn(p, yr, w_), in_axes=(0, 0))
+        return jax.vmap(lambda ps: per_fold(ps, vwr), in_axes=0)(probs)
+
+    return eval_softmax_sweep
+
+
+def eval_linear_sweep_program():
+    """The linear eval-sweep program specialized to the ambient mesh."""
+    from ..parallel.mesh import current_mesh
+
+    return _eval_linear_sweep_for(current_mesh())
+
+
+def eval_softmax_sweep_program():
+    """The multiclass eval-sweep program specialized to the ambient mesh."""
+    from ..parallel.mesh import current_mesh
+
+    return _eval_softmax_sweep_for(current_mesh())
 
 
 @partial(jax.jit, static_argnames=("link",))

@@ -69,7 +69,7 @@ class LinearRegression(PredictionEstimatorBase):
 
     def _cv_sweep_device(self, x, y, train_w, val_w,
                          grids: List[Dict[str, Any]], metric_fn):
-        from .base import eval_linear_sweep, place_grid, sweep_placements
+        from .base import eval_linear_sweep_program, place_grid, sweep_placements
 
         regs = place_grid(np.asarray(
             [float(g.get("reg_param", self.reg_param))
@@ -88,7 +88,7 @@ class LinearRegression(PredictionEstimatorBase):
         betas = run_cached(_ridge_sweep, xd, yd, twd, regs,
                            statics=dict(has_intercept=has_icpt),
                            label="LinearRegression/ridge_sweep")
-        return run_cached(eval_linear_sweep, xd, yd, betas, vwd,
+        return run_cached(eval_linear_sweep_program(), xd, yd, betas, vwd,
                           statics=dict(metric_fn=metric_fn),
                           label="LinearRegression/eval_sweep")
 

@@ -345,10 +345,10 @@ class LogisticRegression(PredictionEstimatorBase):
         for idx, b in parts:
             betas = betas.at[jnp.asarray(idx)].set(b)
 
-        from .base import eval_linear_sweep
+        from .base import eval_linear_sweep_program
 
         return run_cached(
-            eval_linear_sweep, xd, yd, betas, val_w,
+            eval_linear_sweep_program(), xd, yd, betas, val_w,
             statics=dict(metric_fn=metric_fn, link="sigmoid"),
             label="LogisticRegression/eval_sweep")
 

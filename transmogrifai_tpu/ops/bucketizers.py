@@ -145,6 +145,43 @@ def bucketize_right(
     return block
 
 
+def device_bucketize_right(x, splits, track_nulls: bool, track_invalid: bool):
+    """jnp half of :func:`bucketize_right` — right-inclusive one-hot over the
+    (possibly traced) ``splits`` vector; ``x`` is the canonical float32 lift
+    (NaN for missing).  ``splits.shape[0] == 0`` is the shouldSplit=false
+    branch (null indicator only).  Row-local and static-shape, so it fuses
+    into the transform planner's jitted prefix.
+
+    float32 caveat: the device path compares float32-rounded values against
+    float32-rounded thresholds; a value within one f32 ulp of a split can land
+    one bucket away from the float64 host path.  Split thresholds are data
+    values, and equal f64 values round equally, so ties at the thresholds
+    themselves agree — only sub-ulp-spaced data diverges.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n_splits = int(splits.shape[0])
+    present = ~jnp.isnan(x)
+    if n_splits == 0:
+        null_col = (~present).astype(jnp.float32)[:, None]
+        return null_col if track_nulls else jnp.zeros((x.shape[0], 0),
+                                                      jnp.float32)
+    n_buckets = n_splits - 1
+    finite = present & jnp.isfinite(x)
+    v0 = jnp.nan_to_num(x)
+    idx = jnp.clip(jnp.searchsorted(splits, v0, side="left") - 1,
+                   0, n_buckets - 1)
+    in_range = finite & (x > splits[0]) & (x <= splits[-1])
+    parts = [jax.nn.one_hot(idx, n_buckets, dtype=jnp.float32)
+             * in_range.astype(jnp.float32)[:, None]]
+    if track_invalid:
+        parts.append((present & ~in_range).astype(jnp.float32)[:, None])
+    if track_nulls:
+        parts.append((~present).astype(jnp.float32)[:, None])
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+
 def _bucket_labels(splits: np.ndarray) -> List[str]:
     return [f"{splits[i]}-{splits[i + 1]}" for i in range(len(splits) - 1)]
 
@@ -206,6 +243,36 @@ class DecisionTreeNumericBucketizerModel(Transformer):
 
     def _is_label_slot(self, feature, features) -> bool:
         return feature is features[0]
+
+    #: scoring only reads the value slot — the label is absent at serve time
+    device_input_slots = (1,)
+
+    def device_transform(self, x):
+        """Right-inclusive one-hot of the fitted tree splits (device half of
+        ``transform_columns``; see :func:`device_bucketize_right`)."""
+        import jax.numpy as jnp
+
+        splits = jnp.asarray(np.asarray(self.splits, dtype=np.float32)) \
+            if self.should_split else jnp.zeros((0,), jnp.float32)
+        return device_bucketize_right(x, splits, self.track_nulls,
+                                      self.track_invalid)
+
+    def device_state(self):
+        # split count (hence output width) rides the state SHAPE, so the
+        # fold-batched planner only stacks folds whose trees agree on width
+        splits = np.asarray(self.splits if self.should_split else [],
+                            dtype=np.float32)
+        return (splits,)
+
+    def device_transform_stateful(self, state, x):
+        return device_bucketize_right(x, state[0], self.track_nulls,
+                                      self.track_invalid)
+
+    def transform(self, dataset):
+        # label is absent at scoring time — only the value column is needed
+        col = dataset[self.inputs[1].name]
+        out = self.transform_columns([None, col], dataset)
+        return dataset.with_column(self.output_name, out)
 
     def _meta_cols(self, f) -> List[VectorColumnMetadata]:
         cols: List[VectorColumnMetadata] = []

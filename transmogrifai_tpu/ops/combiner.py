@@ -26,6 +26,12 @@ class VectorsCombiner(SequenceTransformer):
         return lax.concatenate([b if b.ndim == 2 else b.reshape(b.shape[0], 1)
                                 for b in blocks], dimension=1)
 
+    def device_state(self):
+        return ()  # stateless: fold copies are interchangeable
+
+    def device_transform_stateful(self, state, *blocks):
+        return self.device_transform(*blocks)
+
     def transform_columns(self, cols, dataset):
         metas = []
         for f, c in zip(self.inputs, cols):

@@ -102,11 +102,18 @@ class NumericVectorizerModel(Transformer):
     def device_transform(self, *xs):
         """Impute + null-indicator as one traceable kernel; operands are the
         canonical float32-with-NaN lifts of each numeric input column."""
+        return self.device_transform_stateful(
+            (np.asarray(self.fills, np.float32),), *xs)
+
+    def device_state(self):
+        return (np.asarray(self.fills, np.float32),)
+
+    def device_transform_stateful(self, state, *xs):
         import jax.numpy as jnp
 
         x = jnp.stack(xs, axis=1)
         nan = jnp.isnan(x)
-        filled = jnp.where(nan, jnp.asarray(self.fills.astype(np.float32)), x)
+        filled = jnp.where(nan, state[0], x)
         if not self.track_nulls:
             return filled
         return _device_interleave(filled, nan)
@@ -129,6 +136,12 @@ class RealNNVectorizer(SequenceTransformer):
         import jax.numpy as jnp
 
         return jnp.stack(xs, axis=1)
+
+    def device_state(self):
+        return ()  # stateless: fold copies are interchangeable
+
+    def device_transform_stateful(self, state, *xs):
+        return self.device_transform(*xs)
 
     def transform_columns(self, cols, dataset):
         x = np.column_stack([c.data.astype(np.float64) for c in cols])
@@ -154,6 +167,12 @@ class BinaryVectorizer(SequenceTransformer):
         if not self.track_nulls:
             return vals
         return _device_interleave(vals, absent)
+
+    def device_state(self):
+        return ()  # stateless: fold copies are interchangeable
+
+    def device_transform_stateful(self, state, *xs):
+        return self.device_transform(*xs)
 
     def transform_columns(self, cols, dataset):
         n = len(cols[0])

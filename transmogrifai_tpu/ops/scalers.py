@@ -45,6 +45,14 @@ class FillMissingWithMeanModel(UnaryTransformer):
 
         return jnp.where(jnp.isnan(x), jnp.float32(self.mean), x)
 
+    def device_state(self):
+        return (np.float32(self.mean),)
+
+    def device_transform_stateful(self, state, x):
+        import jax.numpy as jnp
+
+        return jnp.where(jnp.isnan(x), state[0].astype(x.dtype), x)
+
     def transform_columns(self, cols, dataset):
         v = cols[0].values_f64()
         filled = np.where(np.isnan(v), self.mean, v)
@@ -82,6 +90,13 @@ class StandardScalerModel(UnaryTransformer):
         """Traceable device kernel (opcheck abstract eval / layer fusion)."""
         return (x - self.mean) / self.std
 
+    def device_state(self):
+        return (np.asarray([self.mean, self.std], np.float32),)
+
+    def device_transform_stateful(self, state, x):
+        ms = state[0]
+        return (x - ms[0]) / ms[1]
+
     def transform_columns(self, cols, dataset):
         v = (cols[0].data.astype(np.float64) - self.mean) / self.std
         return Column(RealNN, v, np.ones(len(v), dtype=np.bool_))
@@ -96,6 +111,31 @@ class NumericBucketizer(UnaryTransformer):
     splits = Param(default=(-np.inf, 0.0, np.inf))
     track_nulls = Param(default=True)
     track_invalid = Param(default=False)
+
+    def device_transform(self, x):
+        """Left-inclusive fixed-split one-hot — the device half of
+        ``transform_columns`` (operand: float32 with NaN for missing).
+        Out-of-range values fall into the nearest edge bucket unless
+        ``track_invalid`` gives them their own column, matching the host
+        path exactly (float32 threshold-ulp caveat as in bucketizers.py)."""
+        import jax
+        import jax.numpy as jnp
+
+        splits = jnp.asarray(np.asarray(self.splits, dtype=np.float32))
+        n_buckets = len(self.splits) - 1
+        ok = ~jnp.isnan(x)
+        v0 = jnp.nan_to_num(x)
+        idx = jnp.clip(jnp.searchsorted(splits, v0, side="right") - 1,
+                       0, n_buckets - 1)
+        in_range = ok & (x >= splits[0]) & (x <= splits[-1])
+        sel = in_range if self.track_invalid else ok
+        parts = [jax.nn.one_hot(idx, n_buckets, dtype=jnp.float32)
+                 * sel.astype(jnp.float32)[:, None]]
+        if self.track_invalid:
+            parts.append((ok & ~in_range).astype(jnp.float32)[:, None])
+        if self.track_nulls:
+            parts.append((~ok).astype(jnp.float32)[:, None])
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
 
     def transform_columns(self, cols, dataset):
         f = self.inputs[0]
@@ -160,6 +200,27 @@ class PercentileCalibratorModel(UnaryTransformer):
     def __init__(self, splits: np.ndarray, **kw):
         super().__init__(**kw)
         self.splits = np.asarray(splits, dtype=np.float64)
+
+    def device_transform(self, x):
+        """Percentile-bucket index as a traceable kernel (float32 caveat: a
+        score within one f32 ulp of a quantile edge can land one bucket off
+        the float64 host path)."""
+        import jax.numpy as jnp
+
+        inner = jnp.asarray(self.splits[1:-1].astype(np.float32))
+        idx = jnp.clip(jnp.searchsorted(inner, x, side="right"),
+                       0, len(self.splits) - 2)
+        return idx.astype(jnp.float32)
+
+    def device_state(self):
+        return (self.splits[1:-1].astype(np.float32),)
+
+    def device_transform_stateful(self, state, x):
+        import jax.numpy as jnp
+
+        idx = jnp.clip(jnp.searchsorted(state[0], x, side="right"),
+                       0, state[0].shape[0])
+        return idx.astype(jnp.float32)
 
     def transform_columns(self, cols, dataset):
         v = cols[0].data.astype(np.float64)

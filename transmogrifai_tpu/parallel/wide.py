@@ -43,7 +43,11 @@ def _mark_varying(x, axis: str):
     pcast = getattr(jax.lax, "pcast", None)
     if pcast is not None:
         return pcast(x, (axis,), to="varying")
-    return jax.lax.pvary(x, (axis,))
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, (axis,))
+    # jax <= 0.5: shard_map has no varying-type tracking — nothing to mark
+    return x
 
 
 def col_sharding(mesh: Mesh) -> NamedSharding:

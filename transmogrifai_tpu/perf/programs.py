@@ -258,6 +258,26 @@ def run_cached(fn, *args, kwargs: Optional[Dict[str, Any]] = None,
     return compiled(*args, **kwargs)
 
 
+def evict_program_entries(fns) -> int:
+    """Drop every cache/stat entry keyed on one of ``fns`` (by identity).
+
+    The in-memory key's last component is the function object itself, so
+    per-instance jitted closures (the transform planner's fused programs) can
+    release their executables when their owning plan is evicted — without
+    this, a long-running process doing repeated trains would pin every dead
+    plan's closure, fitted constants, and executables in the unbounded cache.
+    Returns the number of entries removed.
+    """
+    targets = {id(f) for f in fns}
+    removed = 0
+    with _LOCK:
+        for key in [k for k in _CACHE if id(k[-1]) in targets]:
+            _CACHE.pop(key, None)
+            _STATS.pop(key, None)
+            removed += 1
+    return removed
+
+
 def program_cache_stats() -> Dict[str, Any]:
     """Aggregate + per-program cache counters (bench ``compile`` section)."""
     with _LOCK:

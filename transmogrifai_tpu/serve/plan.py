@@ -28,9 +28,6 @@ slices them off before anything reads the result.
 
 from __future__ import annotations
 
-import hashlib
-import itertools
-import json
 import threading
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -44,11 +41,15 @@ from ..types import ColumnKind, NonNullableEmptyException
 from ..workflow.dag import compute_dag
 from ..workflow.fit import _resolve
 
-#: kinds with a canonical device lift: float32 rows, NaN where the validity
-#: mask is off.  VECTOR is deliberately absent — a raw vector column's width
-#: is only known from the data, which defeats bucket compilation (TM503).
-DEVICE_LIFT_KINDS = frozenset(
-    {ColumnKind.FLOAT, ColumnKind.INT, ColumnKind.BOOL, ColumnKind.GEO})
+# the partition/fusion primitives live in the shared transform planner
+# (workflow/plan.py) — serving, training, and CV prep are one code path;
+# re-exported here under their historical names.
+from ..workflow.plan import (  # noqa: F401 — re-exports
+    DEVICE_LIFT_KINDS,
+    device_slots,
+    partition_scoring_stages,
+    stage_content_fingerprint,
+)
 
 #: process-wide AOT executable cache: (plan fingerprint, bucket) -> compiled.
 #: Bounded FIFO — serving processes host a handful of live models, not many.
@@ -56,16 +57,6 @@ _EXEC_CACHE: Dict[Tuple[str, int], Any] = {}
 _EXEC_CACHE_MAX = 64
 _EXEC_CACHE_LOCK = threading.Lock()
 
-#: unique fingerprints for plans whose stage state cannot be hashed
-_UNSHARED_TOKENS = itertools.count()
-
-
-def device_slots(runner) -> Tuple[int, ...]:
-    """Input slots a runner's ``device_transform`` consumes (default: all)."""
-    slots = getattr(runner, "device_input_slots", None)
-    if slots is None:
-        return tuple(range(len(runner.inputs)))
-    return tuple(slots)
 
 
 def resolve_scoring_stages(result_features: Sequence[Feature],
@@ -85,40 +76,6 @@ def resolve_scoring_stages(result_features: Sequence[Feature],
                     "cannot compile a scoring plan")
             runners.append(runner)
     return runners
-
-
-def partition_scoring_stages(runners: Sequence[Any]):
-    """Split topo-ordered runners into (device prefix, host remainder).
-
-    A runner joins the prefix when it exposes ``device_transform`` and every
-    device-slot input is either another prefix output, or a raw feature with
-    a canonical lift / stage-provided encoding.  Returns
-    ``(prefix, remainder, device_uids)`` with ``device_uids`` the feature
-    uids materialized on device.
-    """
-    device_uids: set = set()
-    prefix: List[Any] = []
-    remainder: List[Any] = []
-    for runner in runners:
-        fn = getattr(runner, "device_transform", None)
-        ok = callable(fn) and len(runner.inputs) > 0
-        if ok:
-            for slot in device_slots(runner):
-                f = runner.inputs[slot]
-                if f.uid in device_uids:
-                    continue
-                if isinstance(f.origin_stage, FeatureGeneratorStage) and (
-                        f.ftype.kind in DEVICE_LIFT_KINDS
-                        or runner.device_lifts_input(slot)):
-                    continue
-                ok = False
-                break
-        if ok:
-            prefix.append(runner)
-            device_uids.add(runner.get_output().uid)
-        else:
-            remainder.append(runner)
-    return prefix, remainder, device_uids
 
 
 def _bucket_for(n: int, min_bucket: int, max_bucket: int) -> int:
@@ -382,38 +339,16 @@ class CompiledScoringPlan:
         return tuple(env[u] for u in self._out_uids)
 
     def _compute_fingerprint(self) -> str:
-        """Content hash of the fused program: prefix stage state + wiring.
-
-        Two plans with equal fingerprints trace to identical XLA programs
-        (stage constants are baked into the trace), so the process-wide
-        executable cache may share compilations between them.
-        """
-        from ..stages.base import Estimator
-        from ..workflow.serde import _Encoder, encode_stage
-
-        enc = _Encoder()
-        try:
-            payload = {
-                "stages": [encode_stage(s, enc, full=not isinstance(s, Estimator))
-                           for s in self._prefix],
-                "entries": [list(k) for k in self._entry_keys],
-                "specs": [[list(t), d] for t, d in self._entry_specs],
-                "outs": self._out_uids,
-            }
-            h = hashlib.sha256(
-                json.dumps(payload, sort_keys=True, default=repr).encode())
-            for key in sorted(enc.arrays):
-                arr = np.ascontiguousarray(enc.arrays[key])
-                h.update(f"{key}:{arr.shape}:{arr.dtype}".encode())
-                h.update(arr.tobytes())
-            return h.hexdigest()
-        except Exception:
-            # non-serializable stage state: no cross-plan sharing, the plan
-            # still caches its own executables under a token no other plan
-            # can ever produce (a process counter — NOT id(), whose values
-            # recycle after GC and would let a later plan inherit a dead
-            # plan's executables from the process-wide cache)
-            return f"unshared-{next(_UNSHARED_TOKENS)}"
+        """Content hash of the fused program (shared planner helper): prefix
+        stage state + wiring.  Equal fingerprints trace to identical XLA
+        programs, so the process-wide executable cache may share
+        compilations; unhashable stage state degrades to a process-unique
+        token (no cross-plan sharing, no recycled-id aliasing)."""
+        return stage_content_fingerprint(
+            self._prefix,
+            extra={"entries": [list(k) for k in self._entry_keys],
+                   "specs": [[list(t), d] for t, d in self._entry_specs],
+                   "outs": self._out_uids})
 
     # -- compilation ---------------------------------------------------------
     def _ensure_compiled(self, bucket: int):

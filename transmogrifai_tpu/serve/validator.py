@@ -58,7 +58,18 @@ def check_servability(result_features: Sequence[Feature],
             runner = st
         resolved.append(runner)
 
-    _prefix, remainder, device_uids = partition_scoring_stages(resolved)
+    prefix, remainder, device_uids = partition_scoring_stages(resolved)
+
+    # TM504 (info) — the planner's prefix/remainder split, so `cli lint
+    # --serving` shows what will fuse before any data is touched
+    if resolved:
+        host_names = ", ".join(sorted({type(r).__name__ for r in remainder})) \
+            or "none"
+        report.extend([make_diagnostic(
+            "TM504",
+            f"transform planner fuses {len(prefix)} of {len(resolved)} "
+            f"stage(s) into the jitted device prefix; host remainder: "
+            f"{len(remainder)} stage(s) ({host_names})")])
 
     # TM502 — host stage sandwiched between device-capable stages
     consumers: Dict[str, List[Any]] = {}

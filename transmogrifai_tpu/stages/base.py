@@ -301,6 +301,29 @@ class Transformer(PipelineStage):
         int32 code per row."""
         return (), "int32"
 
+    # -- fold-batched execution (workflow/plan.py transform_folds) -----------
+    def device_state(self) -> Optional[tuple]:
+        """Fitted constants ``device_transform`` bakes into its trace, as a
+        tuple of arrays — or None when the stage has no stateful device form.
+
+        The fold-batched transform planner stacks the k fold-fitted copies'
+        states along a leading fold axis and passes them as TRACED operands to
+        :meth:`device_transform_stateful` under ``jax.vmap``, so all k folds
+        execute as one program.  Stages whose fitted state only shapes the
+        program (e.g. a one-hot width) but never enters it as values should
+        return None; truly stateless transformers return ``()``.
+        """
+        return None
+
+    def device_transform_stateful(self, state: tuple, *arrays):
+        """``device_transform`` with the fitted constants supplied as traced
+        operands (``state`` is what :meth:`device_state` returned, possibly
+        vmapped over a fold axis).  Must compute exactly what
+        ``device_transform`` computes when ``state == self.device_state()``.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} declares no stateful device transform")
+
     def transform_columns(self, cols: List["Column"], dataset: "Dataset") -> "Column":
         raise NotImplementedError
 

@@ -33,8 +33,11 @@ def fit_dag(
     estimator fit (checkpoint hook).
     """
     fitted = dict(fitted or {})
-    for layer in compute_dag(result_features):
-        dataset = fit_stage_list(dataset, layer, fitted, on_fit=on_fit)
+    # one flattened topo-ordered pass (not per layer): the fused transform
+    # planner batches maximal runs of fitted transformers between estimator
+    # fits, and those runs may span DAG layers
+    stages = [s for layer in compute_dag(result_features) for s in layer]
+    dataset = fit_stage_list(dataset, stages, fitted, on_fit=on_fit)
     return dataset, fitted
 
 
@@ -42,8 +45,17 @@ def transform_dag(
     dataset: Dataset,
     result_features: Sequence[Feature],
     fitted: Dict[str, Transformer],
+    fused: bool | None = None,
 ) -> Dataset:
-    """Scoring path: apply fitted transformers only (no fitting allowed)."""
+    """Scoring path: apply fitted transformers only (no fitting allowed).
+
+    Default execution goes through the fused transform planner
+    (workflow/plan.py): the maximal device-capable prefix runs as ONE jitted,
+    row-sharded XLA program, the remainder per stage.  ``fused=False`` (or
+    ``TMOG_FUSED_TRANSFORM=0``, or an active stage-metrics listener) forces
+    the per-stage interpreted path; a planner failure falls back to it too.
+    """
+    runners = []
     for layer in compute_dag(result_features):
         for stage in layer:
             runner = _resolve(stage, fitted)
@@ -52,9 +64,17 @@ def transform_dag(
                     f"Stage {stage.uid} is an unfitted estimator; cannot score. "
                     "Train the workflow first."
                 )
-            with stage_timer(runner, "transform", dataset) as finish:
-                dataset = runner.transform(dataset)
-                finish(dataset)
+            runners.append(runner)
+    if fused is not False:
+        from .plan import fused_transform
+
+        out = fused_transform(dataset, runners)
+        if out is not None:
+            return out
+    for runner in runners:
+        with stage_timer(runner, "transform", dataset) as finish:
+            dataset = runner.transform(dataset)
+            finish(dataset)
     return dataset
 
 
@@ -68,9 +88,15 @@ def _resolve(stage: PipelineStage, fitted: Dict[str, Transformer]) -> Transforme
 
 
 def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer],
-                   on_fit=None) -> Dataset:
+                   on_fit=None, fused: bool | None = None) -> Dataset:
     """Fit/transform an explicit stage list (topological order) — the single
     fit/transform loop shared by fit_dag and the workflow-CV passes.
+
+    Post-fit transforms batch through the fused transform planner: maximal
+    runs of already-fitted runners between estimator fits execute as one
+    jitted program each (an estimator's fit needs its inputs materialized, so
+    fusion flushes at every fit boundary).  ``fused=False`` /
+    ``TMOG_FUSED_TRANSFORM=0`` / an active listener keep the per-stage path.
 
     Each stage's fit/transform also lands as a perf phase span (no-op unless
     a ``perf.timers.record_phases`` recorder is active — bench and callers
@@ -80,9 +106,28 @@ def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer],
     def _name(s) -> str:
         return getattr(s, "operation_name", None) or type(s).__name__
 
+    def _flush(ds: Dataset, runners) -> Dataset:
+        if not runners:
+            return ds
+        if fused is not False:
+            from .plan import fused_transform
+
+            out = fused_transform(ds, runners)
+            if out is not None:
+                return out
+        for runner in runners:
+            with phase(f"transform.{_name(runner)}"), \
+                    stage_timer(runner, "transform", ds) as finish:
+                ds = runner.transform(ds)
+                finish(ds)
+        return ds
+
+    pending: list = []
     for stage in stages:
         runner = _resolve(stage, fitted)
         if runner is None:
+            dataset = _flush(dataset, pending)
+            pending = []
             with phase(f"fit.{_name(stage)}"), \
                     stage_timer(stage, "fit", dataset) as finish:
                 model = stage.fit(dataset)
@@ -91,11 +136,8 @@ def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer],
             runner = model
             if on_fit is not None:
                 on_fit(model)
-        with phase(f"transform.{_name(runner)}"), \
-                stage_timer(runner, "transform", dataset) as finish:
-            dataset = runner.transform(dataset)
-            finish(dataset)
-    return dataset
+        pending.append(runner)
+    return _flush(dataset, pending)
 
 
 def workflow_cv_validate(ds_before: Dataset, during, selector) -> "object":
@@ -128,22 +170,50 @@ def workflow_cv_validate(ds_before: Dataset, during, selector) -> "object":
     k = train_w.shape[0]
     metric_fn = validator.evaluator.metric_fn()
 
+    # the fold fits only read the during-stages' inputs (plus label/weights):
+    # restrict the per-fold row take to those columns instead of copying the
+    # whole table k times
+    fit_cols = {label_f.name}
+    for s in during:
+        fit_cols.update(fi.name for fi in s.inputs)
+    if "__sample_weight__" in ds_before:
+        fit_cols.add("__sample_weight__")
+    ds_fit_view = ds_before.select([c for c in ds_before.names
+                                    if c in fit_cols])
+
+    # fit during-stage copies per fold on that fold's training rows only
+    fold_runner_maps: List[Dict[str, Transformer]] = []
+    fold_copies: List[list] = []
+    for f in range(k):
+        train_rows = np.flatnonzero(train_w[f] > 0)
+        ds_fold_train = ds_fit_view.take(train_rows)
+        fold_fitted: Dict[str, Transformer] = {}
+        copies = [s.copy() for s in during]
+        fit_stage_list(ds_fold_train, copies, fold_fitted)
+        # plain transformers in the cut have no fitted entry — the copy runs
+        fold_copies.append(copies)
+        fold_runner_maps.append(
+            {c.uid: fold_fitted.get(c.uid, c) for c in copies})
+
+    # apply fold-fitted stages to ALL rows (train + validation) through the
+    # fused planner — one vmapped program over the fold axis when stage
+    # states stack, else one fused plan per fold; host loop as fallback
+    from .plan import fused_fold_transforms
+
+    fold_datasets = fused_fold_transforms(ds_before, during, fold_runner_maps)
+    if fold_datasets is None:
+        fold_datasets = []
+        for f in range(k):
+            runners = fold_runner_maps[f]
+            ds_fold_full = ds_before
+            for s in during:
+                ds_fold_full = runners[s.uid].transform(ds_fold_full)
+            fold_datasets.append(ds_fold_full)
+
     # metric matrix per (model, grid) across folds
     per_key: Dict[tuple, list] = {}
     for f in range(k):
-        train_rows = np.flatnonzero(train_w[f] > 0)
-        ds_fold_train = ds_before.take(train_rows)
-        fold_fitted: Dict[str, Transformer] = {}
-        # fit during-stage copies on the fold's training rows only
-        copies = [s.copy() for s in during]
-        fit_stage_list(ds_fold_train, copies, fold_fitted)
-        # apply fold-fitted stages to ALL rows (train + validation); plain
-        # transformers in the cut have no fitted entry — the copy itself runs
-        runners = {c.uid: fold_fitted.get(c.uid, c) for c in copies}
-        ds_fold_full = ds_before
-        for s in during:
-            ds_fold_full = runners[s.uid].transform(ds_fold_full)
-        x_f = ds_fold_full[vec_f.name].data.astype(np.float32)
+        x_f = fold_datasets[f][vec_f.name].data.astype(np.float32)
         for est, grids in selector.models:
             grids = grids or [{}]
             try:
