@@ -42,7 +42,10 @@ error out, or the run is killed (SIGTERM/SIGINT handlers emit the partial
 result first, so an rc=124 run still records everything it measured).  The
 ``compile`` section reports the process compile budget: backend-compile
 count/seconds, persistent-cache hits, and the sweep-program executable-cache
-counters (``transmogrifai_tpu.perf``).  The selector phase breakdown comes
+counters (``transmogrifai_tpu.perf``).  The ``serve`` section (ISSUE 5)
+replays a clean fixture through the fault-tolerant serving engine — failure
+counters must stay zero — and measures degraded-mode (breaker-open,
+host-path) throughput at zero new backend compiles.  The selector phase breakdown comes
 from the phase spans recorded during the ONE timed fit — no extra sweep
 executions.  ``--smoke`` (or BENCH_SMOKE=1) is a tiny-rows mode that
 exercises every section end-to-end in well under a minute for CI.
@@ -369,6 +372,95 @@ def bench_transform(n_rows: int):
     }
 
 
+def bench_serve(n_records: int):
+    """Serving engine under the fault-tolerance layer: clean-fixture
+    throughput through submit() (micro-batched, resilience ON) plus the
+    degraded-mode figure — the same replay with the circuit breaker forced
+    open, served entirely from the interpreted host path.
+
+    Gates: on the clean fixture every failure counter must be zero
+    (quarantined / breaker trips / deadline evictions / record failures),
+    and degraded-mode serving performs zero new backend compiles.
+    """
+    from transmogrifai_tpu import FeatureBuilder, Workflow, transmogrify
+    from transmogrifai_tpu.perf import measure_compiles
+    from transmogrifai_tpu.readers.files import DataReaders
+    from transmogrifai_tpu.serve import ScoringServer
+
+    import pandas as pd
+
+    rng = np.random.default_rng(21)
+    n_train = 2_000
+    levels = [f"lv{j}" for j in range(12)]
+
+    def make_records(n, seed):
+        r = np.random.default_rng(seed)
+        x = r.normal(size=(n, 4))
+        return [{"label": float(r.random() < 1 / (1 + np.exp(-x[i, 0]))),
+                 **{f"num{j}": (None if r.random() < 0.1 else float(x[i, j]))
+                    for j in range(4)},
+                 "cat0": str(levels[int(r.integers(0, len(levels)))])}
+                for i in range(n)]
+
+    train = make_records(n_train, 22)
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"num{j}").extract_field().as_predictor()
+             for j in range(4)] + \
+            [FeatureBuilder.PickList("cat0").extract_field().as_predictor()]
+    checked = label.sanity_check(transmogrify(feats))
+    from transmogrifai_tpu import BinaryClassificationModelSelector
+    from transmogrifai_tpu.models.logistic import LogisticRegression
+
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+    pred = label.transform_with(sel, checked)
+    model = (Workflow().set_result_features(label, pred)
+             .set_reader(DataReaders.Simple.dataframe(pd.DataFrame(train)))
+             ).train()
+
+    records = [{k: v for k, v in r.items() if k != "label"}
+               for r in make_records(n_records, 23)]
+
+    def replay(server):
+        futs = [None] * len(records)
+        t0 = time.perf_counter()
+        for i, r in enumerate(records):
+            futs[i] = server.submit(r)
+        for f in futs:
+            f.result(timeout=120)
+        return len(records) / (time.perf_counter() - t0)
+
+    with ScoringServer(model, max_batch=64, max_wait_ms=1.0,
+                       max_queue=len(records) + 1) as server:
+        rps = replay(server)
+        clean = server.metrics()
+        # degraded mode: breaker pinned open, host path only, no new compiles
+        server.resilience.breaker.force_open()
+        with measure_compiles() as probe:
+            degraded_rps = replay(server)
+            degraded_compiles = probe.backend_compiles
+        server.resilience.breaker.force_close()
+        m = server.metrics()
+
+    res, bat = clean["resilience"], clean["batcher"]
+    return {
+        "records": len(records),
+        "throughput_rps": round(rps, 1),
+        "degraded_host_rps": round(degraded_rps, 1),
+        "degraded_backend_compiles": degraded_compiles,
+        "degraded_fallback_records": m["resilience"]["fallback_records"],
+        "quarantined": res["quarantined"],
+        "retries": res["retries"],
+        "breaker_opened_clean": res["breaker"]["opened"],
+        "deadline_expired": bat["deadline_expired"],
+        "cancelled": bat["cancelled"],
+        "record_failures": bat["failed"],
+        "clean_fixture_gate": bool(
+            res["quarantined"] == 0 and res["breaker"]["opened"] == 0
+            and bat["deadline_expired"] == 0 and bat["failed"] == 0),
+    }
+
+
 def bench_irls_mfu(n_rows: int, device_kind: str):
     """Achieved TFLOP/s (+ fraction of bf16 peak) of the IRLS CV sweep kernel."""
     import jax
@@ -531,6 +623,7 @@ _EMITTED = False
 _SECTION_FLOORS = {
     "baseline": 60.0,
     "transform": 45.0,
+    "serve": 40.0,
     "irls_mfu": 60.0,
     "tree_hist": 60.0,
     "tree_hist_batched": 90.0,
@@ -670,6 +763,14 @@ def main(argv=None):
         lambda: bench_transform(min(max(n_rows, 50_000), 250_000)))
     if tr is not None:
         _OUT["transform"] = tr
+
+    # serving engine + fault-tolerance layer: clean-fixture failure counters
+    # must be zero; degraded mode (breaker open, host path) is also measured
+    sv = _run_section(
+        "serve", budget,
+        lambda: bench_serve(1_000 if smoke else 5_000))
+    if sv is not None:
+        _OUT["serve"] = sv
 
     mfu = _run_section(
         "irls_mfu", budget,

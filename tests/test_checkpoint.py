@@ -284,5 +284,72 @@ class TestAdviceFixes:
             f"estimator refits; fitted={fitted}")
 
 
+class TestTornCheckpoints:
+    """Crash-resume robustness (ISSUE 5 satellite): a truncated/corrupt
+    checkpoint file — the torn-write shapes a preempted run leaves behind —
+    must log-and-refit that stage, never crash the resumed train()."""
+
+    def _train_once(self, tmp_path):
+        ds, label, pred = _pipeline()
+        ckpt = StageCheckpointer(str(tmp_path))
+        wf = Workflow().set_input_dataset(ds).set_result_features(label, pred)
+        wf.train(checkpointer=ckpt)
+        return ds, label, pred, ckpt, wf
+
+    def _resume_fits(self, wf, ckpt):
+        listener = add_listener(OpMetricsListener())
+        try:
+            model = wf.train(checkpointer=ckpt)
+        finally:
+            remove_listener(listener)
+        return model, [m.stage_class for m in listener.metrics.stage_metrics
+                       if m.phase == "fit"]
+
+    def test_truncated_npz_refits_stage_only(self, tmp_path):
+        ds, label, pred, ckpt, wf = self._train_once(tmp_path)
+        _jpath, npath = ckpt._paths(pred.origin_stage.uid)
+        blob = open(npath, "rb").read()
+        with open(npath, "wb") as fh:  # torn write: first half of the zip
+            fh.write(blob[:max(1, len(blob) // 2)])
+        model, fits = self._resume_fits(wf, ckpt)
+        assert fits == ["ModelSelector"], fits  # damaged stage refit, rest resumed
+        assert np.isfinite(
+            np.asarray(model.score(ds)[pred.name].score)).all()
+
+    def test_corrupt_json_refits_stage_only(self, tmp_path):
+        ds, label, pred, ckpt, wf = self._train_once(tmp_path)
+        jpath, _npath = ckpt._paths(pred.origin_stage.uid)
+        with open(jpath, "w") as fh:
+            fh.write('{"className": "SelectedMo')  # torn mid-object
+        _model, fits = self._resume_fits(wf, ckpt)
+        assert fits == ["ModelSelector"], fits
+
+    def test_json_present_npz_missing_refits(self, tmp_path):
+        """json landed, npz lost (the reverse torn-write): decode fails on
+        the missing arrays and the stage refits instead of crashing."""
+        import os
+
+        ds, label, pred, ckpt, wf = self._train_once(tmp_path)
+        _jpath, npath = ckpt._paths(pred.origin_stage.uid)
+        if os.path.exists(npath):
+            os.remove(npath)
+        _model, fits = self._resume_fits(wf, ckpt)
+        assert fits == ["ModelSelector"], fits
+
+    def test_load_entries_logs_and_skips(self, tmp_path, caplog):
+        import logging
+
+        ds, label, pred, ckpt, wf = self._train_once(tmp_path)
+        _jpath, npath = ckpt._paths(pred.origin_stage.uid)
+        with open(npath, "wb") as fh:
+            fh.write(b"\x00\x01not-a-zip")
+        with caplog.at_level(logging.WARNING,
+                             logger="transmogrifai_tpu.workflow.checkpoint"):
+            loaded = ckpt.load_entries()
+        assert pred.origin_stage.uid not in loaded
+        assert loaded  # the intact stages still load
+        assert any("not loadable" in r.message for r in caplog.records)
+
+
 def _keep_all_slots(cm):
     return False

@@ -354,3 +354,13 @@ class TestBenchSmoke:
         assert tr["speedup"] >= 3.0, tr
         assert tr["gate_3x"] is True
         assert tr["warm_transform_backend_compiles"] == 0
+        # serving fault-tolerance section: zero quarantines/breaker trips/
+        # deadline evictions on the clean fixture, and the degraded-mode
+        # (breaker-open, host-path) replay compiles nothing (ISSUE 5)
+        assert secs["serve"]["status"] == "ok", secs["serve"]
+        sv = parsed["serve"]
+        assert sv["clean_fixture_gate"] is True, sv
+        assert sv["quarantined"] == 0 and sv["breaker_opened_clean"] == 0
+        assert sv["degraded_backend_compiles"] == 0, sv
+        assert sv["degraded_host_rps"] > 0 and sv["throughput_rps"] > 0
+        assert sv["degraded_fallback_records"] == sv["records"], sv

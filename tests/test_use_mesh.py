@@ -122,6 +122,57 @@ class TestTwoDimensionalMesh:
         assert sm1.failed_models == [] and sm2.failed_models == []
         np.testing.assert_allclose(s1, s2, atol=1e-5)
 
+    def test_svc_rf_gbt_cv_metrics_bitwise_under_4x2_mesh(self):
+        """ROADMAP watch item (ISSUE 5 satellite): the SVC/RF/GBT CV programs
+        run sort-based metrics on sharded operands WITHOUT the replicated pin
+        the eval sweeps got in ISSUE 4 — their fold-vmapped payload sharding
+        avoids the GSPMD sort-miscompile shape on this jax, but that is a
+        property of the XLA build, so the bit-correctness claim gets a
+        regression test: per-fold CV metric values under a 4x2 mesh must be
+        BITWISE equal to the unmeshed fit (the miscompile class returned
+        auPR ~ -n, so any recurrence trips exact equality loudly)."""
+        from transmogrifai_tpu.models.svm import LinearSVC
+        from transmogrifai_tpu.models.trees import (
+            GradientBoostedTreesClassifier, RandomForestClassifier)
+
+        rng = np.random.default_rng(23)
+        n = 211
+        cols = {f"x{i}": rng.normal(size=n).tolist() for i in range(4)}
+        z = sum((i + 1) * 0.4 * np.asarray(cols[f"x{i}"]) for i in range(4))
+        cols["label"] = (rng.random(n) < 1 / (1 + np.exp(-z))
+                         ).astype(float).tolist()
+        ds = Dataset.from_features(
+            cols, {**{f"x{i}": Real for i in range(4)}, "label": RealNN})
+        label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+        fs = [FeatureBuilder.of(f"x{i}", Real).extract_field().as_predictor()
+              for i in range(4)]
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2,
+            models=[(LinearSVC(), [{"reg_param": r} for r in (0.01, 0.1)]),
+                    (RandomForestClassifier(num_trees=5, max_depth=3), [{}]),
+                    (GradientBoostedTreesClassifier(num_rounds=4, max_depth=2),
+                     [{}])])
+        p = label.transform_with(sel, transmogrify(fs))
+
+        m1 = (Workflow().set_input_dataset(ds)
+              .set_result_features(label, p).train())
+        with use_mesh(make_mesh(n_data=4, n_model=2)):
+            m2 = (Workflow().set_input_dataset(ds)
+                  .set_result_features(label, p).train())
+        sm1, sm2 = m1.summary(), m2.summary()
+        assert sm1.failed_models == [] and sm2.failed_models == []
+        ev1 = {(e.model_name, tuple(sorted(e.grid.items()))): e
+               for e in sm1.validation_results}
+        ev2 = {(e.model_name, tuple(sorted(e.grid.items()))): e
+               for e in sm2.validation_results}
+        assert set(ev1) == set(ev2)
+        for key in ev1:
+            v1, v2 = ev1[key].metric_values, ev2[key].metric_values
+            assert v1 == v2, (  # bitwise: any sort miscompile is NOT subtle
+                f"CV metrics diverged under the 4x2 mesh for {key}: "
+                f"{v1} != {v2}")
+        assert sm1.best_model_name == sm2.best_model_name
+
     def test_place_grid_shards_model_axis(self):
         from transmogrifai_tpu.models.base import place_grid
 

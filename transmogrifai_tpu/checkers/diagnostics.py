@@ -115,6 +115,16 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "into the jit-fused device prefix and the per-stage host "
               "remainder; widen the prefix by implementing device_transform "
               "on the listed host stages"),
+    "TM505": (Severity.ERROR, "invalid fault-tolerance configuration",
+              "fix the serving resilience parameters: retry counts must be "
+              ">= 0, backoff seconds > 0, breaker failure_threshold and "
+              "recovery_batches >= 1, and the dead-letter hook (if set) "
+              "must be callable"),
+    "TM506": (Severity.WARNING, "deadline tighter than the batch flush wait",
+              "the default request deadline is not longer than the "
+              "batcher's max_wait_ms, so every request that waits for a "
+              "full flush window expires in the queue and is evicted "
+              "unscored; raise the deadline or lower max_wait_ms"),
     # -- leakage ------------------------------------------------------------
     "TM401": (Severity.ERROR, "label leaks into feature path",
               "a response(-derived) feature reaches the model's feature input "

@@ -5,9 +5,17 @@ types) combined with this port's serving engine (serve/, docs/serving.md).
 There is no HTTP or stdio protocol here — :class:`ScoringServer` is an
 in-process API; this subcommand loads a saved model, replays a JSONL record
 stream through the micro-batcher (every record goes through ``submit``, so
-batching/backpressure/deadline policies are exercised exactly as a real
-embedding would), writes one JSON result per line, and emits the merged
-plan + batcher counters as a final JSON metrics object.
+batching/backpressure/deadline/fault-isolation policies are exercised
+exactly as a real embedding would), writes one JSON result per line, and
+emits the merged plan + batcher + resilience counters as a final JSON
+metrics object.
+
+Robust replay: malformed JSONL lines are skipped-and-counted (stderr
+warning, ``replay.skipped_malformed`` in the metrics) instead of crashing
+the stream, and a record whose scoring fails (poison quarantine, expired
+deadline, ...) emits an ``{"error": ..., "error_type": ...}`` output line in
+its position — the replay finishes and exits nonzero instead of dying on
+the first bad future.
 
 Run::
 
@@ -19,7 +27,7 @@ from __future__ import annotations
 
 import json
 import sys
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Tuple
 
 
 def add_serve_parser(sub) -> None:
@@ -45,18 +53,47 @@ def add_serve_parser(sub) -> None:
                    help="smallest power-of-two padding bucket (default 8)")
     p.add_argument("--no-warm", action="store_true",
                    help="skip ahead-of-time bucket compilation")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-request deadline enforced in the batch queue "
+                        "(expired requests are evicted unscored)")
+    p.add_argument("--no-resilience", action="store_true",
+                   help="disable the fault-tolerance layer (quarantine, "
+                        "retry, circuit breaker); one bad record then fails "
+                        "its whole co-batch")
 
 
-def _read_records(path: str) -> List[Dict[str, Any]]:
+def _read_records(path: str) -> Tuple[List[Dict[str, Any]], int]:
+    """(records, skipped_malformed).  Bad JSONL lines are skipped-and-counted
+    (a poisoned replay file must not kill the whole replay); only an
+    entirely empty stream aborts."""
     fh = sys.stdin if path == "-" else open(path)
+    records: List[Dict[str, Any]] = []
+    skipped = 0
     try:
-        records = [json.loads(line) for line in fh if line.strip()]
+        for lineno, line in enumerate(fh, 1):
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                skipped += 1
+                print(f"serve: skipping malformed JSONL line {lineno}: {e}",
+                      file=sys.stderr)
     finally:
         if fh is not sys.stdin:
             fh.close()
     if not records:
         raise SystemExit(f"serve: no records in {path!r}")
-    return records
+    return records, skipped
+
+
+def _resolve(future) -> Tuple[Dict[str, Any], bool]:
+    """(output row, ok): a failed future becomes an error row in the record's
+    position instead of killing the replay."""
+    try:
+        return future.result(), True
+    except Exception as e:  # noqa: BLE001 — every failure becomes a row
+        return {"error": str(e), "error_type": type(e).__name__}, False
 
 
 def run_serve(ns) -> int:
@@ -64,16 +101,18 @@ def run_serve(ns) -> int:
     from ..workflow.workflow import WorkflowModel
 
     model = WorkflowModel.load(ns.model)
-    records = _read_records(ns.records)
+    records, skipped = _read_records(ns.records)
 
     from collections import deque
 
     from ..serve import QueueFullError
 
+    errors = 0
     with ScoringServer(model, max_batch=ns.max_batch,
                        max_wait_ms=ns.max_wait_ms, max_queue=ns.max_queue,
-                       min_bucket=ns.min_bucket,
-                       warm=not ns.no_warm) as server:
+                       min_bucket=ns.min_bucket, warm=not ns.no_warm,
+                       resilience=not ns.no_resilience,
+                       deadline_ms=ns.deadline_ms) as server:
         futures: deque = deque()
         results = []
         for r in records:
@@ -83,9 +122,17 @@ def run_serve(ns) -> int:
                     break
                 except QueueFullError:
                     # backpressure: wait for the oldest in-flight request
-                    results.append(futures.popleft().result())
-        results.extend(f.result() for f in futures)
+                    row, ok = _resolve(futures.popleft())
+                    errors += not ok
+                    results.append(row)
+        for f in futures:
+            row, ok = _resolve(f)
+            errors += not ok
+            results.append(row)
         metrics = server.metrics()
+    metrics["replay"] = {"records": len(records),
+                         "skipped_malformed": skipped,
+                         "record_errors": errors}
 
     out = sys.stdout if ns.output == "-" else open(ns.output, "w")
     try:
@@ -101,4 +148,4 @@ def run_serve(ns) -> int:
             fh.write(blob + "\n")
     else:
         print(blob, file=sys.stderr)
-    return 0
+    return 0 if errors == 0 else 1

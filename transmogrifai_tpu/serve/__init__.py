@@ -6,21 +6,42 @@ protocol: a fitted DAG partitions into a jit-fused device prefix plus a host
 remainder (:class:`~.plan.CompiledScoringPlan`), requests flow through an
 adaptive bounded queue (:class:`~.batcher.MicroBatcher`, Clipper-style
 flush-on-size/deadline), and :class:`~.server.ScoringServer` composes both
-behind an in-process API with plain-dict metrics.  ``serve/validator.py``
-contributes the TM5xx servability diagnostics; see docs/serving.md.
+behind an in-process API with plain-dict metrics.  ``serve/resilience.py``
+adds the fault-tolerance layer (poison-record quarantine, retry/backoff, a
+host-path circuit breaker) with deterministic fault injection in
+``serve/faults.py``; ``serve/validator.py`` contributes the TM5xx
+servability diagnostics.  See docs/serving.md.
 """
 
 from .batcher import BatcherClosedError, MicroBatcher, QueueFullError
+from .faults import (
+    CircuitOpenError,
+    DeadlineExceededError,
+    FaultHarness,
+    PoisonRecordError,
+    TransientScoringError,
+    is_retryable,
+)
 from .plan import CompiledScoringPlan, compile_plan
+from .resilience import CircuitBreaker, ResilientScorer
 from .server import ScoringServer
-from .validator import check_servability
+from .validator import check_resilience_config, check_servability
 
 __all__ = [
     "BatcherClosedError",
+    "CircuitBreaker",
+    "CircuitOpenError",
     "CompiledScoringPlan",
+    "DeadlineExceededError",
+    "FaultHarness",
     "MicroBatcher",
+    "PoisonRecordError",
     "QueueFullError",
+    "ResilientScorer",
     "ScoringServer",
+    "TransientScoringError",
+    "check_resilience_config",
     "check_servability",
     "compile_plan",
+    "is_retryable",
 ]

@@ -15,9 +15,22 @@ Backpressure is admission control: a full queue rejects ``submit`` with
 or retry with jitter).  ``shutdown(drain=True)`` stops admission, drains the
 queue in full batches with no deadline waits, and joins the flusher.
 
-Counters (submissions, rejections, batch-size histogram, queue depth, and a
-bounded latency reservoir for p50/p95/p99) export as a plain dict — the
-benchmark/CLI surface, no metrics dependency.
+Request deadlines: ``submit(record, deadline_ms=...)`` bounds the request's
+TOTAL queue life, enforced server-side — an expired request is evicted with
+:class:`~.faults.DeadlineExceededError` inside the queue (making room under
+backpressure) and again at flush time, BEFORE any device call is spent on it.
+This replaces relying on the client-side ``future.result(timeout)`` alone,
+which burned a device slot on an answer nobody was still waiting for.
+
+Per-record fault isolation: a scorer exposing ``score_isolated(records) ->
+[result | Exception, ...]`` (serve/resilience.py) gets per-record outcomes
+routed to per-record futures — a poison record fails only its own future
+instead of co-failing the whole flushed batch.
+
+Counters (submissions, rejections, cancellations, deadline evictions,
+batch-size histogram, queue depth, and a bounded latency reservoir for
+p50/p95/p99) export as a plain dict — the benchmark/CLI surface, no metrics
+dependency.
 """
 
 from __future__ import annotations
@@ -27,6 +40,8 @@ import time
 from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+from .faults import DeadlineExceededError
 
 #: bounded reservoir of completed-request latencies (seconds)
 _LATENCY_WINDOW = 4096
@@ -41,12 +56,15 @@ class BatcherClosedError(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("record", "future", "t_enqueue")
+    __slots__ = ("record", "future", "t_enqueue", "deadline")
 
-    def __init__(self, record: Mapping[str, Any]):
+    def __init__(self, record: Mapping[str, Any],
+                 deadline_ms: Optional[float] = None):
         self.record = record
         self.future: Future = Future()
         self.t_enqueue = time.monotonic()
+        self.deadline = None if deadline_ms is None \
+            else self.t_enqueue + float(deadline_ms) / 1e3
 
 
 class MicroBatcher:
@@ -63,6 +81,9 @@ class MicroBatcher:
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
         self._score = score_batch
+        # per-record isolation protocol (serve/resilience.py): outcomes are
+        # routed future-by-future instead of all-or-nothing
+        self._isolated = callable(getattr(score_batch, "score_isolated", None))
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.max_queue = int(max_queue)
@@ -72,7 +93,8 @@ class MicroBatcher:
         self._wake = threading.Condition(self._lock)
         self._open = True
         self._counters = {"submitted": 0, "rejected": 0, "completed": 0,
-                          "failed": 0, "batches": 0}
+                          "failed": 0, "cancelled": 0, "deadline_expired": 0,
+                          "batches": 0}
         self._batch_sizes: Dict[int, int] = {}
         self._latencies: "deque[float]" = deque(maxlen=_LATENCY_WINDOW)
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -80,30 +102,69 @@ class MicroBatcher:
         self._thread.start()
 
     # -- client API ----------------------------------------------------------
-    def submit(self, record: Mapping[str, Any]) -> Future:
+    def submit(self, record: Mapping[str, Any],
+               deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one record; resolves to its result dict.
 
-        Raises :class:`QueueFullError` when the queue is at capacity and
-        :class:`BatcherClosedError` after shutdown began.
+        ``deadline_ms`` bounds the request's queue life: once it expires the
+        request is evicted with :class:`DeadlineExceededError` instead of
+        spending a device call on it.  Raises :class:`QueueFullError` when
+        the queue is at capacity and :class:`BatcherClosedError` after
+        shutdown began.
         """
-        req = _Request(record)
-        with self._wake:
-            if not self._open:
-                raise BatcherClosedError("MicroBatcher is shut down")
-            if len(self._pending) >= self.max_queue:
-                self._counters["rejected"] += 1
-                raise QueueFullError(
-                    f"request queue at capacity ({self.max_queue}); "
-                    "shed load or retry")
-            self._counters["submitted"] += 1
-            self._pending.append(req)
-            self._wake.notify_all()
+        req = _Request(record, deadline_ms)
+        expired: List[_Request] = []
+        try:
+            with self._wake:
+                if not self._open:
+                    raise BatcherClosedError("MicroBatcher is shut down")
+                if len(self._pending) >= self.max_queue:
+                    # expired requests are dead weight: evict them before
+                    # rejecting a live one (deadline enforcement IN the queue)
+                    expired = self._pop_expired_locked()
+                if len(self._pending) >= self.max_queue:
+                    self._counters["rejected"] += 1
+                    raise QueueFullError(
+                        f"request queue at capacity ({self.max_queue}); "
+                        "shed load or retry")
+                self._counters["submitted"] += 1
+                self._pending.append(req)
+                self._wake.notify_all()
+        finally:
+            # resolve evicted futures OUTSIDE the lock: set_exception runs
+            # client done-callbacks synchronously, and a callback touching
+            # the batcher would deadlock on the non-reentrant lock
+            for r in expired:
+                r.future.set_exception(DeadlineExceededError(
+                    "request deadline expired while queued"))
         return req.future
 
+    def _pop_expired_locked(self) -> List[_Request]:
+        """Remove queued requests whose deadline passed (lock held) and
+        return the CLAIMED ones for the caller to fail outside the lock."""
+        now = time.monotonic()
+        if not any(r.deadline is not None and r.deadline <= now
+                   for r in self._pending):
+            return []
+        keep: "deque[_Request]" = deque()
+        expired: List[_Request] = []
+        for r in self._pending:
+            if r.deadline is not None and r.deadline <= now:
+                if r.future.set_running_or_notify_cancel():
+                    self._counters["deadline_expired"] += 1
+                    expired.append(r)
+                else:
+                    self._counters["cancelled"] += 1
+            else:
+                keep.append(r)
+        self._pending = keep
+        return expired
+
     def score(self, record: Mapping[str, Any],
-              timeout: Optional[float] = None) -> Any:
+              timeout: Optional[float] = None,
+              deadline_ms: Optional[float] = None) -> Any:
         """Synchronous single-record convenience: submit + wait."""
-        return self.submit(record).result(timeout)
+        return self.submit(record, deadline_ms=deadline_ms).result(timeout)
 
     def __call__(self, record: Mapping[str, Any]) -> Any:
         return self.score(record)
@@ -111,18 +172,21 @@ class MicroBatcher:
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:
         """Stop admission; drain (or fail) queued requests; join the flusher."""
+        evicted: List[_Request] = []
         with self._wake:
             self._open = False
             if not drain:
                 while self._pending:
                     req = self._pending.popleft()
                     if req.future.set_running_or_notify_cancel():
-                        req.future.set_exception(BatcherClosedError(
-                            "batcher shut down before flush"))
-                        # client-cancelled requests don't count as failed —
-                        # same accounting as the flusher's claim filter
-                        self._counters["failed"] += 1
+                        evicted.append(req)
+                    # server-side cancellation, not a scoring failure — same
+                    # bucket as a client-side cancel() the claim filter sees
+                    self._counters["cancelled"] += 1
             self._wake.notify_all()
+        for req in evicted:  # outside the lock: done-callbacks may re-enter
+            req.future.set_exception(BatcherClosedError(
+                "batcher shut down before flush"))
         self._thread.join(timeout)
 
     def __enter__(self) -> "MicroBatcher":
@@ -173,20 +237,49 @@ class MicroBatcher:
             take = min(self.max_batch, len(self._pending))
             return [self._pending.popleft() for _ in range(take)]
 
+    def _claim(self, batch: List[_Request]) -> List[_Request]:
+        """Claim futures and evict expired requests before any device call.
+
+        Claiming every future before scoring matters: a client-side cancel()
+        on a still-pending future would otherwise make the later
+        set_result/set_exception raise InvalidStateError and kill the flusher
+        thread, hanging all subsequent requests.  Deadline eviction happens
+        HERE — after the queue wait, before the scorer — so an expired
+        request never costs a device dispatch.
+        """
+        now = time.monotonic()
+        claimed: List[_Request] = []
+        cancelled = expired = 0
+        for r in batch:
+            if not r.future.set_running_or_notify_cancel():
+                cancelled += 1
+                continue
+            if r.deadline is not None and r.deadline <= now:
+                expired += 1
+                r.future.set_exception(DeadlineExceededError(
+                    "request deadline expired before flush"))
+                continue
+            claimed.append(r)
+        if cancelled or expired:
+            with self._lock:
+                self._counters["cancelled"] += cancelled
+                self._counters["deadline_expired"] += expired
+        return claimed
+
     def _run(self) -> None:
         while True:
             batch = self._take_batch()
             if batch is None:
                 return
-            # claim every future before scoring: a client-side cancel() on a
-            # still-pending future would otherwise make the later
-            # set_result/set_exception raise InvalidStateError and kill the
-            # flusher thread, hanging all subsequent requests
-            batch = [r for r in batch if r.future.set_running_or_notify_cancel()]
+            batch = self._claim(batch)
             if not batch:
                 continue
             try:
-                results = self._score([r.record for r in batch])
+                if self._isolated:
+                    results = self._score.score_isolated(
+                        [r.record for r in batch])
+                else:
+                    results = self._score([r.record for r in batch])
                 if len(results) != len(batch):
                     raise RuntimeError(
                         f"score_batch returned {len(results)} results for "
@@ -201,12 +294,18 @@ class MicroBatcher:
                     r.future.set_exception(e)
                 continue
             now = time.monotonic()
+            ok = [not isinstance(res, Exception) for res in results]
             with self._lock:
-                self._counters["completed"] += len(batch)
+                self._counters["completed"] += sum(ok)
+                self._counters["failed"] += len(batch) - sum(ok)
                 self._counters["batches"] += 1
                 size = len(batch)
                 self._batch_sizes[size] = self._batch_sizes.get(size, 0) + 1
-                for r in batch:
-                    self._latencies.append(now - r.t_enqueue)
-            for r, res in zip(batch, results):
-                r.future.set_result(res)
+                for r, good in zip(batch, ok):
+                    if good:
+                        self._latencies.append(now - r.t_enqueue)
+            for r, res, good in zip(batch, results, ok):
+                if good:
+                    r.future.set_result(res)
+                else:
+                    r.future.set_exception(res)

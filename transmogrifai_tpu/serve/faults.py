@@ -1,0 +1,202 @@
+"""Typed serving faults + a deterministic fault-injection harness.
+
+Reference role: the reference's serving story leans on input hygiene
+(RawFeatureFilter, SURVEY §7) and engine-free local scoring staying up under
+production traffic; Clipper (Crankshaw et al., NSDI'17) adds the systems half
+— adaptive batching AMPLIFIES failures (one bad record or one transient
+device error co-fails every batched peer) unless the serving layer isolates
+them.  This module defines the typed error vocabulary the fault-tolerance
+layer speaks (serve/resilience.py, serve/batcher.py) and a seeded,
+scriptable fault injector so every failure path is testable with EXACT
+schedules instead of sleeps and luck.
+
+Fault points (fired by ``CompiledScoringPlan.score``):
+
+- ``encode`` — host-side record extraction/encoding (where malformed payloads
+  surface);
+- ``device`` — the compiled fused-program dispatch (where transient
+  resource-exhausted / XLA runtime errors surface);
+- ``host``   — the interpreted host-remainder stages.
+
+Usage in tests::
+
+    harness = FaultHarness(seed=0)
+    harness.script("device", [TransientScoringError("oom"), None])
+    with harness:                       # first device call fails, rest pass
+        server.score_batch(records)
+    assert harness.calls["device"] == 2
+
+Schedules are consumed per firing, so a scripted failure happens exactly
+once; predicate rules (``fail_when``) fire whenever their predicate matches
+the call context (e.g. "any batch containing the poison record").  The
+harness is process-global while active (the micro-batcher scores on its own
+thread, so a contextvar would not reach it) — one harness at a time.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "CircuitOpenError",
+    "DeadlineExceededError",
+    "FaultHarness",
+    "PoisonRecordError",
+    "TransientScoringError",
+    "fault_point",
+    "is_retryable",
+]
+
+
+# ---------------------------------------------------------------------------
+# Typed serving errors
+# ---------------------------------------------------------------------------
+
+class PoisonRecordError(RuntimeError):
+    """One record is individually unscorable: its future fails, its co-batched
+    peers do not.  Raised by the bisect-and-retry quarantine
+    (serve/resilience.py) with the original failure as ``cause``."""
+
+    def __init__(self, message: str, cause: Optional[BaseException] = None):
+        super().__init__(message)
+        self.cause = cause
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline expired while it waited in the batch queue; it
+    was evicted before any device call was spent on it."""
+
+
+class TransientScoringError(RuntimeError):
+    """A retryable infrastructure failure (device resource exhaustion,
+    transport hiccup) — retry with backoff, never quarantine the records."""
+
+
+class CircuitOpenError(RuntimeError):
+    """No scoring path is available: the device plan's circuit breaker is
+    open AND the interpreted host fallback failed for this request."""
+
+    def __init__(self, message: str, cause: Optional[BaseException] = None):
+        super().__init__(message)
+        self.cause = cause
+
+
+#: substrings marking a device/XLA error as retryable infrastructure noise
+_RETRYABLE_MARKERS = ("resource_exhausted", "resource exhausted",
+                      "out of memory", "deadline_exceeded (xla)",
+                      "unavailable:")
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Transient (retry with backoff) vs permanent (bisect/quarantine).
+
+    Explicit :class:`TransientScoringError` is always retryable; anything the
+    XLA runtime raises is sniffed for resource-exhaustion/unavailability
+    markers (jaxlib's ``XlaRuntimeError`` carries the gRPC-style status in
+    its message).  Everything else — type errors, value errors, poison
+    payloads — is permanent: retrying cannot fix the input.
+    """
+    if isinstance(exc, TransientScoringError):
+        return True
+    if type(exc).__name__ == "XlaRuntimeError":
+        msg = str(exc).lower()
+        return any(m in msg for m in _RETRYABLE_MARKERS)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Deterministic fault injection
+# ---------------------------------------------------------------------------
+
+#: the one active harness (process-global: the batcher flusher is another
+#: thread, so contextvars would not propagate to the scoring call site)
+_ACTIVE: Optional["FaultHarness"] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+class FaultHarness:
+    """Seeded, scriptable fault schedules for the serving fault points.
+
+    - ``script(point, schedule)`` — the n-th firing of ``point`` raises the
+      n-th schedule entry (None entries pass; callables get the call context
+      and return an exception or None).  Entries beyond the schedule pass.
+    - ``fail_when(point, predicate, make_error, times=None)`` — raise
+      whenever ``predicate(ctx)`` matches, at most ``times`` times (None =
+      unbounded).  Predicate rules run after (and independent of) scripts.
+    - ``calls`` — firings per point; ``fired`` — (point, call index) log of
+      every injected failure, for exact-schedule assertions.
+
+    ``seed`` makes any randomized schedule (callable entries using
+    ``harness.rng``) reproducible run-to-run.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.calls: Dict[str, int] = {}
+        self.fired: List[tuple] = []
+        self._scripts: Dict[str, List[Any]] = {}
+        self._rules: List[tuple] = []  # (point, predicate, make_error, left)
+        self._lock = threading.Lock()
+
+    # -- schedule construction ----------------------------------------------
+    def script(self, point: str, schedule) -> "FaultHarness":
+        self._scripts.setdefault(point, []).extend(schedule)
+        return self
+
+    def fail_when(self, point: str, predicate: Callable[[dict], bool],
+                  make_error: Callable[[], BaseException],
+                  times: Optional[int] = None) -> "FaultHarness":
+        self._rules.append([point, predicate, make_error, times])
+        return self
+
+    # -- firing --------------------------------------------------------------
+    def _check(self, point: str, ctx: dict) -> Optional[BaseException]:
+        with self._lock:
+            idx = self.calls.get(point, 0)
+            self.calls[point] = idx + 1
+            entry = None
+            sched = self._scripts.get(point)
+            if sched and idx < len(sched):
+                entry = sched[idx]
+            if callable(entry):
+                entry = entry(ctx)
+            if entry is None:
+                for rule in self._rules:
+                    rpoint, pred, make_error, left = rule
+                    if rpoint != point or left == 0:
+                        continue
+                    if pred(ctx):
+                        if left is not None:
+                            rule[3] = left - 1
+                        entry = make_error()
+                        break
+            if entry is not None:
+                self.fired.append((point, idx))
+            return entry
+
+    # -- activation ----------------------------------------------------------
+    def __enter__(self) -> "FaultHarness":
+        global _ACTIVE
+        with _ACTIVE_LOCK:
+            if _ACTIVE is not None:
+                raise RuntimeError("another FaultHarness is already active")
+            _ACTIVE = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        with _ACTIVE_LOCK:
+            _ACTIVE = None
+
+
+def fault_point(point: str, **ctx) -> None:
+    """Hook called from the scoring hot path; raises the scheduled fault when
+    a harness is active, otherwise costs one global read."""
+    harness = _ACTIVE
+    if harness is None:
+        return
+    err = harness._check(point, ctx)
+    if err is not None:
+        raise err

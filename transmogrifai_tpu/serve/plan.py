@@ -48,8 +48,10 @@ from ..workflow.plan import (  # noqa: F401 — re-exports
     DEVICE_LIFT_KINDS,
     device_slots,
     partition_scoring_stages,
+    run_host_stages,
     stage_content_fingerprint,
 )
+from .faults import fault_point
 
 #: process-wide AOT executable cache: (plan fingerprint, bucket) -> compiled.
 #: Bounded FIFO — serving processes host a handful of live models, not many.
@@ -410,6 +412,7 @@ class CompiledScoringPlan:
 
         from ..readers.base import extract_columns
 
+        fault_point("encode", records=records)
         host_cols = extract_columns(records, self._host_raw,
                                     allow_missing_response=True)
 
@@ -433,18 +436,55 @@ class CompiledScoringPlan:
                         runner.encode_device_input(slot, col)))
             bucket = _bucket_for(n, self.min_bucket, self.max_bucket)
             compiled = self._ensure_compiled(bucket)
+            fault_point("device", records=records, bucket=bucket)
             outs = compiled(*[_pad_rows(a, bucket) for a in entries])
             for f, dev in zip(self._out_features, outs):
                 cols[f.name] = self._materialize(f, np.asarray(dev)[:n])
 
-        ds = Dataset(cols)
-        for runner in self._remainder:
-            ds = runner.transform(ds)
+        fault_point("host", records=records)
+        ds = run_host_stages(Dataset(cols), self._remainder)
+        out = self._rows_from(ds, n)
+        with self._lock:
+            self._counters["scored_records"] += n
+            self._counters["scored_batches"] += 1
+            if self._prefix:
+                bb = self._counters["bucket_batches"]
+                bb[bucket] = bb.get(bucket, 0) + 1
+        return out
 
+    def score_host(self, records: Sequence[Mapping[str, Any]]
+                   ) -> List[Dict[str, Any]]:
+        """Full interpreted scoring: every stage (device prefix included) runs
+        its host ``transform`` — the degraded path the circuit breaker
+        (serve/resilience.py) serves from while the compiled plan is broken.
+
+        Output contract and values match ``LocalScorer.batch`` exactly (same
+        extraction, same per-stage columnar loop), which is bitwise-equal to
+        the engine path; no XLA program is touched, so degradation performs
+        zero backend compiles.
+        """
+        n = len(records)
+        if n == 0:
+            return []
+        from ..readers.base import extract_columns
+
+        ds = Dataset(extract_columns(
+            records, [(g.raw_name, g) for g in self._generators],
+            allow_missing_response=True))
+        ds = run_host_stages(ds, self._runners)
+        out = self._rows_from(ds, n)
+        with self._lock:
+            self._counters["host_scored_records"] = \
+                self._counters.get("host_scored_records", 0) + n
+        return out
+
+    def _rows_from(self, ds: Dataset, n: int) -> List[Dict[str, Any]]:
+        """Result-feature columns -> one plain dict per record (the
+        Map[String,Any] contract both scoring paths share)."""
         from ..local.scoring import _plain
         from ..models.prediction import PredictionColumn
 
-        out = [{} for _ in records]
+        out: List[Dict[str, Any]] = [{} for _ in range(n)]
         for f in self.result_features:
             if f.name not in ds:
                 continue
@@ -457,12 +497,6 @@ class CompiledScoringPlan:
             else:
                 for row, v in zip(out, col.to_values()):
                     row[name] = _plain(v)
-        with self._lock:
-            self._counters["scored_records"] += n
-            self._counters["scored_batches"] += 1
-            if self._prefix:
-                bb = self._counters["bucket_batches"]
-                bb[bucket] = bb.get(bucket, 0) + 1
         return out
 
     @staticmethod

@@ -1,0 +1,307 @@
+"""Fault-isolated scoring: quarantine, retry/backoff, and a circuit breaker.
+
+Reference role: Clipper (Crankshaw et al., NSDI'17) warns that adaptive
+micro-batching amplifies failures — one poison record or one transient device
+error co-fails every batched peer, and a persistently broken compiled plan
+takes the whole server down.  :class:`ResilientScorer` sits between the
+micro-batcher and the compiled plan and turns batch-level failures into
+per-record outcomes:
+
+- **poison isolation** — a non-retryable batch failure bisect-and-retries:
+  halves rescore until the genuinely poisonous records are singled out and
+  quarantined (:class:`~.faults.PoisonRecordError`, ``quarantined`` counter,
+  optional dead-letter callback); survivors rescore through the SAME compiled
+  plan, so their results are bitwise identical to a clean run (row-local
+  kernels + padding buckets — docs/serving.md).
+- **transient retry** — retryable failures (:func:`~.faults.is_retryable`)
+  back off exponentially with seeded jitter, bounded by ``max_retries``; a
+  batch-shaped failure that survives retries falls back to scoring in halves
+  (smaller padding buckets) before being declared a device failure.
+- **circuit breaker** — ``failure_threshold`` consecutive device failures
+  open the breaker: scoring degrades to the interpreted host path
+  (``CompiledScoringPlan.score_host`` — the per-stage fallback the fused
+  planner keeps alive) while every ``recovery_batches`` host-served batches a
+  half-open probe retries the compiled plan; one success recloses.  State
+  transitions and fallback-scored counts export through ``metrics()``.
+
+Recovery is measured in BATCHES, not wall-clock, so breaker behavior is
+deterministic under the fault harness (serve/faults.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+from .faults import CircuitOpenError, PoisonRecordError, is_retryable
+
+log = logging.getLogger(__name__)
+
+#: bisect depth bound: 2^20 records per batch is far beyond any flush size
+_MAX_SPLIT_DEPTH = 20
+
+
+class CircuitBreaker:
+    """closed -> open -> half-open state machine around the device plan.
+
+    ``failure_threshold`` consecutive device failures open it; while open,
+    every batch serves from the host path and after ``recovery_batches`` of
+    those a half-open probe lets ONE batch try the device plan again —
+    success recloses, failure re-opens (and restarts the recovery count).
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failure_threshold: int = 3, recovery_batches: int = 8):
+        if failure_threshold < 1 or recovery_batches < 1:
+            raise ValueError("failure_threshold and recovery_batches "
+                             "must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.recovery_batches = int(recovery_batches)
+        self.state = self.CLOSED
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._host_since_open = 0
+        self._held_open = False
+        self._counters = {"opened": 0, "reclosed": 0, "probes": 0}
+        #: bounded: a flapping dependency must not grow memory or bloat
+        #: every metrics() scrape; totals live in the counters
+        self.transitions: "deque[str]" = deque(maxlen=64)
+
+    def _to(self, state: str) -> None:
+        self.transitions.append(f"{self.state}->{state}")
+        self.state = state
+
+    # -- decision + outcome hooks (called once per batch) --------------------
+    def allow_device(self) -> bool:
+        """True when this batch may try the compiled plan (closed, or an
+        open breaker due a half-open probe)."""
+        with self._lock:
+            if self.state == self.CLOSED or self.state == self.HALF_OPEN:
+                return True
+            if self._held_open:
+                return False
+            if self._host_since_open >= self.recovery_batches:
+                self._to(self.HALF_OPEN)
+                self._counters["probes"] += 1
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self.state == self.HALF_OPEN:
+                self._to(self.CLOSED)
+                self._counters["reclosed"] += 1
+            self._consecutive = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self.state == self.HALF_OPEN:
+                # a failed probe is a fresh open: operators watching
+                # "opened" must see the continuing incident, not one blip
+                self._to(self.OPEN)
+                self._counters["opened"] += 1
+                self._host_since_open = 0
+                return
+            self._consecutive += 1
+            if self.state == self.CLOSED \
+                    and self._consecutive >= self.failure_threshold:
+                self._to(self.OPEN)
+                self._counters["opened"] += 1
+                self._host_since_open = 0
+
+    def record_host_batch(self) -> None:
+        with self._lock:
+            if self.state == self.OPEN:
+                self._host_since_open += 1
+
+    # -- operator overrides (bench degraded-mode measurement, drills) --------
+    def force_open(self) -> None:
+        """Pin the breaker open (no half-open probes) until force_close()."""
+        with self._lock:
+            if self.state != self.OPEN:
+                self._to(self.OPEN)
+                self._counters["opened"] += 1
+            self._held_open = True
+            self._host_since_open = 0
+
+    def force_close(self) -> None:
+        with self._lock:
+            self._held_open = False
+            if self.state != self.CLOSED:
+                self._to(self.CLOSED)
+            self._consecutive = 0
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"state": self.state,
+                    "consecutive_failures": self._consecutive,
+                    "transitions": list(self.transitions),  # last 64
+                    **self._counters}
+
+
+class ResilientScorer:
+    """Per-record fault isolation over a compiled plan + host fallback.
+
+    The micro-batcher detects ``score_isolated`` and uses it instead of the
+    all-or-nothing batch contract: the return value is one entry per record,
+    each either a result dict or an ``Exception`` instance (set on that
+    record's future alone).
+    """
+
+    def __init__(self, plan, host_score: Optional[Callable] = None, *,
+                 max_retries: int = 2, backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 1.0, failure_threshold: int = 3,
+                 recovery_batches: int = 8,
+                 dead_letter: Optional[Callable] = None,
+                 seed: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._plan = plan
+        self._host = host_score if host_score is not None \
+            else getattr(plan, "score_host", None)
+        self.max_retries = int(max_retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.breaker = CircuitBreaker(failure_threshold=failure_threshold,
+                                      recovery_batches=recovery_batches)
+        self._dead_letter = dead_letter
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._counters = {"quarantined": 0, "retries": 0, "bucket_splits": 0,
+                          "bisect_batches": 0, "device_failures": 0,
+                          "fallback_batches": 0, "fallback_records": 0}
+
+    # -- public entry points -------------------------------------------------
+    def score_isolated(self, records: Sequence[Mapping[str, Any]]
+                       ) -> List[Any]:
+        """One outcome per record: a result dict, or the Exception that fails
+        (only) that record's future."""
+        if not records:
+            return []
+        if self.breaker.allow_device():
+            try:
+                out = self._device_with_retry(list(records))
+                self.breaker.record_success()
+                return out
+            except Exception as e:  # noqa: BLE001 — classified below
+                if is_retryable(e):
+                    # infrastructure failure that survived retries AND the
+                    # split-to-smaller-bucket fallback: a device problem, not
+                    # a record problem — count it toward the breaker and
+                    # serve THIS batch degraded from the host path
+                    self.breaker.record_failure()
+                    with self._lock:
+                        self._counters["device_failures"] += 1
+                    log.warning("device scoring failed after retries (%s: "
+                                "%s); serving batch from the host path",
+                                type(e).__name__, e)
+                    return self._host_fallback(records)
+                # permanent failure: some record(s) in the batch are poison —
+                # bisect so only those are quarantined (halves still get the
+                # transient-retry treatment on the way down)
+                out = self._isolate(list(records), self._device_with_retry, e)
+                if any(not isinstance(r, Exception) for r in out):
+                    # the device path served the survivors: that's a healthy
+                    # plan, so the consecutive-failure count must reset
+                    self.breaker.record_success()
+                return out
+        return self._host_fallback(records)
+
+    def __call__(self, records: Sequence[Mapping[str, Any]]
+                 ) -> List[Dict[str, Any]]:
+        """Legacy all-or-nothing contract: raise the first per-record error."""
+        out = self.score_isolated(records)
+        for r in out:
+            if isinstance(r, Exception):
+                raise r
+        return out
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            out = dict(self._counters)
+        out["breaker"] = self.breaker.metrics()
+        return out
+
+    # -- device path ---------------------------------------------------------
+    def _device_with_retry(self, records: List[Any], depth: int = 0):
+        attempt = 0
+        while True:
+            try:
+                return self._plan.score(records)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not is_retryable(e):
+                    raise
+                if attempt < self.max_retries:
+                    delay = min(self.backoff_cap_s,
+                                self.backoff_base_s * (2 ** attempt))
+                    # full jitter (seeded when the caller needs determinism)
+                    self._sleep(delay * (0.5 + 0.5 * self._rng.random()))
+                    attempt += 1
+                    with self._lock:
+                        self._counters["retries"] += 1
+                    continue
+                if len(records) > 1 and depth < _MAX_SPLIT_DEPTH:
+                    # batch-shaped failure (resource exhaustion scales with
+                    # the padding bucket): halve into smaller buckets
+                    with self._lock:
+                        self._counters["bucket_splits"] += 1
+                    mid = len(records) // 2
+                    return (self._device_with_retry(records[:mid], depth + 1)
+                            + self._device_with_retry(records[mid:],
+                                                      depth + 1))
+                raise
+
+    # -- poison isolation ----------------------------------------------------
+    def _isolate(self, records: List[Any], score_fn: Callable,
+                 exc: BaseException) -> List[Any]:
+        """Bisect-and-retry: rescore halves until the failing records are
+        singled out; survivors come back bitwise equal to a clean run (same
+        compiled plan, row-local kernels)."""
+        if len(records) == 1:
+            return [self._quarantine(records[0], exc)]
+        with self._lock:
+            self._counters["bisect_batches"] += 1
+        mid = len(records) // 2
+        out: List[Any] = []
+        for half in (records[:mid], records[mid:]):
+            try:
+                out.extend(score_fn(half))
+            except Exception as e:  # noqa: BLE001 — recurse to singletons
+                out.extend(self._isolate(half, score_fn, e))
+        return out
+
+    def _quarantine(self, record, exc: BaseException) -> PoisonRecordError:
+        with self._lock:
+            self._counters["quarantined"] += 1
+        err = PoisonRecordError(
+            f"record quarantined: scoring failed with "
+            f"{type(exc).__name__}: {exc}", cause=exc)
+        if self._dead_letter is not None:
+            try:
+                self._dead_letter(record, exc)
+            except Exception as dl:  # noqa: BLE001 — DLQ must not break serving
+                log.warning("dead-letter callback failed: %s", dl)
+        return err
+
+    # -- degraded host path --------------------------------------------------
+    def _host_fallback(self, records: Sequence[Mapping[str, Any]]
+                       ) -> List[Any]:
+        self.breaker.record_host_batch()
+        if self._host is None:
+            err = CircuitOpenError(
+                "device plan unavailable and no host fallback configured")
+            return [err for _ in records]
+        try:
+            out = self._host(list(records))
+        except Exception as e:  # noqa: BLE001 — isolate on the host path too
+            out = self._isolate(list(records), self._host, e)
+        with self._lock:
+            self._counters["fallback_batches"] += 1
+            self._counters["fallback_records"] += sum(
+                1 for r in out if not isinstance(r, Exception))
+        return out

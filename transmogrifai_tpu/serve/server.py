@@ -6,31 +6,58 @@ behind a micro-batcher (serve/batcher.py), exposed as a plain in-process
 object — no HTTP, no stdio protocol — so any transport (gRPC handler, WSGI
 view, queue consumer) can embed it.  ``cli serve`` drives the same API from
 the command line for smoke runs and benchmarks.
+
+Between the batcher and the plan sits the fault-tolerance layer
+(serve/resilience.py, on by default): poison records quarantine individually
+instead of co-failing their batch, transient device errors retry with
+backoff, and a circuit breaker degrades to the interpreted host path when
+the compiled plan is persistently broken.  ``resilience=False`` restores the
+bare plan; ``resilience={...}`` overrides the layer's parameters (validated
+up front — TM505/TM506, serve/validator.py).
 """
 
 from __future__ import annotations
 
+import logging
 from concurrent.futures import Future
-from typing import Any, Dict, List, Mapping, Optional, Sequence
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Union
 
 from .batcher import MicroBatcher
 from .plan import CompiledScoringPlan
+from .resilience import ResilientScorer
+
+log = logging.getLogger(__name__)
+
+#: ResilientScorer keyword defaults the server exposes for override
+_RESILIENCE_DEFAULTS = {
+    "max_retries": 2,
+    "backoff_base_s": 0.05,
+    "backoff_cap_s": 1.0,
+    "failure_threshold": 3,
+    "recovery_batches": 8,
+    "dead_letter": None,
+    "seed": None,
+}
 
 
 class ScoringServer:
-    """Compiled plan + micro-batcher with a merged metrics surface.
+    """Compiled plan + fault-tolerance layer + micro-batcher, one metrics dict.
 
-    - ``submit(record) -> Future`` — asynchronous, micro-batched (the
-      production request path; rejects with QueueFullError under pressure).
+    - ``submit(record, deadline_ms=...) -> Future`` — asynchronous,
+      micro-batched (the production request path; rejects with
+      QueueFullError under pressure, evicts with DeadlineExceededError when
+      the deadline passes in the queue).
     - ``score(record)`` — synchronous convenience over ``submit``.
     - ``score_batch(records)`` — bypasses the queue straight into the plan
-      (bulk/offline callers that already hold a batch).
-    - ``metrics()`` — plan + batcher counters as one plain dict.
+      (bulk/offline callers that already hold a batch; no fault isolation).
+    - ``metrics()`` — plan + batcher + resilience counters as one plain dict.
     """
 
     def __init__(self, model, max_batch: int = 256, max_wait_ms: float = 2.0,
                  max_queue: int = 4096, min_bucket: int = 8,
-                 max_bucket: Optional[int] = None, warm: bool = True):
+                 max_bucket: Optional[int] = None, warm: bool = True,
+                 resilience: Union[bool, Mapping[str, Any]] = True,
+                 deadline_ms: Optional[float] = None):
         if max_bucket is None:
             # every flushed batch must fit one bucket, so a single fused call
             # serves the largest flush the batcher can produce
@@ -40,17 +67,57 @@ class ScoringServer:
                                         max_bucket=max_bucket)
         if warm:
             self.plan.warm()
-        self.batcher = MicroBatcher(self.plan.score, max_batch=max_batch,
+        self.default_deadline_ms = deadline_ms
+
+        self.resilience: Optional[ResilientScorer] = None
+        if resilience:
+            params = dict(_RESILIENCE_DEFAULTS)
+            if isinstance(resilience, Mapping):
+                unknown = set(resilience) - set(params)
+                if unknown:
+                    raise TypeError(
+                        f"unknown resilience parameter(s): {sorted(unknown)}")
+                params.update(resilience)
+            self._validate_resilience(params, deadline_ms, max_wait_ms)
+            self.resilience = ResilientScorer(self.plan, **params)
+        score_fn: Any = self.resilience if self.resilience is not None \
+            else self.plan.score
+        self.batcher = MicroBatcher(score_fn, max_batch=max_batch,
                                     max_wait_ms=max_wait_ms,
                                     max_queue=max_queue)
 
+    @staticmethod
+    def _validate_resilience(params: Dict[str, Any],
+                             deadline_ms: Optional[float],
+                             max_wait_ms: float) -> None:
+        from ..checkers.diagnostics import OpCheckError
+        from .validator import check_resilience_config
+
+        report = check_resilience_config(
+            max_retries=params["max_retries"],
+            backoff_base_s=params["backoff_base_s"],
+            backoff_cap_s=params["backoff_cap_s"],
+            failure_threshold=params["failure_threshold"],
+            recovery_batches=params["recovery_batches"],
+            dead_letter=params["dead_letter"],
+            default_deadline_ms=deadline_ms,
+            max_wait_ms=max_wait_ms)
+        if report.errors():
+            raise OpCheckError(report)
+        for d in report.warnings():
+            log.warning("%s", d.pretty())
+
     # -- request paths -------------------------------------------------------
-    def submit(self, record: Mapping[str, Any]) -> Future:
-        return self.batcher.submit(record)
+    def submit(self, record: Mapping[str, Any],
+               deadline_ms: Optional[float] = None) -> Future:
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        return self.batcher.submit(record, deadline_ms=deadline_ms)
 
     def score(self, record: Mapping[str, Any],
-              timeout: Optional[float] = None) -> Dict[str, Any]:
-        return self.batcher.score(record, timeout=timeout)
+              timeout: Optional[float] = None,
+              deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+        return self.submit(record, deadline_ms=deadline_ms).result(timeout)
 
     def score_batch(self, records: Sequence[Mapping[str, Any]]
                     ) -> List[Dict[str, Any]]:
@@ -70,4 +137,6 @@ class ScoringServer:
     def metrics(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"plan": self.plan.metrics(),
                                "batcher": self.batcher.metrics()}
+        if self.resilience is not None:
+            out["resilience"] = self.resilience.metrics()
         return out

@@ -16,6 +16,10 @@ hazards surface from ``workflow.validate(serving=True)``, ``cli lint
   the data (an OPVector column) feeds a device-capable stage; padding buckets
   amortize the row axis only, so every new width forces a recompile and the
   planner keeps such consumers on host.
+- **TM505** (error) / **TM506** (warning): fault-tolerance configuration
+  checks (:func:`check_resilience_config`) — invalid retry/breaker numbers,
+  and a default deadline the flush wait makes unmeetable.  Run by
+  :class:`~.server.ScoringServer` before any request is accepted.
 """
 
 from __future__ import annotations
@@ -27,6 +31,54 @@ from ..features.feature import Feature
 from ..features.generator import FeatureGeneratorStage
 from ..stages.base import Estimator
 from ..types import ColumnKind
+
+
+def check_resilience_config(*, max_retries: int = 0,
+                            backoff_base_s: float = 0.05,
+                            backoff_cap_s: float = 1.0,
+                            failure_threshold: int = 3,
+                            recovery_batches: int = 8,
+                            dead_letter: Any = None,
+                            default_deadline_ms: Optional[float] = None,
+                            max_wait_ms: Optional[float] = None
+                            ) -> DiagnosticReport:
+    """Static validation of the serving fault-tolerance parameters.
+
+    TM505 (error): numerically impossible retry/backoff/breaker settings, or
+    a non-callable dead-letter hook — the layer could never run as asked.
+    TM506 (warning): a default deadline no longer than the batcher's flush
+    wait, so every request that waits out a full flush window is evicted
+    unscored.
+    """
+    report = DiagnosticReport()
+
+    def bad(msg: str) -> None:
+        report.extend([make_diagnostic("TM505", msg)])
+
+    if max_retries < 0:
+        bad(f"max_retries must be >= 0, got {max_retries}")
+    if backoff_base_s <= 0 or backoff_cap_s <= 0:
+        bad(f"backoff seconds must be > 0, got base={backoff_base_s}, "
+            f"cap={backoff_cap_s}")
+    if backoff_cap_s < backoff_base_s:
+        bad(f"backoff_cap_s ({backoff_cap_s}) < backoff_base_s "
+            f"({backoff_base_s}): the cap would truncate the first retry")
+    if failure_threshold < 1:
+        bad(f"failure_threshold must be >= 1, got {failure_threshold}")
+    if recovery_batches < 1:
+        bad(f"recovery_batches must be >= 1, got {recovery_batches}")
+    if dead_letter is not None and not callable(dead_letter):
+        bad(f"dead_letter must be callable, got {type(dead_letter).__name__}")
+    if default_deadline_ms is not None and default_deadline_ms <= 0:
+        bad(f"default_deadline_ms must be > 0, got {default_deadline_ms}")
+    if default_deadline_ms is not None and max_wait_ms is not None \
+            and 0 < default_deadline_ms <= max_wait_ms:
+        report.extend([make_diagnostic(
+            "TM506",
+            f"default deadline ({default_deadline_ms} ms) is not longer "
+            f"than the batcher flush wait ({max_wait_ms} ms); queued "
+            "requests will expire before they can flush")])
+    return report
 
 
 def check_servability(result_features: Sequence[Feature],

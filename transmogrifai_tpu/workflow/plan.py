@@ -150,6 +150,22 @@ def partition_device_prefix(runners: Sequence[Any], entry_ok: Callable):
     return prefix, remainder, device_uids
 
 
+def run_host_stages(dataset: Dataset, runners: Sequence[Any]) -> Dataset:
+    """Shared host-remainder entry point: the per-stage interpreted transform
+    loop.  Every fused-planner consumer (training transform, CV folds, the
+    serving plan's remainder, AND the serving circuit breaker's degraded
+    host path) runs host stages through here, so the fallback path is the
+    same code in every mode — one loop to keep alive, one set of phase spans.
+    """
+    from ..perf.timers import phase
+
+    out = dataset
+    for runner in runners:
+        with phase(f"transform.{type(runner).__name__}"):
+            out = runner.transform(out)
+    return out
+
+
 def _serving_entry_ok(runner, slot, f) -> bool:
     """Serving rule: raw features only, canonical lift or stage encoding."""
     return isinstance(f.origin_stage, FeatureGeneratorStage) and (
@@ -433,13 +449,7 @@ class ColumnarTransformPlan:
         own); the cached-plan entry points run ``apply_prefix`` plus the
         caller's current remainder instead.
         """
-        from ..perf.timers import phase
-
-        out = self.apply_prefix(dataset)
-        for runner in self._remainder:
-            with phase(f"transform.{type(runner).__name__}"):
-                out = runner.transform(out)
-        return out
+        return run_host_stages(self.apply_prefix(dataset), self._remainder)
 
     # -- fold-batched execution ----------------------------------------------
     def _fold_plan_ok(self, fold_by_uid: List[Dict[str, Any]]):
@@ -671,7 +681,6 @@ def fused_transform(dataset: Dataset, runners: Sequence[Any]
                     ) -> Optional[Dataset]:
     """Fused transform of ``runners`` over ``dataset``; None -> caller falls
     back to the per-stage path (nothing fuses, listener active, or failure)."""
-    from ..perf.timers import phase
     from ..utils.listener import active_listeners
 
     if not fused_transforms_enabled() or active_listeners():
@@ -687,10 +696,7 @@ def fused_transform(dataset: Dataset, runners: Sequence[Any]
         return None
     # the remainder runs the caller's CURRENT stage objects; its failures are
     # real transform failures and must propagate, not trigger a re-run
-    for runner in remainder:
-        with phase(f"transform.{type(runner).__name__}"):
-            out = runner.transform(out)
-    return out
+    return run_host_stages(out, remainder)
 
 
 def fused_fold_transforms(dataset: Dataset, during: Sequence[Any],
@@ -730,12 +736,8 @@ def fused_fold_transforms(dataset: Dataset, during: Sequence[Any],
         return None
     # host remainders run OUTSIDE the fallback guard: their failures are real
     # transform failures that must propagate, not planner failures to retry
-    out = []
-    for ds_f, remainder in zip(batched, remainders):
-        for runner in remainder:
-            ds_f = runner.transform(ds_f)
-        out.append(ds_f)
-    return out
+    return [run_host_stages(ds_f, remainder)
+            for ds_f, remainder in zip(batched, remainders)]
 
 
 def clear_plan_cache() -> None:
