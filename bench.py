@@ -362,7 +362,7 @@ def bench_transform(n_rows: int):
         transform_dag(ds, features, fitted)
         warm_compiles = probe.backend_compiles
     speedup = dt_interp / max(dt_fused, 1e-9)
-    return {
+    out = {
         "rows": n,
         "fused_rows_per_sec": round(n / dt_fused, 1),
         "interpreted_rows_per_sec": round(n / dt_interp, 1),
@@ -370,6 +370,25 @@ def bench_transform(n_rows: int):
         "gate_3x": bool(speedup >= 3.0),
         "warm_transform_backend_compiles": warm_compiles,
     }
+    # static cost model (checkers/plancheck.py): abstract jaxpr trace of the
+    # SAME fused plan transform_dag just ran — predicted FLOPs/bytes recorded
+    # beside the measured throughput so driver artifacts cross-check the
+    # analyzer's calibration (asserted in test_perf smoke)
+    try:
+        from transmogrifai_tpu.checkers.plancheck import analyze_transform
+
+        rep = analyze_transform(ds, features, fitted)
+        if rep is not None and rep.buckets:
+            b = rep.buckets[-1]
+            out.update({
+                "predicted_flops": b.flops,
+                "predicted_bytes": b.bytes_read + b.bytes_written,
+                "predicted_peak_hbm_bytes": b.peak_hbm_bytes,
+                "predicted_intensity": round(b.intensity, 4),
+            })
+    except Exception as e:  # noqa: BLE001 — the bench must still emit
+        out["predicted_error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 def bench_serve(n_records: int):
@@ -500,7 +519,19 @@ def bench_irls_mfu(n_rows: int, device_kind: str):
     tflops = flops / dt / 1e12
     peak = next((v for k, v in _PEAK_TFLOPS.items() if k in device_kind.lower()),
                 None)
-    return tflops, (tflops / peak if peak else None)
+    # static cost model of the SAME sweep program (abstract jaxpr trace):
+    # the calibration ratio vs the analytic count above is the bench's
+    # cross-check that the MFU numbers rest on a sane FLOP model
+    predicted = None
+    try:
+        from transmogrifai_tpu.checkers.plancheck import trace_cost
+
+        seg = trace_cost(lambda a, b, c, d: _irls_sweep(a, b, c, d, iters),
+                         xd, yd, twd, rd, name="irls_sweep")
+        predicted = seg.flops
+    except Exception:  # noqa: BLE001 — the bench must still emit
+        pass
+    return tflops, (tflops / peak if peak else None), flops, predicted
 
 
 def bench_tree_hist(n_rows: int, device_kind: str):
@@ -776,9 +807,14 @@ def main(argv=None):
         "irls_mfu", budget,
         lambda: bench_irls_mfu(min(n_rows, 250_000), device_kind))
     if mfu is not None:
-        tflops, frac = mfu
+        tflops, frac, analytic_flops, predicted_flops = mfu
         _OUT["irls_sweep_tflops"] = round(tflops, 2)
         _OUT["irls_sweep_mfu"] = round(frac, 4) if frac is not None else None
+        _OUT["irls_sweep_analytic_flops"] = analytic_flops
+        _OUT["irls_sweep_predicted_flops"] = predicted_flops
+        _OUT["irls_sweep_flops_calibration"] = \
+            round(predicted_flops / analytic_flops, 4) \
+            if predicted_flops else None
 
     hist = _run_section(
         "tree_hist", budget,

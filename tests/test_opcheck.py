@@ -92,6 +92,47 @@ class OcHostSync(UnaryTransformer):
         return Column.from_values(Real, [total] * len(cols[0]))
 
 
+class OcFoldStateful(UnaryTransformer):
+    """Clean ``device_state`` stage: the stateful form matches the plain form
+    under the fold-vmapped protocol (workflow/plan.py transform_folds)."""
+
+    input_types = (Real,)
+    output_type = Real
+
+    def transform_columns(self, cols, dataset):
+        return Column.from_values(Real, list(cols[0].values_f64() * 2.0))
+
+    def device_transform(self, x):
+        return x * 2.0
+
+    def device_state(self):
+        return (np.asarray([2.0], np.float32),)
+
+    def device_transform_stateful(self, state, x):
+        return x * state[0][0]
+
+
+class OcFoldStatefulBroken(OcFoldStateful):
+    """Seeded TM204 (stacked-fold form): ``device_transform`` is fine, but
+    the stateful form reshapes its state to a size it does not have — the
+    bug class the single-state check cannot see, which at fold-CV time
+    silently degraded to the per-fold host loop (PR 4 protocol regression)."""
+
+    def device_transform_stateful(self, state, x):
+        import jax.numpy as jnp
+
+        return x * jnp.reshape(state[0], (3,))[0]  # state[0] has 1 element
+
+
+class OcFoldStatefulDrifts(OcFoldStateful):
+    """Seeded TM204 (stacked-fold form): the stateful form traces, but its
+    per-fold output diverges from ``device_transform`` (extra trailing axis),
+    so the fold-vmapped CV program would compute something else."""
+
+    def device_transform_stateful(self, state, x):
+        return x[:, None] * state[0]  # (rows, 1), plain form returns (rows,)
+
+
 class OcLabelGrab(UnaryTransformer):
     """Seeded TM401: consumes the response as a plain input (no label slot)
     and emits a predictor-typed feature — the label leaks downstream."""
@@ -294,6 +335,31 @@ class TestTypeShape:
         s.output_type = Integral  # params changed after get_output()
         report = validate_result_features([out])
         assert len(report.by_code("TM203")) == 1
+
+    # -- stacked-fold (device_state) form: the PR 4 fold-vmap protocol ------
+
+    def test_clean_device_state_stage_passes_stacked_fold_check(self):
+        out = _raw("a").transform_with(OcFoldStateful())
+        report = validate_result_features([out])
+        assert not report.by_code("TM204"), report.pretty()
+
+    def test_broken_stateful_form_fires_tm204_via_stacked_fold_eval(self):
+        """check_shapes must eval_shape the STACKED-FOLD form, not just the
+        single-state form: device_transform alone is clean here, so only the
+        vmapped device_transform_stateful trace can catch the bug."""
+        out = _raw("a").transform_with(OcFoldStatefulBroken())
+        report = validate_result_features([out])
+        tm204 = report.by_code("TM204")
+        assert len(tm204) == 1, report.pretty()
+        assert "stacked-fold" in tm204[0].message
+        assert report.errors()
+
+    def test_stateful_output_drift_fires_tm204(self):
+        out = _raw("a").transform_with(OcFoldStatefulDrifts())
+        report = validate_result_features([out])
+        tm204 = report.by_code("TM204")
+        assert len(tm204) == 1, report.pretty()
+        assert "diverges" in tm204[0].message
 
 
 # ---------------------------------------------------------------------------

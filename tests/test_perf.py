@@ -364,3 +364,44 @@ class TestBenchSmoke:
         assert sv["degraded_backend_compiles"] == 0, sv
         assert sv["degraded_host_rps"] > 0 and sv["throughput_rps"] > 0
         assert sv["degraded_fallback_records"] == sv["records"], sv
+        # static cost model (ISSUE 6): predicted FLOPs/bytes recorded beside
+        # the measured transform/sweep numbers, calibration within the band
+        assert tr["predicted_flops"] > 0, tr
+        assert tr["predicted_bytes"] > 0, tr
+        assert tr["predicted_peak_hbm_bytes"] > 0, tr
+        if secs.get("irls_mfu", {}).get("status") == "ok":
+            assert parsed["irls_sweep_predicted_flops"] > 0
+            cal = parsed["irls_sweep_flops_calibration"]
+            assert 0.2 <= cal <= 5.0, \
+                f"static FLOP model drifted from the analytic count: {cal}"
+
+    def test_bench_emits_json_under_sigterm_mid_section(self):
+        """Regression for the PR 3 signal handlers (the BENCH_r05 rc=124 run
+        predated them and recorded NOTHING): a SIGTERM delivered mid-section
+        must still flush the one JSON line, tagged with the signal name."""
+        import signal
+
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "BENCH_SMOKE": "1",
+               # big enough that the selector section far outlives the kill
+               "BENCH_ROWS": "60000", "BENCH_BUDGET_S": "600",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+        proc = subprocess.Popen([sys.executable, "bench.py", "--smoke"],
+                                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        try:
+            # handlers install before the heavy jax import; 12s lands the
+            # signal well inside the (minutes-long at 60k CPU rows) selector
+            time.sleep(12.0)
+            proc.send_signal(signal.SIGTERM)
+            stdout, stderr = proc.communicate(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+        lines = [ln for ln in stdout.strip().splitlines() if ln.strip()]
+        assert lines, f"no stdout at all; stderr: {stderr[-2000:]}"
+        parsed = json.loads(lines[-1])
+        assert parsed["interrupted"] == "SIGTERM"
+        assert parsed["metric"] == "selector_cv_models_per_sec_1m_rows"
+        # the handler exits 0 after flushing — the JSON is the contract
+        assert proc.returncode == 0, (proc.returncode, stderr[-500:])

@@ -371,3 +371,146 @@ def build():
             env=env, capture_output=True, text=True, timeout=300)
         assert r.returncode == 1, r.stdout + r.stderr
         assert "TM204" in r.stdout
+
+    def test_format_json_one_diagnostic_per_line(self, tmp_path):
+        """Satellite (ISSUE 6): machine-readable JSONL — one diagnostic per
+        line with code/severity/stageUid/message — the lint_gate contract."""
+        p = tmp_path / "sneaky.py"
+        p.write_text(_HAZARD_SOURCE)
+        r = self._lint("--path", str(p), "--format", "json")
+        assert r.returncode == 1
+        lines = [json.loads(ln) for ln in r.stdout.strip().splitlines()]
+        assert lines, r.stdout
+        for obj in lines:
+            assert {"code", "severity", "stageUid", "message"} <= set(obj)
+        assert lines[0]["code"] == "TM301"
+        assert lines[0]["severity"] == "warning"
+
+    def test_concurrency_flag_adds_tm306(self, tmp_path):
+        p = tmp_path / "caches.py"
+        p.write_text("_CACHE = {}\n"
+                     "def put(k, v):\n"
+                     "    _CACHE[k] = v\n")
+        clean = self._lint("--path", str(p), "--all-functions")
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        r = self._lint("--path", str(p), "--concurrency")
+        assert r.returncode == 1
+        assert "TM306" in r.stdout
+
+
+class TestCliLintCost:
+    """``cli lint --cost`` (ISSUE 6 tentpole): the PlanCostReport from the
+    command line, with the TM601 HBM admission error on a tiny budget."""
+
+    @pytest.fixture(scope="class")
+    def saved_model(self, tmp_path_factory):
+        import pandas as pd
+
+        from transmogrifai_tpu import (
+            BinaryClassificationModelSelector,
+            transmogrify,
+        )
+        from transmogrifai_tpu.models.logistic import LogisticRegression
+        from transmogrifai_tpu.readers.files import DataReaders
+
+        rng = np.random.default_rng(13)
+        records = [{"label": float(rng.random() < 0.5),
+                    "x1": float(rng.normal()),
+                    "x2": float(rng.normal())} for _ in range(200)]
+        label = FeatureBuilder.RealNN("label").extract_field().as_response()
+        f1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+        f2 = FeatureBuilder.Real("x2").extract_field().as_predictor()
+        vec = transmogrify([f1, f2])
+        checked = label.sanity_check(vec)
+        sel = BinaryClassificationModelSelector.with_train_validation_split(
+            models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+        pred = label.transform_with(sel, checked)
+        model = (Workflow().set_result_features(label, pred)
+                 .set_reader(DataReaders.Simple.dataframe(
+                     pd.DataFrame(records)))).train()
+        path = str(tmp_path_factory.mktemp("m") / "model")
+        model.save(path)
+        return path
+
+    def _lint(self, *args):
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "transmogrifai_tpu.cli", "lint", *args],
+            env=env, capture_output=True, text=True, timeout=300)
+
+    def test_cost_emits_plan_cost_report(self, saved_model):
+        r = self._lint("--model", saved_model, "--cost",
+                       "--format", "json", "--fail-on", "error")
+        assert r.returncode == 0, r.stdout + r.stderr
+        lines = [json.loads(ln) for ln in r.stdout.strip().splitlines()]
+        reports = [ln["planCostReport"] for ln in lines
+                   if "planCostReport" in ln]
+        assert len(reports) == 1
+        rep = reports[0]
+        assert rep["totalFlops"] > 0 and rep["totalBytes"] > 0
+        assert rep["buckets"] and all(
+            b["peakHbmBytes"] > 0 for b in rep["buckets"])
+
+    def test_cost_text_mode_prints_report(self, saved_model):
+        r = self._lint("--model", saved_model, "--cost", "--fail-on", "error")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "PlanCostReport" in r.stdout
+        assert "peak HBM" in r.stdout
+
+    def test_tiny_hbm_budget_fires_tm601_rc1(self, saved_model):
+        r = self._lint("--model", saved_model, "--hbm-budget", "16",
+                       "--format", "json", "--fail-on", "error")
+        assert r.returncode == 1, r.stdout + r.stderr
+        lines = [json.loads(ln) for ln in r.stdout.strip().splitlines()]
+        codes = [ln.get("code") for ln in lines if "code" in ln]
+        assert "TM601" in codes
+
+    def test_cost_without_target_refuses(self, tmp_path):
+        p = tmp_path / "fine.py"
+        p.write_text("x = 1\n")
+        r = self._lint("--cost", "--path", str(p))  # path is no cost target
+        assert r.returncode != 0
+        assert "--workflow or --model" in r.stderr
+
+
+class TestLintGate:
+    """tools/lint_gate.py (ISSUE 6 satellite): rc flips ONLY on NEW errors —
+    INFO/WARNING never gate; baselined errors pass; --update-baseline."""
+
+    def _gate(self, *args, cwd):
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "lint_gate.py"),
+             *args],
+            env=env, cwd=cwd, capture_output=True, text=True, timeout=300)
+
+    def test_warnings_never_flip_rc(self, tmp_path):
+        p = tmp_path / "warn.py"
+        p.write_text(_HAZARD_SOURCE)  # TM301 warning
+        r = self._gate("--baseline", str(tmp_path / "b.json"),
+                       "--", "--path", str(p), cwd=tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "never gates" in r.stdout
+
+    def test_lint_crash_is_not_green(self, tmp_path):
+        """A lint that refuses to run (bad --model path, lost args) emits no
+        parseable diagnostics — the gate must FAIL, not report OK."""
+        r = self._gate("--baseline", str(tmp_path / "b.json"),
+                       "--", "--model", str(tmp_path / "nope"), cwd=tmp_path)
+        assert r.returncode != 0, r.stdout + r.stderr
+        assert "refusing to report OK" in r.stderr
+
+    def test_new_error_fails_then_baseline_passes(self, tmp_path):
+        (tmp_path / "bad.py").write_text("def broken(:\n")  # TM305 error
+        baseline = str(tmp_path / "b.json")
+        r = self._gate("--baseline", baseline,
+                       "--", "--path", str(tmp_path), cwd=tmp_path)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "NEW error" in r.stdout
+        up = self._gate("--baseline", baseline, "--update-baseline",
+                        "--", "--path", str(tmp_path), cwd=tmp_path)
+        assert up.returncode == 0, up.stdout + up.stderr
+        again = self._gate("--baseline", baseline,
+                           "--", "--path", str(tmp_path), cwd=tmp_path)
+        assert again.returncode == 0, again.stdout + again.stderr
+        assert "known error" in again.stdout

@@ -84,13 +84,72 @@ class TestStyleValidation:
             "intentional ones inline with '# opcheck: allow(TMxxx) reason'):\n"
             + "\n".join(findings))
 
+    def test_serve_perf_full_function_lint(self):
+        """serve/ and perf/ joined the codebase after PR 1 and their hot
+        paths are NOT named transform_columns/fit_columns/device_transform,
+        so the default gate above never saw them.  Lint EVERY function there
+        (``only_names=None``) plus the TM306 concurrency rule: module-level
+        mutable caches (the executable caches, the source-fingerprint memo)
+        must only be mutated under their locks."""
+        from transmogrifai_tpu.checkers.opcheck import (
+            lint_file,
+            lint_file_concurrency,
+        )
+
+        findings = []
+        for sub in ("serve", "perf"):
+            d = os.path.join(PKG_ROOT, sub)
+            for f in sorted(os.listdir(d)):
+                if not f.endswith(".py"):
+                    continue
+                path = os.path.join(d, f)
+                for fi in list(lint_file(path, only_names=None)) \
+                        + list(lint_file_concurrency(path)):
+                    rel = os.path.relpath(path, PKG_ROOT)
+                    findings.append(
+                        f"{rel}:{fi.lineno} {fi.code} {fi.qualname}: "
+                        f"{fi.message}")
+        assert not findings, (
+            "unallowlisted hazards in serve//perf/ (fix them, or mark "
+            "intentional ones inline with '# opcheck: allow(TMxxx) reason'):\n"
+            + "\n".join(findings))
+
+    def test_concurrency_rule_sees_through_the_caches(self):
+        """The TM306 heuristic itself must keep WORKING on the real caches:
+        stripping the lock from a known-locked mutation makes it fire.  (A
+        rule that silently stopped matching would green-light future races.)
+        """
+        from transmogrifai_tpu.checkers.opcheck import lint_module_concurrency
+
+        src = (
+            "_CACHE = {}\n"
+            "_CACHE_LOCK = __import__('threading').Lock()\n"
+            "def locked(k, v):\n"
+            "    with _CACHE_LOCK:\n"
+            "        _CACHE[k] = v\n"
+            "def racy(k, v):\n"
+            "    _CACHE[k] = v\n"
+            "def racy_method(k):\n"
+            "    _CACHE.pop(k, None)\n"
+            "def allowed(k, v):\n"
+            "    _CACHE[k] = v  # opcheck: allow(TM306) import-time only\n")
+        found = lint_module_concurrency(src)
+        assert sorted((f.qualname, f.code) for f in found) == [
+            ("racy", "TM306"), ("racy_method", "TM306")]
+
     def test_inline_allow_markers_still_needed(self):
         """Stale-marker guard: every inline ``opcheck: allow`` marker must sit
         in a file whose unsuppressed lint would actually fire — a marker that
-        no longer suppresses anything should be deleted."""
+        no longer suppresses anything should be deleted.  Re-lints with the
+        WIDEST rule set (every function + the TM306 concurrency rule), since
+        serve//perf/ markers may suppress findings outside the default
+        hazard-function gate."""
         import re
 
-        from transmogrifai_tpu.checkers.opcheck import lint_source
+        from transmogrifai_tpu.checkers.opcheck import (
+            lint_module_concurrency,
+            lint_source,
+        )
 
         marker = re.compile(r"opcheck:\s*allow\(TM\d{3}")  # same shape _ALLOW_RE accepts
         for root, _dirs, files in os.walk(PKG_ROOT):
@@ -109,7 +168,15 @@ class TestStyleValidation:
                 stripped = "\n".join(
                     re.sub(r"#\s*opcheck:\s*allow\([^)]*\).*", "", line)
                     for line in src.splitlines())
-                fired = {fi.lineno for fi in lint_source(stripped, filename=path)}
+                import ast
+
+                tree = ast.parse(stripped, filename=path)  # parse ONCE
+                fired = {fi.lineno for fi in
+                         lint_source(stripped, filename=path,
+                                     only_names=None, tree=tree)}
+                fired |= {fi.lineno for fi in
+                          lint_module_concurrency(stripped, filename=path,
+                                                  tree=tree)}
                 stale = [ln for ln in marked if ln not in fired]
                 assert not stale, \
                     f"{path}: stale opcheck allow markers at lines {stale}"

@@ -14,13 +14,35 @@ from .diagnostics import (
     Severity,
     make_diagnostic,
 )
+from .plancheck import (
+    BucketCost,
+    PlanCostReport,
+    RecompileHazard,
+    SegmentCost,
+    analyze_scoring_plan,
+    analyze_transform,
+    analyze_transform_plan,
+    check_plan_cost,
+    cost_diagnostics,
+    trace_cost,
+)
 
 __all__ = [
     "DIAGNOSTIC_CODES",
+    "BucketCost",
     "DagCycleError",
     "Diagnostic",
     "DiagnosticReport",
     "OpCheckError",
+    "PlanCostReport",
+    "RecompileHazard",
+    "SegmentCost",
     "Severity",
+    "analyze_scoring_plan",
+    "analyze_transform",
+    "analyze_transform_plan",
+    "check_plan_cost",
+    "cost_diagnostics",
     "make_diagnostic",
+    "trace_cost",
 ]

@@ -17,6 +17,10 @@ Code families:
 - ``TM5xx`` servability  — hazards for the compiled online-scoring path
   (serve/plan.py): unfitted estimators, host round-trips splitting the fused
   device prefix, unbounded shapes defeating padding-bucket compilation
+- ``TM6xx`` plan cost    — jaxpr-level static cost analysis of fused
+  programs (checkers/plancheck.py): HBM budget admission, recompile
+  hazards, collectives under a single-host contract, memory-bound
+  segments, order-dependent numerics
 """
 
 from __future__ import annotations
@@ -93,6 +97,12 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "fix the syntax error (or exclude the file from the lint path); "
               "an unparseable file cannot be checked and must not silently "
               "mask findings elsewhere"),
+    "TM306": (Severity.WARNING, "unsynchronized module-level mutable state",
+              "a module-level dict/list is mutated inside a function without "
+              "holding a threading lock; concurrent scorers/trainers race on "
+              "it — wrap the mutation in `with <lock>:`, or mark a "
+              "single-threaded-by-design site with an inline opcheck "
+              "allow marker for TM306"),
     # -- servability (serving path, opt-in via validate(serving=True)) ------
     "TM501": (Severity.ERROR, "unfitted estimator in scoring path",
               "train the workflow (or warm-start the missing stage) before "
@@ -125,6 +135,41 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "batcher's max_wait_ms, so every request that waits for a "
               "full flush window expires in the queue and is evicted "
               "unscored; raise the deadline or lower max_wait_ms"),
+    # -- plan cost (jaxpr-level static analysis, checkers/plancheck.py) -----
+    "TM601": (Severity.ERROR, "plan exceeds the HBM budget",
+              "the fused program's peak live-buffer estimate at its largest "
+              "row bucket exceeds the configured device budget; shrink the "
+              "bucket ladder (max_bucket), narrow the feature vector, or "
+              "raise hbm_budget if the device really has the headroom"),
+    "TM602": (Severity.WARNING, "recompile hazard: shape outside the bucket ladder",
+              "an input shape is only known from the data (e.g. a raw "
+              "OPVector width), so the pow2/8192 row-bucket ladder cannot "
+              "amortize it — every new shape compiles a fresh executable; "
+              "declare/enforce a static width upstream or keep the consumer "
+              "on the host path"),
+    "TM603": (Severity.ERROR, "collective in a single-host plan",
+              "the plan contains cross-device collective/resharding ops but "
+              "validate() was told the deployment is single-host; drop the "
+              "sharding annotations (or validate without single_host=True "
+              "and deploy on the mesh the plan was built for)"),
+    "TM604": (Severity.INFO, "memory-bound fused segment",
+              "the segment's arithmetic intensity (FLOPs per HBM byte) is "
+              "below the threshold, so it is bandwidth-bound on any "
+              "accelerator — a candidate for the Pallas fused-kernel "
+              "worklist (see ROADMAP: tree hot loops)"),
+    "TM606": (Severity.ERROR, "budget gate armed but plan cost unavailable",
+              "an hbm_budget/single_host contract was requested but the "
+              "fused-prefix cost cannot be computed (unfitted estimators in "
+              "the DAG); a gate that silently passed here would admit "
+              "anything — train the workflow (or validate the fitted "
+              "WorkflowModel) so the admission check can actually run"),
+    "TM605": (Severity.WARNING, "layout/order-dependent numerics",
+              "the plan contains ops whose floating-point result depends on "
+              "reduction order or data layout (float sort keys, "
+              "accumulations under a sharded mesh); bitwise parity across "
+              "backends/meshes is not guaranteed — pin the layout (e.g. "
+              "C-contiguous blocks, replicated metric inputs) where parity "
+              "matters"),
     # -- leakage ------------------------------------------------------------
     "TM401": (Severity.ERROR, "label leaks into feature path",
               "a response(-derived) feature reaches the model's feature input "
@@ -193,6 +238,9 @@ class DiagnosticReport:
     """Ordered collection of diagnostics with severity filters and rendering."""
 
     diagnostics: List[Diagnostic] = field(default_factory=list)
+    #: PlanCostReport attached by the TM6xx cost analyzers (validate(cost=True)
+    #: / ``cli lint --cost``); None when the cost pass did not run
+    plan_cost: Optional[object] = None
 
     def __iter__(self) -> Iterator[Diagnostic]:
         return iter(self.diagnostics)

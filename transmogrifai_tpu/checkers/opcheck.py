@@ -69,7 +69,10 @@ _ABSTRACT_ROWS = 2
 def validate_result_features(result_features: Sequence[Feature],
                              workflow_cv: bool = False,
                              serving: bool = False,
-                             fitted=None) -> DiagnosticReport:
+                             fitted=None,
+                             cost: bool = False,
+                             hbm_budget: Optional[float] = None,
+                             single_host: bool = False) -> DiagnosticReport:
     """Run every analyzer over the DAG reached from ``result_features``.
 
     Touches no data: type propagation walks declared FeatureTypes and the
@@ -78,6 +81,13 @@ def validate_result_features(result_features: Sequence[Feature],
     ``serving=True`` adds the TM5xx servability analyzers
     (serve/validator.py); ``fitted`` (uid -> fitted transformer) switches
     them to scoring-path mode, where an unfitted estimator is a TM501 error.
+
+    ``cost=True`` (implied by a non-None ``hbm_budget`` or
+    ``single_host=True``) adds the TM6xx plan-cost analyzers
+    (checkers/plancheck.py): the fused device prefix is traced abstractly
+    (``jax.make_jaxpr`` — zero backend compiles) and the resulting
+    :class:`~.plancheck.PlanCostReport` is attached as
+    ``report.plan_cost``.
     """
     from ..workflow.dag import all_stages
     from .diagnostics import DagCycleError
@@ -100,6 +110,14 @@ def validate_result_features(result_features: Sequence[Feature],
         from ..serve.validator import check_servability
 
         report.extend(check_servability(result_features, fitted=fitted))
+    if cost or hbm_budget is not None or single_host:
+        from .plancheck import check_plan_cost
+
+        cost_report, diags = check_plan_cost(
+            result_features, fitted=fitted, hbm_budget=hbm_budget,
+            single_host=single_host)
+        report.plan_cost = cost_report
+        report.extend(diags)
     return report
 
 
@@ -407,8 +425,77 @@ def check_shapes(stages: Sequence[Any],
             else:
                 if hasattr(traced, "shape") and hasattr(traced, "dtype"):
                     out_spec = jax.ShapeDtypeStruct(traced.shape, traced.dtype)
+                diags.extend(_check_stacked_fold_form(st, dev_specs, traced))
         specs[out.uid] = out_spec
     return diags
+
+
+def _check_stacked_fold_form(st, dev_specs, single_traced):
+    """Abstractly evaluate the STACKED-FOLD form of a ``device_state`` stage.
+
+    The fold-batched transform planner (workflow/plan.py transform_folds)
+    runs ``device_transform_stateful`` under ``jax.vmap`` with the k
+    fold-fitted states stacked on a leading axis — a protocol the
+    single-state ``device_transform`` check cannot exercise: a stateful form
+    that disagrees with (or chokes under vmap of) the plain form would only
+    surface at fold-CV time as a silent planner fallback.  Here it is traced
+    on ``(k,)+state``-shaped specs with ``jax.eval_shape`` and its per-fold
+    output must match the single-state trace exactly (TM204 otherwise).
+    """
+    import numpy as np
+
+    import jax
+
+    from ..stages.base import Transformer
+
+    impl = getattr(type(st), "device_transform_stateful", None)
+    if impl is None or impl is Transformer.device_transform_stateful:
+        return []  # no stateful form declared (base raises NotImplementedError)
+    try:
+        state = st.device_state()
+    except Exception:
+        return []
+    if not state:
+        return []
+    k = 2  # any fold count >= 2 exercises the vmapped layout
+    try:
+        arrs = [np.asarray(a) for a in state]
+    except Exception:
+        return []
+    state_specs = tuple(
+        jax.ShapeDtypeStruct((k,) + a.shape, a.dtype) for a in arrs)
+    n_state = len(state_specs)
+
+    def stacked(*flat):
+        return st.device_transform_stateful(tuple(flat[:n_state]),
+                                            *flat[n_state:])
+
+    vmapped = jax.vmap(stacked,
+                       in_axes=(0,) * n_state + (None,) * len(dev_specs))
+    try:
+        fold_traced = jax.eval_shape(vmapped, *state_specs, *dev_specs)
+    except Exception as e:
+        msg = str(e).split("\n")[0]
+        return [make_diagnostic(
+            "TM204",
+            f"{type(st).__name__}.device_transform_stateful fails abstract "
+            f"evaluation in the stacked-fold (vmap over {k} folds) form: "
+            f"{msg}",
+            stage_uid=st.uid)]
+    if hasattr(fold_traced, "shape") and hasattr(single_traced, "shape"):
+        expected = (k,) + tuple(single_traced.shape)
+        got = tuple(fold_traced.shape)
+        if got != expected or fold_traced.dtype != single_traced.dtype:
+            return [make_diagnostic(
+                "TM204",
+                f"{type(st).__name__}.device_transform_stateful stacked-fold "
+                f"output {got}/{fold_traced.dtype} diverges from the "
+                f"single-state device_transform "
+                f"({expected}/{single_traced.dtype}); the fold-vmapped CV "
+                f"program would compute something else than the per-fold "
+                f"path",
+                stage_uid=st.uid)]
+    return []
 
 
 # ---------------------------------------------------------------------------
@@ -648,10 +735,12 @@ def _iter_functions(tree: ast.AST, qualprefix: str = ""):
 
 
 def lint_source(source: str, filename: str = "<string>",
-                only_names: Optional[frozenset] = HAZARD_FUNCTION_NAMES
-                ) -> List[LintFinding]:
-    """AST-lint a python source string; ``only_names=None`` lints every function."""
-    tree = ast.parse(source, filename=filename)
+                only_names: Optional[frozenset] = HAZARD_FUNCTION_NAMES,
+                tree: Optional[ast.AST] = None) -> List[LintFinding]:
+    """AST-lint a python source string; ``only_names=None`` lints every
+    function.  ``tree`` reuses an already-parsed AST of ``source``."""
+    if tree is None:
+        tree = ast.parse(source, filename=filename)
     lines = source.splitlines()
     out: List[LintFinding] = []
     for qualname, fn in _iter_functions(tree):
@@ -693,6 +782,170 @@ def lint_stage_class(cls: type) -> List[LintFinding]:
             fn_node, filename, f"{cls.__name__}.{name}",
             line_offset=start - 1, lines=src).run())
     return out
+
+
+# -- TM306: unsynchronized module-level mutable state -----------------------
+
+#: method calls that mutate a dict/list/set in place (reads like .get/.keys
+#: are not flagged — the hazard is the unsynchronized read-modify-write)
+_MUTATOR_METHODS = frozenset({
+    "append", "extend", "insert", "pop", "popitem", "clear", "update",
+    "setdefault", "remove", "sort", "reverse", "add", "discard",
+})
+
+#: constructor calls whose result is a module-level mutable container
+_MUTABLE_CTORS = frozenset({
+    "dict", "list", "set", "defaultdict", "OrderedDict", "Counter", "deque",
+})
+
+
+def _is_mutable_ctor(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                         ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = node.func.id if isinstance(node.func, ast.Name) else \
+            node.func.attr if isinstance(node.func, ast.Attribute) else ""
+        return name in _MUTABLE_CTORS
+    return False
+
+
+def _looks_like_lock(expr: ast.AST) -> bool:
+    """True when a with-item's context expression names a lock (heuristic:
+    the final dotted segment contains 'lock', e.g. ``_CACHE_LOCK``,
+    ``self._lock``, ``threading.Lock()``)."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    chain = _attr_chain(expr)
+    if chain is None:
+        return False
+    return "lock" in chain.rsplit(".", 1)[-1].lower()
+
+
+class _ConcurrencyLinter(ast.NodeVisitor):
+    """Flags read-modify-writes of module-level mutables outside any
+    ``with <lock>:`` block inside one function body."""
+
+    def __init__(self, mutables: Set[str], qualname: str, filename: str,
+                 lines: List[str]):
+        self.mutables = mutables
+        self.qualname = qualname
+        self.filename = filename
+        self.lines = lines
+        self.lock_depth = 0
+        self.findings: List[LintFinding] = []
+
+    def _flag(self, node: ast.AST, name: str, how: str) -> None:
+        if self.lock_depth > 0:
+            return
+        f = LintFinding(
+            code="TM306",
+            message=f"module-level mutable {name!r} {how} outside a "
+                    "threading lock; concurrent callers race on it",
+            qualname=self.qualname, filename=self.filename,
+            lineno=getattr(node, "lineno", 0))
+        lineno = f.lineno
+        if 0 < lineno <= len(self.lines):
+            m = _ALLOW_RE.search(self.lines[lineno - 1])
+            if m and "TM306" in m.group(1):
+                return
+        self.findings.append(f)
+
+    def visit_With(self, node: ast.With) -> None:
+        locky = any(_looks_like_lock(item.context_expr)
+                    for item in node.items)
+        if locky:
+            self.lock_depth += 1
+        self.generic_visit(node)
+        if locky:
+            self.lock_depth -= 1
+
+    visit_AsyncWith = visit_With
+
+    def _target_mutable(self, target: ast.AST) -> Optional[str]:
+        if isinstance(target, ast.Subscript) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id in self.mutables:
+            return target.value.id
+        return None
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            name = self._target_mutable(t)
+            if name:
+                self._flag(node, name, "item-assigned")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        name = self._target_mutable(node.target)
+        # `_CACHE |= d` / `_CACHE += [...]` on the bare name mutates the
+        # container in place — the same race as `.update()`/`.extend()`
+        if name is None and isinstance(node.target, ast.Name) \
+                and node.target.id in self.mutables:
+            name = node.target.id
+        if name:
+            self._flag(node, name, "augmented-assigned")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            name = self._target_mutable(t)
+            if name:
+                self._flag(node, name, "item-deleted")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute) \
+                and func.attr in _MUTATOR_METHODS \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in self.mutables:
+            self._flag(node, func.value.id, f"mutated via .{func.attr}()")
+        self.generic_visit(node)
+
+
+def lint_module_concurrency(source: str, filename: str = "<string>",
+                            tree: Optional[ast.AST] = None
+                            ) -> List[LintFinding]:
+    """TM306: module-level mutable dict/list/set read-modify-written inside a
+    function without a ``with <lock>:`` frame (AST heuristic; suppress an
+    intentional single-threaded site with the usual inline opcheck allow
+    marker carrying code TM306).
+
+    Only mutations inside function bodies are flagged — module top-level
+    mutation runs once, single-threaded, at import time.  ``tree`` reuses an
+    already-parsed AST of ``source``.
+    """
+    if tree is None:
+        tree = ast.parse(source, filename=filename)
+    lines = source.splitlines()
+    mutables: Set[str] = set()
+    for node in tree.body:
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if _is_mutable_ctor(value):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    mutables.add(t.id)
+    if not mutables:
+        return []
+    out: List[LintFinding] = []
+    for qualname, fn in _iter_functions(tree):
+        linter = _ConcurrencyLinter(mutables, qualname, filename, lines)
+        for stmt in fn.body:
+            linter.visit(stmt)
+        out.extend(linter.findings)
+    return out
+
+
+def lint_file_concurrency(path: str) -> List[LintFinding]:
+    with open(path) as fh:
+        return lint_module_concurrency(fh.read(), filename=path)
 
 
 def check_jax_hazards(stages: Sequence[Any]) -> List[Diagnostic]:

@@ -5,17 +5,35 @@ combined with this port's static validator (checkers/opcheck.py, SURVEY §1):
 print typed TM-code diagnostics and exit non-zero, so CI can gate on them
 before any TPU time is spent.
 
-Two modes, combinable:
+Modes, combinable:
 
 - ``--path FILE_OR_DIR``  AST-lints python sources for JAX hazards (TM3xx) in
   ``transform_columns``/``fit_columns``/``device_transform`` bodies
-  (``--all-functions`` widens to every function).
+  (``--all-functions`` widens to every function; ``--concurrency`` adds the
+  TM306 unsynchronized-module-state rule).
 - ``--workflow module:attr``  imports ``attr`` from ``module`` (a Workflow, a
-  zero-arg factory returning one, or a list of result features) and runs the
-  full analyzer suite over the DAG — no data is touched.
+  fitted WorkflowModel, a zero-arg factory returning either, or a list of
+  result features) and runs the full analyzer suite over the DAG — no data
+  is touched.
+- ``--model DIR``  loads a saved WorkflowModel (``model.save(path)``) and
+  validates it scoring-path aware (TM501+ servability enabled).
+- ``--cost``  adds the TM6xx plan-cost analyzers (checkers/plancheck.py):
+  the fused device prefix traces abstractly (zero backend compiles) and the
+  :class:`PlanCostReport` — FLOPs, bytes, per-bucket peak-HBM estimates,
+  recompile hazards, collective inventory — prints before the diagnostics.
+  ``--hbm-budget BYTES`` arms the TM601 admission error;
+  ``--single-host`` makes any collective/resharding op a TM603 error.
+
+Output: human text by default; ``--format json`` emits ONE JSON OBJECT PER
+LINE — each diagnostic as ``{"code", "severity", "stageUid", "location",
+"message", "fixHint"}``, preceded (under ``--cost``) by one
+``{"planCostReport": {...}}`` line — the machine contract
+``tools/lint_gate.py`` consumes.  (``--json``, kept for compatibility,
+prints the old single JSON array.)
 
 Exit status: 1 when any finding reaches ``--fail-on`` (default: warning),
-else 0.
+else 0.  For a CI gate that only fails on NEW errors (INFO/WARNING never
+flip rc) use ``tools/lint_gate.py`` — see docs/static_analysis.md.
 """
 
 from __future__ import annotations
@@ -32,21 +50,44 @@ def add_lint_parser(sub) -> None:
     p.add_argument("--path", action="append", default=[],
                    help="python file or directory to AST-lint (repeatable)")
     p.add_argument("--workflow", default=None, metavar="MODULE:ATTR",
-                   help="import a Workflow (or factory / result-feature list) "
-                        "and validate its DAG")
+                   help="import a Workflow / WorkflowModel (or factory / "
+                        "result-feature list) and validate its DAG")
+    p.add_argument("--model", default=None, metavar="DIR",
+                   help="saved WorkflowModel directory to validate "
+                        "(scoring-path aware: TM501+ enabled)")
     p.add_argument("--all-functions", action="store_true",
                    help="lint every function, not just "
                         "transform_columns/fit_columns/device_transform")
+    p.add_argument("--concurrency", action="store_true",
+                   help="add the TM306 rule to --path lint: module-level "
+                        "mutable dict/list mutated outside a threading lock")
     p.add_argument("--serving", action="store_true",
                    help="add the TM5xx servability analyzers (host "
                         "round-trips in the fused scoring prefix, unbounded "
                         "shapes breaking padding buckets) to --workflow "
                         "validation")
+    p.add_argument("--cost", action="store_true",
+                   help="add the TM6xx plan-cost analyzers: abstract "
+                        "jaxpr-level FLOPs/bytes/HBM analysis of the fused "
+                        "device prefix (prints a PlanCostReport)")
+    p.add_argument("--hbm-budget", type=float, default=None,
+                   dest="hbm_budget", metavar="BYTES",
+                   help="device HBM budget in bytes; a plan whose static "
+                        "peak estimate exceeds it is a TM601 error")
+    p.add_argument("--single-host", action="store_true", dest="single_host",
+                   help="assert the plan runs single-host: any "
+                        "collective/resharding op inside it is a TM603 error")
     p.add_argument("--fail-on", choices=["info", "warning", "error"],
                    default="warning",
                    help="lowest severity that makes the exit status non-zero")
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   dest="out_format",
+                   help="'json' emits one JSON object per line (one per "
+                        "diagnostic; plus one planCostReport line under "
+                        "--cost) — the contract tools/lint_gate.py consumes")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="emit diagnostics as JSON instead of text")
+                   help="emit diagnostics as a single JSON array "
+                        "(legacy; prefer --format json)")
 
 
 def _python_files(path: str) -> List[str]:
@@ -65,40 +106,77 @@ def _python_files(path: str) -> List[str]:
 
 
 def _resolve_workflow(spec: str):
-    """'pkg.module:attr' -> result feature list (accepts Workflow/factory)."""
-    from ..workflow.workflow import Workflow
+    """'pkg.module:attr' -> (result features, workflow_cv, fitted-or-None).
+
+    Accepts a Workflow, a fitted WorkflowModel, a zero-arg factory returning
+    either, or a plain list of result features.
+    """
+    from ..workflow.workflow import Workflow, WorkflowModel
 
     if ":" not in spec:
         raise SystemExit(f"--workflow expects MODULE:ATTR, got {spec!r}")
     mod_name, attr = spec.split(":", 1)
     obj = getattr(importlib.import_module(mod_name), attr)
-    if callable(obj) and not isinstance(obj, Workflow):
+    if callable(obj) and not isinstance(obj, (Workflow, WorkflowModel)):
         obj = obj()
+    if isinstance(obj, WorkflowModel):
+        return obj.result_features, obj.workflow_cv, obj.fitted
     if isinstance(obj, Workflow):
-        return obj.result_features, obj._workflow_cv
-    return list(obj), False
+        return obj.result_features, obj._workflow_cv, None
+    return list(obj), False, None
 
 
 def run_lint(ns) -> int:
     from ..checkers.diagnostics import DiagnosticReport, Severity
-    from ..checkers.opcheck import (HAZARD_FUNCTION_NAMES, lint_file,
+    from ..checkers.opcheck import (HAZARD_FUNCTION_NAMES,
+                                    lint_module_concurrency, lint_source,
                                     validate_result_features)
 
-    if not ns.workflow and not ns.path:
+    if not ns.workflow and not ns.path and not ns.model:
         # a gate invoked with no target (flag lost in CI YAML quoting, say)
         # must not go silently green
-        raise SystemExit("lint: nothing to lint — pass --path and/or --workflow")
+        raise SystemExit(
+            "lint: nothing to lint — pass --path, --workflow and/or --model")
+    cost = ns.cost or ns.hbm_budget is not None or ns.single_host
+    if cost and not (ns.workflow or ns.model):
+        raise SystemExit("lint: --cost/--hbm-budget/--single-host need a "
+                         "--workflow or --model target")
     report = DiagnosticReport()
+    cost_reports = []  # one PlanCostReport per --workflow/--model target
+    targets = []
     if ns.workflow:
-        features, workflow_cv = _resolve_workflow(ns.workflow)
-        report.extend(validate_result_features(
+        targets.append(_resolve_workflow(ns.workflow))
+    if ns.model:
+        from ..workflow.workflow import WorkflowModel
+
+        model = WorkflowModel.load(ns.model)
+        targets.append((model.result_features, model.workflow_cv,
+                        model.fitted))
+    for features, workflow_cv, fitted in targets:
+        sub = validate_result_features(
             features, workflow_cv=workflow_cv,
-            serving=getattr(ns, "serving", False)))
+            serving=getattr(ns, "serving", False) or fitted is not None,
+            fitted=fitted, cost=cost, hbm_budget=ns.hbm_budget,
+            single_host=ns.single_host)
+        report.extend(sub)
+        if sub.plan_cost is not None:
+            cost_reports.append(sub.plan_cost)
+    if cost_reports:
+        report.plan_cost = cost_reports[-1]
     only = None if ns.all_functions else HAZARD_FUNCTION_NAMES
     for path in ns.path:
         for fname in _python_files(path):
             try:
-                findings = lint_file(fname, only_names=only)
+                import ast
+
+                with open(fname) as fh:
+                    src = fh.read()
+                tree = ast.parse(src, filename=fname)  # parse ONCE
+                findings = list(lint_source(src, filename=fname,
+                                            only_names=only, tree=tree))
+                if ns.concurrency:
+                    findings += lint_module_concurrency(src, filename=fname,
+                                                        tree=tree)
             except (SyntaxError, ValueError, UnicodeDecodeError) as e:
                 # one unparseable file must not abort the lint of the rest
                 from ..checkers.diagnostics import make_diagnostic
@@ -112,8 +190,23 @@ def run_lint(ns) -> int:
     if ns.as_json:
         import json
 
-        print(json.dumps(report.to_dicts(), indent=2))
+        # legacy shape: one array — diagnostics first, then (only when
+        # --cost ran) one {"planCostReport": ...} element per target
+        blob = report.to_dicts()
+        blob += [{"planCostReport": rep.to_dict()} for rep in cost_reports]
+        print(json.dumps(blob, indent=2))
+    elif ns.out_format == "json":
+        import json
+
+        # one object per line: planCostReport lines first (one per target),
+        # then one line per diagnostic — the tools/lint_gate.py contract
+        for rep in cost_reports:
+            print(json.dumps({"planCostReport": rep.to_dict()}))
+        for d in report:
+            print(json.dumps(d.to_dict()))
     else:
+        for rep in cost_reports:
+            print(rep.pretty())
         print(report.pretty())
 
     threshold = Severity[ns.fail_on.upper()]

@@ -94,7 +94,8 @@ def _source_fingerprint(fn) -> str:
         src = getattr(fn, "__qualname__", repr(fn))
     fp = hashlib.blake2b(src.encode(), digest_size=8).hexdigest()
     if target is not None:
-        _SRC_FP[target] = fp
+        with _LOCK:  # concurrent run_cached callers race the memo write
+            _SRC_FP[target] = fp
     return fp
 
 

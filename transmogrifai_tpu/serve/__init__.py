@@ -25,7 +25,11 @@ from .faults import (
 from .plan import CompiledScoringPlan, compile_plan
 from .resilience import CircuitBreaker, ResilientScorer
 from .server import ScoringServer
-from .validator import check_resilience_config, check_servability
+from .validator import (
+    check_plan_admission,
+    check_resilience_config,
+    check_servability,
+)
 
 __all__ = [
     "BatcherClosedError",
@@ -40,6 +44,7 @@ __all__ = [
     "ResilientScorer",
     "ScoringServer",
     "TransientScoringError",
+    "check_plan_admission",
     "check_resilience_config",
     "check_servability",
     "compile_plan",

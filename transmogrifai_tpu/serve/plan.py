@@ -171,7 +171,7 @@ class CompiledScoringPlan:
     """
 
     def __init__(self, model, min_bucket: int = 8, max_bucket: int = 1024,
-                 strict: bool = True):
+                 strict: bool = True, hbm_budget: Optional[float] = None):
         if max_bucket < min_bucket or min_bucket < 1:
             raise ValueError(f"bad bucket range [{min_bucket}, {max_bucket}]")
         # round both ends up to powers of two: every bucket score() can pick
@@ -198,6 +198,16 @@ class CompiledScoringPlan:
         self._build_entries()
         self._build_wiring()
         self._fingerprint = self._compute_fingerprint()
+
+        if hbm_budget is not None:
+            # HBM admission (TM601): abstract jaxpr trace of the fused
+            # prefix across the bucket ladder — zero backend compiles — and
+            # refuse to build a plan the device budget cannot hold
+            from .validator import check_plan_admission
+
+            report = check_plan_admission(self, hbm_budget)
+            if report.errors():
+                raise OpCheckError(report)
 
         self._executables: Dict[int, Any] = {}
         self.compile_count = 0
@@ -370,7 +380,8 @@ class CompiledScoringPlan:
                 specs = [jax.ShapeDtypeStruct((bucket,) + trailing,
                                               np.dtype(dtype))
                          for trailing, dtype in self._entry_specs]
-                compiled = jax.jit(self._fused).lower(*specs).compile()
+                compiled = jax.jit(self._fused).lower(  # opcheck: allow(TM303) once per bucket under _compile_lock, AOT-cached
+                    *specs).compile()
                 self.compile_count += 1
                 with _EXEC_CACHE_LOCK:
                     _EXEC_CACHE[key] = compiled
@@ -510,7 +521,10 @@ class CompiledScoringPlan:
 
 
 def compile_plan(model, min_bucket: int = 8, max_bucket: int = 1024,
-                 strict: bool = True) -> CompiledScoringPlan:
-    """Compile a fitted WorkflowModel for online serving."""
+                 strict: bool = True,
+                 hbm_budget: Optional[float] = None) -> CompiledScoringPlan:
+    """Compile a fitted WorkflowModel for online serving.  ``hbm_budget``
+    (bytes) arms the TM601 admission gate (serve/validator.py)."""
     return CompiledScoringPlan(model, min_bucket=min_bucket,
-                               max_bucket=max_bucket, strict=strict)
+                               max_bucket=max_bucket, strict=strict,
+                               hbm_budget=hbm_budget)

@@ -57,14 +57,19 @@ class ScoringServer:
                  max_queue: int = 4096, min_bucket: int = 8,
                  max_bucket: Optional[int] = None, warm: bool = True,
                  resilience: Union[bool, Mapping[str, Any]] = True,
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 hbm_budget: Optional[float] = None):
         if max_bucket is None:
             # every flushed batch must fit one bucket, so a single fused call
             # serves the largest flush the batcher can produce
             max_bucket = max(1 << (max(max_batch, 1) - 1).bit_length(),
                              min_bucket)
+        # hbm_budget arms the TM601 admission gate (serve/validator.py):
+        # a model whose fused prefix cannot fit the device budget is
+        # rejected here, before any executable compiles or request queues
         self.plan = CompiledScoringPlan(model, min_bucket=min_bucket,
-                                        max_bucket=max_bucket)
+                                        max_bucket=max_bucket,
+                                        hbm_budget=hbm_budget)
         if warm:
             self.plan.warm()
         self.default_deadline_ms = deadline_ms

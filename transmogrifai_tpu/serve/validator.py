@@ -20,6 +20,10 @@ hazards surface from ``workflow.validate(serving=True)``, ``cli lint
   checks (:func:`check_resilience_config`) — invalid retry/breaker numbers,
   and a default deadline the flush wait makes unmeetable.  Run by
   :class:`~.server.ScoringServer` before any request is accepted.
+- **TM601** (error): HBM admission (:func:`check_plan_admission`) — the
+  plan's static peak live-buffer estimate at its largest padding bucket
+  (checkers/plancheck.py, abstract jaxpr trace) exceeds the configured
+  device budget; the plan refuses to build instead of OOMing mid-request.
 """
 
 from __future__ import annotations
@@ -78,6 +82,29 @@ def check_resilience_config(*, max_retries: int = 0,
             f"default deadline ({default_deadline_ms} ms) is not longer "
             f"than the batcher flush wait ({max_wait_ms} ms); queued "
             "requests will expire before they can flush")])
+    return report
+
+
+def check_plan_admission(plan, hbm_budget: float) -> DiagnosticReport:
+    """HBM admission control for a compiled scoring plan (TM601).
+
+    Traces the plan's fused prefix abstractly across its padding-bucket
+    ladder (checkers/plancheck.py — zero backend compiles, zero data) and
+    reports TM601 when the peak live-buffer estimate at any bucket exceeds
+    ``hbm_budget`` bytes.  :class:`~.plan.CompiledScoringPlan` runs this at
+    construction when a budget is configured, so a plan that cannot fit the
+    device is rejected before any executable compiles — the admission seam
+    the multi-tenant serving fleet (ROADMAP) builds on.
+    """
+    from ..checkers.plancheck import analyze_scoring_plan, cost_diagnostics
+
+    report = DiagnosticReport()
+    if not plan.device_stage_uids:
+        return report  # all-host plan: no device buffers to admit
+    cost = analyze_scoring_plan(plan)
+    report.plan_cost = cost
+    report.extend(d for d in cost_diagnostics(cost, hbm_budget=hbm_budget)
+                  if d.code == "TM601")
     return report
 
 

@@ -24,20 +24,23 @@ def fit_dag(
     result_features: Sequence[Feature],
     fitted: Dict[str, Transformer] | None = None,
     on_fit=None,
+    hbm_budget: float | None = None,
 ) -> Tuple[Dataset, Dict[str, Transformer]]:
     """Fit every estimator and apply every transformer, layer by layer.
 
     Returns (transformed dataset, {stage uid -> fitted transformer}).  Already-fitted
     stages (uid present in ``fitted``) are reused, enabling warm-start stacking
     (OpWorkflow.withModelStages :457-461).  ``on_fit(model)`` fires after each
-    estimator fit (checkpoint hook).
+    estimator fit (checkpoint hook).  ``hbm_budget`` arms the TM601 gate on
+    every fused transform plan (see ``Workflow.train``).
     """
     fitted = dict(fitted or {})
     # one flattened topo-ordered pass (not per layer): the fused transform
     # planner batches maximal runs of fitted transformers between estimator
     # fits, and those runs may span DAG layers
     stages = [s for layer in compute_dag(result_features) for s in layer]
-    dataset = fit_stage_list(dataset, stages, fitted, on_fit=on_fit)
+    dataset = fit_stage_list(dataset, stages, fitted, on_fit=on_fit,
+                             hbm_budget=hbm_budget)
     return dataset, fitted
 
 
@@ -88,7 +91,8 @@ def _resolve(stage: PipelineStage, fitted: Dict[str, Transformer]) -> Transforme
 
 
 def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer],
-                   on_fit=None, fused: bool | None = None) -> Dataset:
+                   on_fit=None, fused: bool | None = None,
+                   hbm_budget: float | None = None) -> Dataset:
     """Fit/transform an explicit stage list (topological order) — the single
     fit/transform loop shared by fit_dag and the workflow-CV passes.
 
@@ -112,7 +116,7 @@ def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer],
         if fused is not False:
             from .plan import fused_transform
 
-            out = fused_transform(ds, runners)
+            out = fused_transform(ds, runners, hbm_budget=hbm_budget)
             if out is not None:
                 return out
         for runner in runners:
@@ -140,7 +144,8 @@ def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer],
     return _flush(dataset, pending)
 
 
-def workflow_cv_validate(ds_before: Dataset, during, selector) -> "object":
+def workflow_cv_validate(ds_before: Dataset, during, selector,
+                         hbm_budget: float | None = None) -> "object":
     """In-fold feature engineering CV (reference OpWorkflow.fitStages withWorkflowCV,
     FitStagesUtil.scala:305-358 + OpWorkflow.scala:403-438).
 
@@ -189,7 +194,8 @@ def workflow_cv_validate(ds_before: Dataset, during, selector) -> "object":
         ds_fold_train = ds_fit_view.take(train_rows)
         fold_fitted: Dict[str, Transformer] = {}
         copies = [s.copy() for s in during]
-        fit_stage_list(ds_fold_train, copies, fold_fitted)
+        fit_stage_list(ds_fold_train, copies, fold_fitted,
+                       hbm_budget=hbm_budget)
         # plain transformers in the cut have no fitted entry — the copy runs
         fold_copies.append(copies)
         fold_runner_maps.append(
@@ -200,7 +206,8 @@ def workflow_cv_validate(ds_before: Dataset, during, selector) -> "object":
     # states stack, else one fused plan per fold; host loop as fallback
     from .plan import fused_fold_transforms
 
-    fold_datasets = fused_fold_transforms(ds_before, during, fold_runner_maps)
+    fold_datasets = fused_fold_transforms(ds_before, during, fold_runner_maps,
+                                          hbm_budget=hbm_budget)
     if fold_datasets is None:
         fold_datasets = []
         for f in range(k):

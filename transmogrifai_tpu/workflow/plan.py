@@ -677,10 +677,47 @@ def plan_for(runners: Sequence[Any], available: frozenset
     return probe, list(probe._remainder)
 
 
-def fused_transform(dataset: Dataset, runners: Sequence[Any]
+def check_plan_hbm_budget(plan: "ColumnarTransformPlan", dataset: Dataset,
+                          hbm_budget: float):
+    """TM601 admission gate on a fused transform plan: estimate the prefix's
+    peak live-buffer HBM at the dataset's row bucket by abstract jaxpr trace
+    (checkers/plancheck.py — zero backend compiles) and raise
+    :class:`OpCheckError` before the over-budget program dispatches.
+    Returns the :class:`PlanCostReport` when the plan is admitted.
+
+    An analyzer failure fails CLOSED as TM606 (still an OpCheckError): the
+    armed budget contract cannot be evaluated, and silently dispatching the
+    unchecked program would admit anything.
+    """
+    from ..checkers.diagnostics import (DiagnosticReport, OpCheckError,
+                                        make_diagnostic)
+    from ..checkers.plancheck import analyze_transform_plan, cost_diagnostics
+
+    try:
+        report = analyze_transform_plan(plan, dataset)
+        diags = [d for d in cost_diagnostics(report, hbm_budget=hbm_budget)
+                 if d.code == "TM601"]
+    except Exception as e:  # noqa: BLE001 — fail closed, not raw
+        raise OpCheckError(DiagnosticReport(diagnostics=[make_diagnostic(
+            "TM606",
+            f"hbm_budget contract requested but the plan cost could not be "
+            f"computed ({type(e).__name__}: {e})")])) from e
+    if diags:
+        full = DiagnosticReport(diagnostics=diags, plan_cost=report)
+        raise OpCheckError(full)
+    return report
+
+
+def fused_transform(dataset: Dataset, runners: Sequence[Any],
+                    hbm_budget: Optional[float] = None
                     ) -> Optional[Dataset]:
     """Fused transform of ``runners`` over ``dataset``; None -> caller falls
-    back to the per-stage path (nothing fuses, listener active, or failure)."""
+    back to the per-stage path (nothing fuses, listener active, or failure).
+
+    ``hbm_budget`` (bytes): the TM601 gate — an over-budget plan raises
+    :class:`OpCheckError` (NOT a fallback: silently running the same work
+    through the host path would hide the admission failure).
+    """
     from ..utils.listener import active_listeners
 
     if not fused_transforms_enabled() or active_listeners():
@@ -689,6 +726,15 @@ def fused_transform(dataset: Dataset, runners: Sequence[Any]
         plan, remainder = plan_for(runners, frozenset(dataset.names))
         if plan is None:
             return None
+    except Exception as e:  # noqa: BLE001 — transform must never get flakier
+        log.warning("fused transform planning failed (%s: %s); falling back "
+                    "to the per-stage path", type(e).__name__, e)
+        return None
+    if hbm_budget is not None:
+        # deliberately OUTSIDE the fallback guard: an OpCheckError here is an
+        # admission decision that must propagate, not a planner failure
+        check_plan_hbm_budget(plan, dataset, hbm_budget)
+    try:
         out = plan.apply_prefix(dataset)
     except Exception as e:  # noqa: BLE001 — transform must never get flakier
         log.warning("fused transform plan failed (%s: %s); falling back to "
@@ -700,11 +746,19 @@ def fused_transform(dataset: Dataset, runners: Sequence[Any]
 
 
 def fused_fold_transforms(dataset: Dataset, during: Sequence[Any],
-                          fold_runner_maps: List[Dict[str, Any]]
+                          fold_runner_maps: List[Dict[str, Any]],
+                          hbm_budget: Optional[float] = None
                           ) -> Optional[List[Dataset]]:
     """Apply fold-fitted ``during`` stages to ALL rows for every fold through
     the fused planner — vmapped over folds when stage states stack, else one
-    fused plan per fold.  None -> caller falls back to the host loop."""
+    fused plan per fold.  None -> caller falls back to the host loop.
+
+    ``hbm_budget``: the TM601 gate on the fold plans.  A per-fold plan over
+    budget raises :class:`OpCheckError` (never falls back); the fold-vmapped
+    program holds all k folds' buffers at once, so when k x the per-fold
+    peak exceeds the budget the vmapped mode is simply SKIPPED — the k
+    sequential per-fold plans still fit and still run fused.
+    """
     from ..utils.listener import active_listeners
 
     if not fused_transforms_enabled() or active_listeners():
@@ -715,22 +769,44 @@ def fused_fold_transforms(dataset: Dataset, during: Sequence[Any],
         plan0, _ = plan_for(resolved[0], frozenset(dataset.names))
         if plan0 is None:
             return None
-        batched = plan0.transform_folds(dataset, resolved)
+    except Exception as e:  # noqa: BLE001
+        log.warning("fused fold transform planning failed (%s: %s); falling "
+                    "back to the per-fold host loop", type(e).__name__, e)
+        return None
+    vmapped_ok = True
+    if hbm_budget is not None:
+        # outside the fallback guard: an admission refusal must propagate
+        cost = check_plan_hbm_budget(plan0, dataset, hbm_budget)
+        if cost.peak_hbm_bytes * k > hbm_budget:
+            vmapped_ok = False  # one fold at a time fits; k stacked don't
+            log.info("fold-vmapped transform skipped: %d folds x %d bytes "
+                     "peak exceeds hbm_budget %d; running per-fold plans",
+                     k, cost.peak_hbm_bytes, int(hbm_budget))
+    try:
+        batched = plan0.transform_folds(dataset, resolved) if vmapped_ok \
+            else None
         if batched is not None:
             fused_uids = set(plan0.device_stage_uids)
             remainders = [[r for r in resolved[f] if r.uid not in fused_uids]
                           for f in range(k)]
         else:
-            # per-fold fused plans (fold states too ragged to vmap)
+            # per-fold fused plans (fold states too ragged to vmap); these
+            # dispatch one fold at a time, so each plan gets the full budget
             batched, remainders = [], []
             for f in range(k):
                 plan, remainder = plan_for(resolved[f],
                                            frozenset(dataset.names))
                 if plan is None:
                     return None
+                if hbm_budget is not None:
+                    check_plan_hbm_budget(plan, dataset, hbm_budget)
                 batched.append(plan.apply_prefix(dataset))
                 remainders.append(remainder)
     except Exception as e:  # noqa: BLE001
+        from ..checkers.diagnostics import OpCheckError
+
+        if isinstance(e, OpCheckError):
+            raise  # admission refusal, not a planner failure to retry
         log.warning("fused fold transform failed (%s: %s); falling back to "
                     "the per-fold host loop", type(e).__name__, e)
         return None

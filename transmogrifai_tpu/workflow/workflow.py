@@ -96,7 +96,9 @@ class Workflow:
                 raise ValueError(f"[TM102] Duplicate stage uid in DAG: {stage.uid}")
             seen_uids[stage.uid] = stage
 
-    def validate(self, serving: bool = False) -> "DiagnosticReport":
+    def validate(self, serving: bool = False, cost: bool = False,
+                 hbm_budget: Optional[float] = None,
+                 single_host: bool = False) -> "DiagnosticReport":
         """Static pre-execution validation — runs WITHOUT touching data.
 
         Walks the DAG reached from the result features through every opcheck
@@ -110,12 +112,20 @@ class Workflow:
         round-trips splitting the fused scoring prefix, unbounded shapes
         defeating padding buckets); unfitted-estimator TM501 checks need a
         fitted model — use :meth:`WorkflowModel.validate` for those.
+
+        ``cost=True`` (or a non-None ``hbm_budget``) adds the TM6xx
+        plan-cost analyzers (checkers/plancheck.py).  On an untrained
+        workflow only the recompile-hazard map is computable; the full
+        FLOPs/bytes/HBM analysis needs fitted stages
+        (:meth:`WorkflowModel.validate`).
         """
         from ..checkers.opcheck import validate_result_features
 
         return validate_result_features(self.result_features,
                                         workflow_cv=self._workflow_cv,
-                                        serving=serving)
+                                        serving=serving, cost=cost,
+                                        hbm_budget=hbm_budget,
+                                        single_host=single_host)
 
     # -- data ----------------------------------------------------------------
     def raw_features(self) -> List[Feature]:
@@ -134,7 +144,8 @@ class Workflow:
 
     # -- training ------------------------------------------------------------
     def train(self, test_fraction: float = 0.0, seed: int = 42,
-              checkpointer=None, strict: bool = False) -> "WorkflowModel":
+              checkpointer=None, strict: bool = False,
+              hbm_budget: Optional[float] = None) -> "WorkflowModel":
         """Fit the DAG.  ``checkpointer`` (a StageCheckpointer) persists each
         fitted stage as it completes and resumes from disk on re-run —
         sweep-level resume for preemptible hardware (SURVEY §5.4).
@@ -142,6 +153,13 @@ class Workflow:
         ``strict=True`` runs the static validator first and raises
         :class:`OpCheckError` on any error-severity diagnostic, so a broken
         DAG fails in milliseconds instead of minutes into a TPU job.
+
+        ``hbm_budget`` (bytes) arms the TM601 admission gate on every fused
+        transform plan the fit builds: before a fused prefix dispatches, its
+        jaxpr-level peak live-buffer estimate (checkers/plancheck.py) is
+        compared against the budget and an over-budget plan raises
+        :class:`OpCheckError` instead of launching a device job that will
+        OOM minutes in.
         """
         if not self.result_features:
             raise ValueError("set_result_features before train()")
@@ -242,14 +260,16 @@ class Workflow:
             before, during, selector = cut
             if selector.uid not in warm:  # checkpoint resume: sweep already done
                 warm = dict(warm)
-                ds_before = fit_stage_list(train_ds, before, warm, on_fit=on_fit)
+                ds_before = fit_stage_list(train_ds, before, warm,
+                                           on_fit=on_fit,
+                                           hbm_budget=hbm_budget)
                 selector._preselected = workflow_cv_validate(
-                    ds_before, during, selector)
+                    ds_before, during, selector, hbm_budget=hbm_budget)
                 preseeded_selector = selector
 
         try:
             _, fitted = fit_dag(train_ds, self.result_features, fitted=warm,
-                                on_fit=on_fit)
+                                on_fit=on_fit, hbm_budget=hbm_budget)
         finally:
             if preseeded_selector is not None and hasattr(
                     preseeded_selector, "_preselected"):
@@ -404,30 +424,46 @@ class WorkflowModel:
         return score_function(self)
 
     # -- serving (serve/, docs/serving.md) -----------------------------------
-    def validate(self, serving: bool = True) -> "DiagnosticReport":
+    def validate(self, serving: bool = True, cost: bool = False,
+                 hbm_budget: Optional[float] = None,
+                 single_host: bool = False) -> "DiagnosticReport":
         """Static validation of the FITTED model, scoring-path aware.
 
         Same analyzer suite as :meth:`Workflow.validate` but estimators
         resolve through the fitted models, so a missing fit is a TM501
         error and the TM502/TM503 servability analyzers see the stages that
         will actually run at request time.
+
+        ``cost=True`` (or a non-None ``hbm_budget``, or
+        ``single_host=True``) additionally traces the fused scoring prefix
+        abstractly (checkers/plancheck.py — zero backend compiles) and
+        attaches the :class:`PlanCostReport` as ``report.plan_cost``, with
+        TM601 (HBM budget), TM602 (recompile hazards), TM603 (collectives
+        under a single-host contract), TM604 (memory-bound segments), and
+        TM605 (order-dependent numerics) findings.
         """
         from ..checkers.opcheck import validate_result_features
 
         return validate_result_features(self.result_features,
                                         workflow_cv=self.workflow_cv,
-                                        serving=serving, fitted=self.fitted)
+                                        serving=serving, fitted=self.fitted,
+                                        cost=cost, hbm_budget=hbm_budget,
+                                        single_host=single_host)
 
     def serving_plan(self, min_bucket: int = 8, max_bucket: int = 1024,
-                     strict: bool = True):
+                     strict: bool = True,
+                     hbm_budget: Optional[float] = None):
         """Compile this model for online scoring
         (:class:`~transmogrifai_tpu.serve.CompiledScoringPlan`): maximal
         jit-fused device prefix + host remainder, specialized per
-        power-of-two padding bucket."""
+        power-of-two padding bucket.  ``hbm_budget`` (bytes) arms the TM601
+        admission gate: a plan whose static peak-HBM estimate exceeds the
+        budget refuses to build (serve/validator.py)."""
         from ..serve import compile_plan
 
         return compile_plan(self, min_bucket=min_bucket,
-                            max_bucket=max_bucket, strict=strict)
+                            max_bucket=max_bucket, strict=strict,
+                            hbm_budget=hbm_budget)
 
     def serve(self, **kwargs):
         """In-process scoring server over this model
