@@ -388,6 +388,22 @@ def bench_transform(n_rows: int):
             })
     except Exception as e:  # noqa: BLE001 — the bench must still emit
         out["predicted_error"] = f"{type(e).__name__}: {e}"
+    # program identity (checkers/irsnap.py): content + IR fingerprints of
+    # the EXACT fused plan timed above, so BENCH artifacts are
+    # self-describing across rounds — a throughput shift between rounds can
+    # be told apart from a program change (jax bump, kernel edit) by diffing
+    # these instead of guessing
+    try:
+        from transmogrifai_tpu.checkers.irsnap import snapshot_transform_plan
+        from transmogrifai_tpu.workflow.plan import plan_for_features
+
+        plan = plan_for_features(ds, features, fitted)
+        if plan is not None:
+            snap = snapshot_transform_plan(plan, ds)
+            out["plan_fingerprint"] = plan.fingerprint[:16]
+            out["ir_fingerprint"] = snap.ir_fingerprint
+    except Exception as e:  # noqa: BLE001 — the bench must still emit
+        out["ir_fingerprint_error"] = f"{type(e).__name__}: {e}"
     return out
 
 
@@ -462,7 +478,7 @@ def bench_serve(n_records: int):
         m = server.metrics()
 
     res, bat = clean["resilience"], clean["batcher"]
-    return {
+    out = {
         "records": len(records),
         "throughput_rps": round(rps, 1),
         "degraded_host_rps": round(degraded_rps, 1),
@@ -478,6 +494,17 @@ def bench_serve(n_records: int):
             res["quarantined"] == 0 and res["breaker"]["opened"] == 0
             and bat["deadline_expired"] == 0 and bat["failed"] == 0),
     }
+    # program identity of the scoring plan the server just replayed through
+    # (see the transform section's ir_fingerprint note)
+    try:
+        from transmogrifai_tpu.checkers.irsnap import snapshot_scoring_plan
+
+        snap = snapshot_scoring_plan(server.plan)
+        out["plan_fingerprint"] = server.plan.fingerprint[:16]
+        out["ir_fingerprint"] = snap.ir_fingerprint
+    except Exception as e:  # noqa: BLE001 — the bench must still emit
+        out["ir_fingerprint_error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 def bench_irls_mfu(n_rows: int, device_kind: str):

@@ -369,6 +369,14 @@ class TestBenchSmoke:
         assert tr["predicted_flops"] > 0, tr
         assert tr["predicted_bytes"] > 0, tr
         assert tr["predicted_peak_hbm_bytes"] > 0, tr
+        # program identity (ISSUE 7): the BENCH artifact names the exact
+        # fused programs it timed — content + IR-corpus fingerprints in the
+        # transform and serve sections, so round-over-round throughput
+        # shifts can be told apart from program changes
+        assert len(tr["ir_fingerprint"]) == 32, tr
+        assert tr["plan_fingerprint"], tr
+        assert len(sv["ir_fingerprint"]) == 32, sv
+        assert sv["plan_fingerprint"], sv
         if secs.get("irls_mfu", {}).get("status") == "ok":
             assert parsed["irls_sweep_predicted_flops"] > 0
             cal = parsed["irls_sweep_flops_calibration"]

@@ -473,6 +473,104 @@ class TestCliLintCost:
         assert "--workflow or --model" in r.stderr
 
 
+#: documented key sets of the three ``--format json`` line types
+#: (docs/static_analysis.md "Machine-readable output") — the contract the
+#: tools/*_gate.py parsers and any downstream tooling rely on
+_JSONL_DIAGNOSTIC_KEYS = {"code", "severity", "stageUid", "location",
+                          "message", "fixHint"}
+_JSONL_PLAN_COST_KEYS = {"plan", "totalFlops", "totalBytes", "peakHbmBytes",
+                         "buckets", "segments", "recompileHazards",
+                         "collectives", "orderSensitiveOps", "mesh", "notes"}
+_JSONL_IR_DIFF_KEYS = {"compared", "changed", "skipped", "counts",
+                       "goldenJaxVersion", "currentJaxVersion",
+                       "goldenPlatform", "currentPlatform"}
+
+
+class TestCliLintJsonRoundTrip:
+    """Satellite (ISSUE 7): EVERY ``--format json`` line — diagnostic,
+    planCostReport, and the new irDiff — parses as one JSON object and
+    carries its documented keys, in one combined invocation."""
+
+    def _lint(self, *args):
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "transmogrifai_tpu.cli", "lint", *args],
+            env=env, capture_output=True, text=True, timeout=300)
+
+    def test_all_three_line_types_round_trip(self, tmp_path):
+        p = tmp_path / "sneaky.py"
+        p.write_text(_HAZARD_SOURCE)  # seeds a TM301 diagnostic line
+        r = self._lint("--path", str(p),
+                       "--ir", "--ir-family", "models.linear",
+                       "--format", "json")
+        assert r.returncode == 1, r.stdout + r.stderr  # TM301 >= warning
+        lines = r.stdout.strip().splitlines()
+        assert lines
+        kinds = {"diagnostic": 0, "planCostReport": 0, "irDiff": 0}
+        for ln in lines:
+            obj = json.loads(ln)  # every line is one JSON object
+            assert isinstance(obj, dict)
+            if "planCostReport" in obj:
+                kinds["planCostReport"] += 1
+                assert _JSONL_PLAN_COST_KEYS <= set(obj["planCostReport"])
+            elif "irDiff" in obj:
+                kinds["irDiff"] += 1
+                assert _JSONL_IR_DIFF_KEYS <= set(obj["irDiff"])
+            else:
+                kinds["diagnostic"] += 1
+                assert _JSONL_DIAGNOSTIC_KEYS <= set(obj), obj
+        assert kinds["irDiff"] == 1
+        assert kinds["diagnostic"] >= 1
+        codes = [json.loads(ln).get("code") for ln in lines]
+        assert "TM301" in codes
+
+    def test_ir_diff_line_reports_clean_corpus(self):
+        r = self._lint("--ir", "--ir-family", "models.linear",
+                       "--format", "json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        lines = [json.loads(ln) for ln in r.stdout.strip().splitlines()]
+        ir = [ln["irDiff"] for ln in lines if "irDiff" in ln]
+        assert len(ir) == 1
+        assert ir[0]["compared"] == 1 and ir[0]["changed"] == []
+        # with a clean corpus no diagnostic lines are emitted at all
+        assert not [ln for ln in lines if "code" in ln]
+
+    def test_legacy_json_array_carries_ir_diff_element(self):
+        r = self._lint("--ir", "--ir-family", "models.linear", "--json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        blob = json.loads(r.stdout)
+        assert isinstance(blob, list)
+        ir = [el["irDiff"] for el in blob if "irDiff" in el]
+        assert len(ir) == 1
+        assert _JSONL_IR_DIFF_KEYS <= set(ir[0])
+
+    def test_plan_cost_line_keys(self, tmp_path):
+        """planCostReport JSONL keys, exercised via a workflow target (the
+        unfitted-workflow cost report needs no training)."""
+        wf_src = '''\
+from transmogrifai_tpu import FeatureBuilder, Workflow, transmogrify
+
+
+def build():
+    a = FeatureBuilder.Real("a").extract_field().as_predictor()
+    return Workflow().set_result_features(transmogrify([a]))
+'''
+        (tmp_path / "jsondemo.py").write_text(wf_src)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=f"{REPO_ROOT}{os.pathsep}{tmp_path}")
+        r = subprocess.run(
+            [sys.executable, "-m", "transmogrifai_tpu.cli", "lint",
+             "--workflow", "jsondemo:build", "--cost", "--format", "json",
+             "--fail-on", "error"],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        lines = [json.loads(ln) for ln in r.stdout.strip().splitlines()]
+        reports = [ln["planCostReport"] for ln in lines
+                   if "planCostReport" in ln]
+        assert len(reports) == 1
+        assert _JSONL_PLAN_COST_KEYS <= set(reports[0])
+
+
 class TestLintGate:
     """tools/lint_gate.py (ISSUE 6 satellite): rc flips ONLY on NEW errors —
     INFO/WARNING never gate; baselined errors pass; --update-baseline."""

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""static_gate — the single CI entrypoint for every static-analysis gate.
+
+Runs, in order:
+
+1. **ir_gate** (tools/ir_gate.py): the IR golden-corpus differ — always, no
+   target needed (the builtin program-family corpus is self-contained);
+2. **lint_gate** (tools/lint_gate.py): the TM1xx-TM6xx diagnostic gate —
+   when lint arguments are provided after ``--`` (it needs a --workflow /
+   --model / --path target).
+
+One merged exit-code contract, inherited from both gates: rc **1** only when
+either gate finds a NEW error-severity diagnostic relative to its baseline;
+INFO/WARNING findings never flip the rc; a gate that cannot run (crash,
+missing corpus, no parseable output) is fatal, never green.
+
+Usage::
+
+    # IR corpus only
+    python tools/static_gate.py
+
+    # IR corpus + workflow/source lint
+    python tools/static_gate.py -- --workflow myproj.main:build --path myproj/
+
+    # custom baselines
+    python tools/static_gate.py --ir-baseline tools/ir_baseline.json \
+        --lint-baseline tools/lint_baseline.json -- --path myproj/
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import ir_gate  # noqa: E402
+import lint_gate  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="static_gate",
+        description="run ir_gate + lint_gate with one merged exit-code "
+                    "contract (rc 1 only on NEW errors in either)")
+    ap.add_argument("--ir-baseline", default="tools/ir_baseline.json",
+                    help="ir_gate baseline file")
+    ap.add_argument("--lint-baseline", default="tools/lint_baseline.json",
+                    help="lint_gate baseline file")
+    ap.add_argument("--skip-ir", action="store_true",
+                    help="skip the IR corpus gate")
+    ap.add_argument("--goldens", default=None, metavar="DIR",
+                    help="golden IR corpus directory forwarded to ir_gate")
+    ap.add_argument("lint_args", nargs=argparse.REMAINDER,
+                    help="arguments forwarded to `cli lint` via lint_gate "
+                         "(prefix with --); omit to run the IR gate only")
+    ns = ap.parse_args(argv)
+    lint_args = [a for a in ns.lint_args if a != "--"]
+
+    rc = 0
+    if not ns.skip_ir:
+        ir_argv = ["--baseline", ns.ir_baseline]
+        if ns.goldens:
+            ir_argv += ["--", "--goldens", ns.goldens]
+        print("static_gate: running ir_gate ...")
+        rc_ir = ir_gate.main(ir_argv)
+        print(f"static_gate: ir_gate rc={rc_ir}")
+        rc = max(rc, rc_ir)
+
+    if lint_args:
+        print("static_gate: running lint_gate ...")
+        rc_lint = lint_gate.main(["--baseline", ns.lint_baseline, "--",
+                                  *lint_args])
+        print(f"static_gate: lint_gate rc={rc_lint}")
+        rc = max(rc, rc_lint)
+    elif ns.skip_ir:
+        # both halves disabled: refuse to report a green nothing
+        raise SystemExit("static_gate: --skip-ir with no lint args runs "
+                         "NO gate — refusing to exit 0")
+    else:
+        print("static_gate: no lint args — lint_gate skipped "
+              "(pass `-- --workflow ... --path ...` to enable)")
+
+    print(f"static_gate: {'FAIL' if rc else 'OK'} (rc={rc})")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

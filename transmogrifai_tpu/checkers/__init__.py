@@ -14,6 +14,20 @@ from .diagnostics import (
     Severity,
     make_diagnostic,
 )
+from .irsnap import (
+    CorpusDiff,
+    IRSnapshot,
+    build_corpus,
+    canonicalize_stablehlo,
+    check_ir_corpus,
+    diff_corpus,
+    diff_snapshots,
+    load_corpus,
+    save_corpus,
+    snapshot_program,
+    snapshot_scoring_plan,
+    snapshot_transform_plan,
+)
 from .plancheck import (
     BucketCost,
     PlanCostReport,
@@ -30,9 +44,11 @@ from .plancheck import (
 __all__ = [
     "DIAGNOSTIC_CODES",
     "BucketCost",
+    "CorpusDiff",
     "DagCycleError",
     "Diagnostic",
     "DiagnosticReport",
+    "IRSnapshot",
     "OpCheckError",
     "PlanCostReport",
     "RecompileHazard",
@@ -41,8 +57,18 @@ __all__ = [
     "analyze_scoring_plan",
     "analyze_transform",
     "analyze_transform_plan",
+    "build_corpus",
+    "canonicalize_stablehlo",
+    "check_ir_corpus",
     "check_plan_cost",
     "cost_diagnostics",
+    "diff_corpus",
+    "diff_snapshots",
+    "load_corpus",
     "make_diagnostic",
+    "save_corpus",
+    "snapshot_program",
+    "snapshot_scoring_plan",
+    "snapshot_transform_plan",
     "trace_cost",
 ]

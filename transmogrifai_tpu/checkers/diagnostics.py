@@ -21,6 +21,10 @@ Code families:
   programs (checkers/plancheck.py): HBM budget admission, recompile
   hazards, collectives under a single-host contract, memory-bound
   segments, order-dependent numerics
+- ``TM7xx`` IR corpus    — StableHLO golden-corpus differ
+  (checkers/irsnap.py): classified IR drift of every emitted program
+  family (benign text / fusion-layout / collectives / dtype widening /
+  the GSPMD sharded-sort miscompile class) across jax upgrades
 """
 
 from __future__ import annotations
@@ -170,6 +174,39 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "backends/meshes is not guaranteed — pin the layout (e.g. "
               "C-contiguous blocks, replicated metric inputs) where parity "
               "matters"),
+    # -- IR corpus (StableHLO golden differ, checkers/irsnap.py) ------------
+    "TM700": (Severity.INFO, "IR corpus membership drift",
+              "a program family appeared without a golden snapshot (or a "
+              "golden family is no longer emitted); review the change and "
+              "refresh the corpus with `cli lint --ir --update-goldens`"),
+    "TM701": (Severity.INFO, "benign IR text drift",
+              "the canonical StableHLO text changed but every semantic "
+              "feature (op histogram, dtypes, collectives, sort signatures) "
+              "is identical — typically an MLIR printer or metadata change; "
+              "refresh the corpus at leisure"),
+    "TM702": (Severity.WARNING, "IR fusion/layout change",
+              "the op histogram of a lowered program shifted (ops "
+              "added/removed/recounted); performance and fusion structure "
+              "drifted — re-run the bench sections covering this family "
+              "before re-goldening"),
+    "TM703": (Severity.WARNING, "IR collective/resharding drift",
+              "cross-device collective or resharding ops were added or "
+              "removed from a lowered program; communication volume and "
+              "reduction-order numerics moved — validate mesh parity "
+              "(test_use_mesh) before re-goldening"),
+    "TM704": (Severity.ERROR, "IR dtype/widening drift",
+              "the element-type inventory of a lowered program changed "
+              "(a dtype appeared/vanished, or tensor counts migrated "
+              "between float widths); numeric precision semantics shifted "
+              "silently — audit the kernel (or the jax upgrade notes) "
+              "before re-goldening"),
+    "TM705": (Severity.ERROR, "sharded-sort-dim miscompile hazard",
+              "a sort op's sort dimension is sharded while its batch "
+              "dimensions stay replicated — the exact GSPMD pattern that "
+              "miscompiled the eval sweeps (metrics near -n, no error) "
+              "before PR 4 pinned metric inputs to replicated; replicate "
+              "the sort operand (models/base.py:_replicator) or shard a "
+              "batch dimension instead"),
     # -- leakage ------------------------------------------------------------
     "TM401": (Severity.ERROR, "label leaks into feature path",
               "a response(-derived) feature reaches the model's feature input "

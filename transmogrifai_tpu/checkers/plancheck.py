@@ -743,18 +743,9 @@ def analyze_transform(dataset, result_features, fitted) -> Optional[PlanCostRepo
     """Cost report of the fused transform plan ``transform_dag`` would run
     over ``dataset`` (None when nothing fuses).  Bench cross-checks its
     recorded FLOPs/bytes against this."""
-    from ..workflow.dag import compute_dag
-    from ..workflow.fit import _resolve
-    from ..workflow.plan import plan_for
+    from ..workflow.plan import plan_for_features
 
-    runners = []
-    for layer in compute_dag(result_features):
-        for stage in layer:
-            runner = _resolve(stage, dict(fitted))
-            if runner is None:
-                return None
-            runners.append(runner)
-    plan, _remainder = plan_for(runners, frozenset(dataset.names))
+    plan = plan_for_features(dataset, result_features, fitted)
     if plan is None:
         return None
     return analyze_transform_plan(plan, dataset)

@@ -77,6 +77,23 @@ def add_lint_parser(sub) -> None:
     p.add_argument("--single-host", action="store_true", dest="single_host",
                    help="assert the plan runs single-host: any "
                         "collective/resharding op inside it is a TM603 error")
+    p.add_argument("--ir", action="store_true",
+                   help="snapshot every builtin program family to canonical "
+                        "StableHLO (abstract lowering, zero backend "
+                        "compiles) and diff against the golden IR corpus "
+                        "(tests/goldens/ir) — TM7xx diagnostics")
+    p.add_argument("--update-goldens", action="store_true",
+                   dest="update_goldens",
+                   help="with --ir: rewrite the golden IR corpus from the "
+                        "current snapshots and exit 0 (use after a REVIEWED "
+                        "jax upgrade or kernel change)")
+    p.add_argument("--goldens", default=None, metavar="DIR",
+                   help="golden IR corpus directory (default: the repo's "
+                        "tests/goldens/ir)")
+    p.add_argument("--ir-family", action="append", default=[],
+                   dest="ir_families", metavar="SUBSTR",
+                   help="restrict --ir to families whose key contains "
+                        "SUBSTR (repeatable)")
     p.add_argument("--fail-on", choices=["info", "warning", "error"],
                    default="warning",
                    help="lowest severity that makes the exit status non-zero")
@@ -132,16 +149,26 @@ def run_lint(ns) -> int:
                                     lint_module_concurrency, lint_source,
                                     validate_result_features)
 
-    if not ns.workflow and not ns.path and not ns.model:
+    ir = ns.ir or ns.update_goldens or ns.ir_families
+    if not ns.workflow and not ns.path and not ns.model and not ir:
         # a gate invoked with no target (flag lost in CI YAML quoting, say)
         # must not go silently green
         raise SystemExit(
-            "lint: nothing to lint — pass --path, --workflow and/or --model")
+            "lint: nothing to lint — pass --path, --workflow, --model "
+            "and/or --ir")
     cost = ns.cost or ns.hbm_budget is not None or ns.single_host
     if cost and not (ns.workflow or ns.model):
         raise SystemExit("lint: --cost/--hbm-budget/--single-host need a "
                          "--workflow or --model target")
     report = DiagnosticReport()
+    ir_diff = None
+    if ir:
+        ir_diff = _run_ir(ns, report)
+        if ns.update_goldens and not (ns.path or ns.workflow or ns.model):
+            return 0
+        # a refresh combined with other lint targets falls through: the
+        # corpus was rewritten (nothing left to diff), but the requested
+        # --path/--workflow/--model lint must still run and set the rc
     cost_reports = []  # one PlanCostReport per --workflow/--model target
     targets = []
     if ns.workflow:
@@ -191,23 +218,99 @@ def run_lint(ns) -> int:
         import json
 
         # legacy shape: one array — diagnostics first, then (only when
-        # --cost ran) one {"planCostReport": ...} element per target
+        # --cost/--ir ran) one {"planCostReport"/"irDiff"} element per target
         blob = report.to_dicts()
         blob += [{"planCostReport": rep.to_dict()} for rep in cost_reports]
+        if ir_diff is not None:
+            blob.append({"irDiff": ir_diff.to_dict()})
         print(json.dumps(blob, indent=2))
     elif ns.out_format == "json":
         import json
 
-        # one object per line: planCostReport lines first (one per target),
-        # then one line per diagnostic — the tools/lint_gate.py contract
+        # one object per line: planCostReport/irDiff summary lines first,
+        # then one line per diagnostic — the tools/*_gate.py contract
         for rep in cost_reports:
             print(json.dumps({"planCostReport": rep.to_dict()}))
+        if ir_diff is not None:
+            print(json.dumps({"irDiff": ir_diff.to_dict()}))
         for d in report:
             print(json.dumps(d.to_dict()))
     else:
         for rep in cost_reports:
             print(rep.pretty())
+        if ir_diff is not None:
+            print(_ir_pretty(ir_diff))
         print(report.pretty())
 
     threshold = Severity[ns.fail_on.upper()]
     return 1 if report.at_least(threshold) else 0
+
+
+def _run_ir(ns, report):
+    """The ``--ir`` mode: snapshot + diff (or re-golden) the IR corpus.
+
+    Returns the :class:`~..checkers.irsnap.CorpusDiff` (None under
+    --update-goldens) and extends ``report`` with the TM7xx diagnostics.
+    A missing corpus is a hard refusal, not a silent pass — the gate exists
+    to catch exactly the run that forgot its baseline.
+    """
+    from ..checkers.irsnap import (build_corpus, check_ir_corpus,
+                                   default_goldens_dir, save_corpus)
+
+    goldens_dir = ns.goldens or default_goldens_dir()
+    families = ns.ir_families or None
+    if ns.update_goldens:
+        snaps, skipped = build_corpus(families=families)
+        # SKIPPED families (filtered out by --ir-family, or unbuildable in
+        # this environment — e.g. the @mesh4x2 entry on a 1-device box)
+        # keep their existing goldens: a refresh must never silently drop
+        # the TM705-absence pin just because this machine could not lower
+        # it.  Families removed from the registry entirely (neither built
+        # nor skipped) are the only ones dropped.
+        if skipped:
+            from ..checkers.irsnap import load_corpus
+
+            try:
+                kept, _index = load_corpus(goldens_dir)
+            except FileNotFoundError:
+                kept = {}
+            snaps = {**{k: v for k, v in kept.items() if k in skipped},
+                     **snaps}
+        index_path = save_corpus(snaps, goldens_dir)
+        print(f"lint --ir: golden corpus updated with {len(snaps)} "
+              f"program famil{'y' if len(snaps) == 1 else 'ies'} "
+              f"({len(skipped)} skipped) -> {index_path}")
+        return None
+    try:
+        diff, _current = check_ir_corpus(goldens_dir=goldens_dir,
+                                         families=families)
+    except FileNotFoundError as e:
+        raise SystemExit(
+            f"lint --ir: no golden IR corpus at {goldens_dir!r} ({e}); "
+            f"record one with `cli lint --ir --update-goldens` "
+            f"(or pass --goldens DIR)") from e
+    if diff.compared == 0:
+        # a typo'd --ir-family (or a filter this environment cannot lower)
+        # compares nothing — refusing keeps the gate fail-closed, same
+        # contract as the no-target and missing-corpus refusals
+        what = (f"--ir-family {', '.join(families)} matched nothing"
+                if families else "the corpus is empty")
+        raise SystemExit(
+            f"lint --ir: 0 program families compared ({what} in this "
+            f"environment) — refusing to report a green nothing")
+    report.extend(diff.diagnostics)
+    return diff
+
+
+def _ir_pretty(diff) -> str:
+    lines = [f"IR corpus: {diff.compared} famil"
+             f"{'y' if diff.compared == 1 else 'ies'} compared, "
+             f"{len(diff.changed)} changed, {len(diff.skipped)} skipped"]
+    if diff.golden_jax_version or diff.current_jax_version:
+        lines.append(f"  jax: golden {diff.golden_jax_version} / current "
+                     f"{diff.current_jax_version}; platform: golden "
+                     f"{diff.golden_platform} / current "
+                     f"{diff.current_platform}")
+    for key in diff.changed:
+        lines.append(f"  changed: {key}")
+    return "\n".join(lines)

@@ -433,7 +433,7 @@ class ColumnarTransformPlan:
             entries = self._host_entries(dataset)
             placed, _bucket = self._place(entries, n)
             if self._jitted is None:
-                self._jitted = jax.jit(self._fused)
+                self._jitted = jax.jit(self._fused)  # opcheck: allow(TM303) built once per plan, memoized on self._jitted
             outs = run_cached(self._jitted, *placed,
                               label=f"transform_plan/{len(self._prefix)}stages")
             cols = {}
@@ -584,7 +584,7 @@ class ColumnarTransformPlan:
             if prog is None:
                 in_axes = (0,) * (n_states + n_fold) \
                     + (None,) * len(placed_shared)
-                prog = jax.jit(jax.vmap(fold_fn, in_axes=in_axes))
+                prog = jax.jit(jax.vmap(fold_fn, in_axes=in_axes))  # opcheck: allow(TM303) built once per (fold count), memoized in self._fold_programs
                 self._fold_programs[key] = prog
             outs = run_cached(
                 prog, *flat_states, *padded_fold, *placed_shared,
@@ -675,6 +675,27 @@ def plan_for(runners: Sequence[Any], available: frozenset
 
             evict_program_entries(fns)
     return probe, list(probe._remainder)
+
+
+def plan_for_features(dataset: Dataset, result_features, fitted
+                      ) -> Optional[ColumnarTransformPlan]:
+    """The fused transform plan ``transform_dag`` would dispatch for a
+    fitted workflow over ``dataset`` (None when nothing fuses or any stage
+    is unfitted).  The one derivation shared by the static analyzers
+    (plancheck/irsnap) and bench, so they all cost/fingerprint the SAME
+    program the planner runs."""
+    from .dag import compute_dag
+    from .fit import _resolve
+
+    runners = []
+    for layer in compute_dag(result_features):
+        for stage in layer:
+            runner = _resolve(stage, dict(fitted))
+            if runner is None:
+                return None
+            runners.append(runner)
+    plan, _remainder = plan_for(runners, frozenset(dataset.names))
+    return plan
 
 
 def check_plan_hbm_budget(plan: "ColumnarTransformPlan", dataset: Dataset,
