@@ -507,6 +507,102 @@ def bench_serve(n_records: int):
     return out
 
 
+def bench_stream(n_records: int):
+    """Continual-training control plane (workflow/continual.py): streamed
+    records/sec through drift-check + shadow-score, and the warm-refit
+    compile count.
+
+    A candidate model is produced by a frozen-prep warm refit on the
+    training window and staged for shadow scoring, then every streamed
+    batch goes through submit() (mirrored to the candidate) and the drift
+    accumulators.  Gates: the warm refit performs ZERO backend compiles
+    (plan cache + sweep executable cache), the swap shares the prefix
+    executables (equal plan fingerprints), and shadow mirroring covers the
+    stream with zero shadow failures.
+    """
+    from transmogrifai_tpu import FeatureBuilder, Workflow, transmogrify
+    from transmogrifai_tpu import BinaryClassificationModelSelector
+    from transmogrifai_tpu.models.logistic import LogisticRegression
+    from transmogrifai_tpu.readers.base import rows_to_dataset
+    from transmogrifai_tpu.readers.files import DataReaders
+    from transmogrifai_tpu.serve import ScoringServer
+    from transmogrifai_tpu.workflow.continual import (DriftDetector,
+                                                      RefitController,
+                                                      TrainingSnapshot)
+    from transmogrifai_tpu.workflow.workflow import dedup_raw_features
+
+    import pandas as pd
+
+    n_train = 2_000
+    levels = [f"lv{j}" for j in range(8)]
+
+    def make_records(n, seed):
+        r = np.random.default_rng(seed)
+        x = r.normal(size=(n, 4))
+        return [{"label": float(r.random() < 1 / (1 + np.exp(-x[i, 0]))),
+                 **{f"num{j}": float(x[i, j]) for j in range(4)},
+                 "cat0": str(levels[int(r.integers(0, len(levels)))])}
+                for i in range(n)]
+
+    train = make_records(n_train, 31)
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"num{j}").extract_field().as_predictor()
+             for j in range(4)] + \
+            [FeatureBuilder.PickList("cat0").extract_field().as_predictor()]
+    checked = label.sanity_check(transmogrify(feats))
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+    pred = label.transform_with(sel, checked)
+    model = (Workflow().set_result_features(label, pred)
+             .set_reader(DataReaders.Simple.dataframe(pd.DataFrame(train)))
+             ).train()
+
+    raws = dedup_raw_features(model.result_features)
+    train_ds = rows_to_dataset(train, raws)
+    snap = TrainingSnapshot.from_dataset(train_ds, features=raws)
+    detector = DriftDetector(snap, min_records=256)
+
+    # frozen-prep warm refit on the training window: the zero-compile gate
+    refit = RefitController(model)
+    prime_compiles = refit.prime(train_ds)
+    res = refit.refit(train_ds)
+
+    records = make_records(n_records, 32)
+    batches = [records[i:i + 256] for i in range(0, len(records), 256)]
+    with ScoringServer(model, max_batch=64, max_wait_ms=1.0,
+                       max_queue=n_records + 1) as server:
+        server.stage_candidate(res.model)
+        t0 = time.perf_counter()
+        for batch in batches:
+            futs = [server.submit({k: v for k, v in r.items()
+                                   if k != "label"}) for r in batch]
+            for f in futs:
+                f.result(timeout=120)
+            detector.observe(rows_to_dataset(batch, raws,
+                                             allow_missing_response=True))
+        dt = time.perf_counter() - t0
+        shadow = server.shadow_report()
+        swap = server.promote(probation_batches=2)
+        m = server.metrics()
+
+    stats = detector.feature_stats()
+    return {
+        "records": len(records),
+        "records_per_sec": round(len(records) / dt, 1),
+        "warm_refit_backend_compiles": res.backend_compiles,
+        "prime_backend_compiles": prime_compiles,
+        "prefix_reused": res.prefix_reused,
+        "zero_refit_compile_gate": bool(res.backend_compiles == 0),
+        "shadow_mirrored": shadow["mirrored_records"],
+        "shadow_failures": shadow["shadow_failures"],
+        "mean_abs_delta": shadow["mean_abs_delta"],
+        "swap_shared_prefix": bool(swap["shared_prefix"]),
+        "swaps": m["swap"]["swaps"],
+        "drift_psi_max": round(max((s["psi"] for s in stats.values()),
+                                   default=0.0), 4),
+    }
+
+
 def bench_irls_mfu(n_rows: int, device_kind: str):
     """Achieved TFLOP/s (+ fraction of bf16 peak) of the IRLS CV sweep kernel."""
     import jax
@@ -682,6 +778,7 @@ _SECTION_FLOORS = {
     "baseline": 60.0,
     "transform": 45.0,
     "serve": 40.0,
+    "stream": 40.0,
     "irls_mfu": 60.0,
     "tree_hist": 60.0,
     "tree_hist_batched": 90.0,
@@ -829,6 +926,14 @@ def main(argv=None):
         lambda: bench_serve(1_000 if smoke else 5_000))
     if sv is not None:
         _OUT["serve"] = sv
+
+    # continual control plane: drift-check + shadow-score streaming
+    # throughput, warm-refit compile count (gate: zero), swap identity
+    st = _run_section(
+        "stream", budget,
+        lambda: bench_stream(1_000 if smoke else 5_000))
+    if st is not None:
+        _OUT["stream"] = st
 
     mfu = _run_section(
         "irls_mfu", budget,

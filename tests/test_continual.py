@@ -1,0 +1,724 @@
+"""Continuous warm refit (ISSUE 9): drift-gated streaming retrain loop with
+shadow scoring, atomic model swap, and rollback — every phase under the
+deterministic fault harness.
+
+Acceptance criteria proven here (TestContinualE2E):
+- streamed batches with injected covariate drift fire the drift detector;
+- the warm refit completes with ZERO new backend compiles on the transform
+  prefix (frozen prep -> plan cache + sweep executable cache hits);
+- the shadow parity gate passes and the atomic swap serves the new model
+  with no dropped or double-scored in-flight requests;
+- under injected refit/swap faults (FaultHarness scripts) the server keeps
+  serving the last-known-good model;
+- a post-swap circuit-breaker trip auto-rolls back to the retained
+  last-known-good model.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    FeatureBuilder,
+    Workflow,
+    transmogrify,
+)
+from transmogrifai_tpu.checkers.diagnostics import OpCheckError
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.perf import measure_compiles
+from transmogrifai_tpu.readers import ListSource, MicroBatchStreamingReader
+from transmogrifai_tpu.readers.base import rows_to_dataset
+from transmogrifai_tpu.readers.files import DataReaders
+from transmogrifai_tpu.serve import (
+    FaultHarness,
+    ScoringServer,
+    TransientScoringError,
+    prediction_delta,
+)
+from transmogrifai_tpu.workflow.continual import (
+    ContinualTrainer,
+    DriftDetector,
+    PromotionGate,
+    RefitController,
+    RefitError,
+    TrainingSnapshot,
+)
+from transmogrifai_tpu.workflow.workflow import dedup_raw_features
+
+N_TRAIN = 256
+
+
+def make_records(n, seed, shift=0.0, missing_rate=0.0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, 3)) + shift
+    out = []
+    for i in range(n):
+        rec = {"label": float(r.random() < 1 / (1 + np.exp(-x[i, 0])))}
+        for j in range(3):
+            rec[f"num{j}"] = None if r.random() < missing_rate \
+                else float(x[i, j])
+        out.append(rec)
+    return out
+
+
+@pytest.fixture(scope="module")
+def base():
+    """(model, train records, raw features, train dataset, snapshot)."""
+    import pandas as pd
+
+    train = make_records(N_TRAIN, 1)
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"num{j}").extract_field().as_predictor()
+             for j in range(3)]
+    checked = label.sanity_check(transmogrify(feats))
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+    pred = label.transform_with(sel, checked)
+    model = (Workflow().set_result_features(label, pred)
+             .set_reader(DataReaders.Simple.dataframe(pd.DataFrame(train)))
+             ).train()
+    raws = dedup_raw_features(model.result_features)
+    train_ds = rows_to_dataset(train, raws)
+    snap = TrainingSnapshot.from_dataset(train_ds, features=raws)
+    return model, train, raws, train_ds, snap
+
+
+def stream_reader(records, batch=128):
+    return MicroBatchStreamingReader(
+        ListSource(records, "stream"), batch_interval=0.0,
+        max_batch_records=batch, max_empty_polls=1)
+
+
+# ---------------------------------------------------------------------------
+# Drift detection
+# ---------------------------------------------------------------------------
+
+class TestDriftDetector:
+    def test_snapshot_covers_numeric_predictors_only(self, base):
+        *_, snap = base
+        assert sorted(snap.features) == ["num0", "num1", "num2"]
+        assert snap.n_rows == N_TRAIN
+        for fs in snap.features.values():
+            assert len(fs.bin_probs) == len(fs.bin_edges) + 1
+            assert abs(sum(fs.bin_probs) - 1.0) < 1e-9
+
+    def test_snapshot_roundtrip(self, base, tmp_path):
+        *_, snap = base
+        p = str(tmp_path / "snap.json")
+        snap.save(p)
+        loaded = TrainingSnapshot.load(p)
+        assert loaded.to_dict() == snap.to_dict()
+
+    def test_quiet_on_same_distribution(self, base):
+        model, train, raws, train_ds, snap = base
+        det = DriftDetector(snap, min_records=128)
+        det.observe(rows_to_dataset(make_records(512, 9), raws))
+        report = det.evaluate()
+        assert not DriftDetector.drifted(report), [d.pretty() for d in report]
+
+    def test_insufficient_data_defers_tm804(self, base):
+        *_, snap = base
+        det = DriftDetector(snap, min_records=128)
+        report = det.evaluate()
+        assert [d.code for d in report] == ["TM804"]
+        assert not DriftDetector.drifted(report)
+
+    def test_covariate_shift_fires_psi_and_z(self, base):
+        model, train, raws, train_ds, snap = base
+        det = DriftDetector(snap, min_records=128)
+        det.observe(rows_to_dataset(make_records(512, 10, shift=3.0), raws))
+        report = det.evaluate()
+        codes = {d.code for d in report}
+        assert "TM801" in codes and "TM802" in codes
+        assert DriftDetector.drifted(report)
+        stats = det.feature_stats()
+        assert stats["num0"]["psi"] > det.psi_threshold
+
+    def test_missing_rate_shift_fires_tm803(self, base):
+        model, train, raws, train_ds, snap = base
+        det = DriftDetector(snap, min_records=128)
+        det.observe(rows_to_dataset(
+            make_records(512, 11, missing_rate=0.6), raws))
+        report = det.evaluate()
+        assert any(d.code == "TM803" for d in report)
+
+    def test_total_outage_all_missing_still_fires_tm803(self, base):
+        """A TOTAL upstream outage (every value missing) must still raise
+        the missing-rate alarm — PSI/z need valid values, TM803 does not."""
+        model, train, raws, train_ds, snap = base
+        det = DriftDetector(snap, min_records=128)
+        det.observe(rows_to_dataset(
+            make_records(256, 13, missing_rate=1.0), raws))
+        report = det.evaluate()
+        assert any(d.code == "TM803" for d in report)
+        assert DriftDetector.drifted(report)
+        stats = det.feature_stats()
+        assert stats["num0"]["missing_rate"] == 1.0
+        assert stats["num0"]["records"] == 0
+
+    def test_constant_feature_shifted_to_new_constant_fires(self, base):
+        """A feature constant in training (zero variance, collapsed bins)
+        that shifts to a DIFFERENT constant must still fire: se == 0 with a
+        moved mean is infinitely significant (TM802), not z = 0."""
+        model, train, raws, train_ds, snap = base
+        import copy
+
+        snap2 = copy.deepcopy(snap)
+        fs = snap2.features["num0"]
+        fs.mean, fs.variance = 0.0, 0.0
+        fs.bin_edges, fs.bin_probs = [0.0], [0.0, 1.0]
+        det = DriftDetector(snap2, min_records=128)
+        shifted = [{"label": 0.0, "num0": 5.0, "num1": 0.0, "num2": 0.0}
+                   for _ in range(200)]
+        det.observe(rows_to_dataset(shifted, raws,
+                                    allow_missing_response=True))
+        report = det.evaluate()
+        assert any(d.code == "TM802" for d in report), \
+            [d.pretty() for d in report]
+        assert math.isinf(det.feature_stats()["num0"]["z"])
+        # identical constant stays quiet
+        det.reset()
+        det.observe(rows_to_dataset(
+            [{"label": 0.0, "num0": 0.0, "num1": 0.0, "num2": 0.0}
+             for _ in range(200)], raws, allow_missing_response=True))
+        assert det.feature_stats()["num0"]["z"] == 0.0
+
+    def test_rebase_resets_accumulators(self, base):
+        model, train, raws, train_ds, snap = base
+        det = DriftDetector(snap, min_records=128)
+        det.observe(rows_to_dataset(make_records(256, 12, shift=3.0), raws))
+        assert det.records == 256
+        det.rebase(snap)
+        assert det.records == 0
+        assert [d.code for d in det.evaluate()] == ["TM804"]
+
+
+# ---------------------------------------------------------------------------
+# Warm refit
+# ---------------------------------------------------------------------------
+
+class TestRefitController:
+    def test_frozen_prefix_refit_zero_compiles(self, base):
+        """Acceptance: after the one-time prime, a warm refit on a window of
+        the training bucket performs ZERO backend compiles — the fused
+        transform prefix comes back from the plan cache and the selector
+        sweep from the content-addressed executable cache."""
+        model, train, raws, train_ds, snap = base
+        ctl = RefitController(model)
+        ctl.prime(train_ds)
+        window = rows_to_dataset(make_records(N_TRAIN, 21, shift=2.0), raws)
+        with measure_compiles() as probe:
+            res = ctl.refit(window)
+        assert res.backend_compiles == 0, res
+        assert probe.backend_compiles == 0
+        assert res.prefix_reused is True
+        assert res.diagnostics == []  # no TM809
+        # the candidate is a genuinely retrained model over frozen prep
+        assert res.model is not model
+        pred_name = next(f.name for f in model.result_features
+                         if f.ftype.__name__ == "Prediction")
+        out = res.model.serving_plan(strict=True).score(
+            [dict(make_records(4, 22)[0])])
+        assert pred_name in out[0]
+
+    def test_scripted_refit_fault_retries_then_succeeds(self, base):
+        model, train, raws, train_ds, snap = base
+        ctl = RefitController(model, sleep=lambda s: None)
+        harness = FaultHarness(seed=0)
+        harness.script("refit", [TransientScoringError("injected"), None])
+        with harness:
+            res = ctl.refit(train_ds)
+        assert res.attempts == 2
+        assert harness.calls["refit"] == 2
+
+    def test_exhausted_retries_raise_refit_error_tm805(self, base):
+        model, train, raws, train_ds, snap = base
+        ctl = RefitController(model, max_retries=1, sleep=lambda s: None)
+        harness = FaultHarness(seed=0)
+        harness.fail_when("refit", lambda ctx: True,
+                          lambda: TransientScoringError("persistent"))
+        with harness:
+            with pytest.raises(RefitError) as ei:
+                ctl.refit(train_ds)
+        assert [d.code for d in ei.value.diagnostics] == ["TM805"]
+        assert harness.calls["refit"] == 2  # bounded: initial + 1 retry
+        # the base model is untouched and still scores
+        model.serving_plan(strict=True).score([make_records(1, 23)[0]])
+
+    def test_checkpoint_current_flips_only_on_promotion(self, base, tmp_path):
+        """refit() saves the versioned candidate but CURRENT (the durable
+        last-known-good) only flips via mark_current — i.e. after the swap
+        commits; a gate-rejected candidate's save never becomes CURRENT."""
+        model, train, raws, train_ds, snap = base
+        d = str(tmp_path / "ckpt")
+        ctl = RefitController(model, checkpoint_dir=d, sleep=lambda s: None)
+        res1 = ctl.refit(train_ds)
+        assert res1.checkpoint_path.endswith("model-0001")
+        assert os.path.isdir(res1.checkpoint_path)
+        # not promoted yet: no CURRENT pointer
+        assert not os.path.exists(os.path.join(d, "CURRENT"))
+        ctl.mark_current(res1.checkpoint_path)  # swap committed
+        good = RefitController.load_checkpoint(d)
+        rec = {k: v for k, v in make_records(1, 24)[0].items()
+               if k != "label"}
+        expect = res1.model.serving_plan().score([rec])
+        assert good.serving_plan().score([rec]) == expect
+
+        # a second refit whose candidate is REJECTED (never marked) leaves
+        # CURRENT on the promoted version
+        res2 = ctl.refit(train_ds)
+        assert res2.checkpoint_path.endswith("model-0002")
+        with open(os.path.join(d, "CURRENT")) as fh:
+            assert fh.read().strip() == "model-0001"
+
+        # a crashed version save (fault) also leaves CURRENT untouched
+        harness = FaultHarness(seed=0)
+        harness.fail_when("checkpoint", lambda ctx: True,
+                          lambda: OSError("disk gone"))
+        ctl2 = RefitController(model, checkpoint_dir=d, max_retries=0,
+                               sleep=lambda s: None)
+        with harness:
+            with pytest.raises(RefitError):
+                ctl2.refit(train_ds)
+        with open(os.path.join(d, "CURRENT")) as fh:
+            assert fh.read().strip() == "model-0001"
+        assert RefitController.load_checkpoint(d) is not None
+
+    def test_scripted_checkpoint_fault_retries(self, base, tmp_path):
+        model, train, raws, train_ds, snap = base
+        d = str(tmp_path / "ckpt2")
+        ctl = RefitController(model, checkpoint_dir=d, sleep=lambda s: None)
+        harness = FaultHarness(seed=0)
+        harness.script("checkpoint", [OSError("transient disk")])
+        with harness:
+            res = ctl.refit(train_ds)
+        assert res.attempts == 2
+        assert os.path.isdir(res.checkpoint_path)  # retried save landed
+
+
+# ---------------------------------------------------------------------------
+# Shadow scoring + atomic swap
+# ---------------------------------------------------------------------------
+
+class TestPredictionDelta:
+    def test_nested_prediction_dicts_compare_shared_keys(self):
+        a = {"p": {"prediction": 1.0, "probability_1": 0.8}, "label": 1.0}
+        b = {"p": {"prediction": 0.0, "probability_1": 0.55}}
+        assert prediction_delta(a, b) == 1.0
+
+    def test_nan_delta_is_infinite(self):
+        assert math.isinf(prediction_delta({"v": float("nan")}, {"v": 1.0}))
+
+    def test_nothing_comparable_is_none(self):
+        assert prediction_delta({"v": "text"}, {"v": "other"}) is None
+        assert prediction_delta({"v": True}, {"v": False}) is None
+
+
+class TestSwapAndShadow:
+    def _server(self, model, **kw):
+        kw.setdefault("max_batch", 32)
+        kw.setdefault("max_wait_ms", 1.0)
+        kw.setdefault("max_queue", 4096)
+        return ScoringServer(model, **kw)
+
+    def _candidate(self, base):
+        model, train, raws, train_ds, snap = base
+        ctl = RefitController(model)
+        ctl.prime(train_ds)
+        return ctl.refit(rows_to_dataset(
+            make_records(N_TRAIN, 31, shift=2.0), raws)).model
+
+    def test_schema_changing_candidate_refused_tm507(self, base):
+        import pandas as pd
+
+        model, train, *_ = base
+        label = FeatureBuilder.RealNN("label").extract_field().as_response()
+        other = FeatureBuilder.Real("num0").extract_field().as_predictor()
+        vec = transmogrify([other])
+        sel = BinaryClassificationModelSelector.with_train_validation_split(
+            models=[(LogisticRegression(), [{"reg_param": 0.1}])])
+        pred2 = label.transform_with(sel, label.sanity_check(vec))
+        other_model = (Workflow().set_result_features(label, pred2)
+                       .set_reader(DataReaders.Simple.dataframe(
+                           pd.DataFrame(train)))).train()
+        with self._server(model) as server:
+            with measure_compiles() as probe:
+                with pytest.raises(OpCheckError) as ei:
+                    server.stage_candidate(other_model)
+            assert any(d.code == "TM507" for d in ei.value.report)
+            assert not server.has_candidate()
+            # refused BEFORE any bucket executable compiled for it
+            assert probe.backend_compiles == 0
+
+    def test_shadow_mirrors_and_promotes_shared_prefix(self, base):
+        """Mirrored traffic accumulates delta stats without touching primary
+        futures; a frozen-prep candidate swaps with SHARED prefix
+        executables (equal plan fingerprints) at zero new compiles."""
+        model, train, raws, train_ds, snap = base
+        cand = self._candidate(base)
+        records = [{k: v for k, v in r.items() if k != "label"}
+                   for r in make_records(96, 32)]
+        with self._server(model) as server:
+            before_fp = server.plan.fingerprint
+            with measure_compiles() as probe:
+                server.stage_candidate(cand)
+            assert probe.backend_compiles == 0  # shared executable cache
+            baseline = [f.result(5) for f in
+                        [server.submit(r) for r in records]]
+            rep = server.shadow_report()
+            assert rep["mirrored_records"] == len(records)
+            assert rep["shadow_failures"] == 0
+            assert rep["compared_records"] == len(records)
+            assert math.isfinite(rep["max_abs_delta"])
+            swap = server.promote(probation_batches=2)
+            assert swap["shared_prefix"] is True
+            assert swap["from"] == before_fp == swap["to"]
+            m = server.swap_metrics()
+            assert m["swaps"] == 1 and m["active_version"] == 2
+            # post-swap scoring serves the CANDIDATE model's host remainder
+            after = [f.result(5) for f in
+                     [server.submit(r) for r in records[:8]]]
+            expect = cand.serving_plan(strict=False).score(records[:8])
+            assert json.loads(json.dumps(after)) == \
+                json.loads(json.dumps(expect))
+            bm = server.metrics()["batcher"]
+            assert bm["failed"] == 0 and bm["cancelled"] == 0
+            assert bm["completed"] == bm["submitted"] == len(baseline) + 8
+
+    def test_injected_swap_fault_leaves_active_serving(self, base):
+        model, *_ = base
+        cand = self._candidate(base)
+        harness = FaultHarness(seed=0)
+        harness.script("swap", [TransientScoringError("swap blip")])
+        with self._server(model) as server:
+            server.stage_candidate(cand)
+            with harness:
+                with pytest.raises(TransientScoringError):
+                    server.promote()
+                assert server.swap_metrics()["active_version"] == 1
+                assert server.has_candidate()  # still staged, retryable
+                swap = server.promote()  # schedule consumed: succeeds
+            assert swap["to_version"] == 2
+            assert server.swap_metrics()["swaps"] == 1
+
+    def test_manual_rollback_restores_previous(self, base):
+        model, *_ = base
+        cand = self._candidate(base)
+        with self._server(model) as server:
+            server.stage_candidate(cand)
+            server.promote(probation_batches=0)
+            assert server.swap_metrics()["active_version"] == 2
+            rec = server.rollback()
+            assert rec["to_version"] == 1
+            m = server.swap_metrics()
+            assert m["active_version"] == 1 and m["rollbacks"] == 1
+
+    def test_post_swap_breaker_trip_auto_rolls_back(self, base):
+        """Acceptance: device faults after the swap open the promoted
+        entry's breaker inside the probation window; the server rolls back
+        to the retained last-known-good automatically, and every request
+        still gets a result (host fallback, then the restored model)."""
+        model, train, raws, train_ds, snap = base
+        cand = self._candidate(base)
+        records = [{k: v for k, v in r.items() if k != "label"}
+                   for r in make_records(8, 33)]
+        harness = FaultHarness(seed=0)
+        with self._server(model, resilience={"max_retries": 0,
+                                             "failure_threshold": 2,
+                                             "recovery_batches": 8}) as srv:
+            srv.stage_candidate(cand)
+            srv.promote(probation_batches=6)
+            assert srv.in_probation()
+            harness.script("device", [TransientScoringError("dead"),
+                                      TransientScoringError("dead")])
+            with harness:
+                out = []
+                for r in records[:3]:  # one batch each (sequential submits)
+                    out.append(srv.score(r, timeout=5))
+            assert all("error" not in o for o in out)  # host path served
+            m = srv.swap_metrics()
+            assert m["rollbacks"] == 1
+            assert m["active_version"] == 1  # last-known-good restored
+            assert not srv.in_probation()
+            hist = [h["event"] for h in m["history"]]
+            assert hist == ["swap", "rollback"]
+            # the restored model serves cleanly on its own breaker
+            clean = srv.score(records[3], timeout=5)
+            expect = model.serving_plan(strict=False).score([records[3]])[0]
+            assert json.loads(json.dumps(clean)) == \
+                json.loads(json.dumps(expect))
+
+
+# ---------------------------------------------------------------------------
+# The end-to-end control loop
+# ---------------------------------------------------------------------------
+
+class TestContinualE2E:
+    def _run(self, base, records, harness=None, **kw):
+        model, train, raws, train_ds, snap = base
+        server = ScoringServer(model, max_batch=64, max_wait_ms=1.0,
+                               max_queue=8192)
+        refit = RefitController(model, sleep=lambda s: None,
+                                **kw.pop("refit_kw", {}))
+        trainer = ContinualTrainer(
+            server, model, stream_reader(records), snapshot=snap,
+            refit=refit, gate=PromotionGate(min_shadow_records=64),
+            window_records=N_TRAIN, drift_params={"min_records": 128},
+            probation_batches=2, **kw)
+        try:
+            if harness is not None:
+                with harness:
+                    metrics = trainer.run()
+            else:
+                metrics = trainer.run()
+            server_metrics = server.metrics()
+        finally:
+            server.close()
+        return trainer, metrics, server_metrics, server
+
+    def test_drift_refit_shadow_swap_end_to_end(self, base):
+        """The acceptance path: injected covariate drift -> detector fires
+        -> zero-compile warm refit -> shadow parity gate -> atomic swap —
+        with no dropped or double-scored in-flight requests."""
+        model, train, raws, train_ds, snap = base
+        records = make_records(512, 41, shift=3.0)
+        trainer, metrics, sm, server = self._run(base, records)
+        assert metrics["drift_events"] >= 1
+        assert metrics["refits"] == 1
+        assert metrics["promotions"] == 1
+        assert metrics["gate_rejections"] == 0
+        assert metrics["record_errors"] == 0
+        # zero new backend compiles on the transform prefix (and the sweep)
+        assert metrics["last_refit"]["backend_compiles"] == 0
+        assert metrics["last_refit"]["prefix_reused"] is True
+        # the swap shared the prefix executables and is now active
+        swap = metrics["swap"]
+        assert swap["swaps"] == 1 and swap["rollbacks"] == 0
+        assert swap["active_version"] == 2
+        assert swap["history"][0]["shared_prefix"] is True
+        # no request dropped or double-scored through the whole stream
+        bm = sm["batcher"]
+        assert bm["submitted"] == len(records) == metrics["records"]
+        assert bm["completed"] == bm["submitted"]
+        assert bm["failed"] == 0 and bm["cancelled"] == 0
+        assert bm["deadline_expired"] == 0
+        codes = [d.code for d in trainer.diagnostics]
+        assert "TM801" in codes and "TM807" in codes
+        assert "TM806" not in codes and "TM809" not in codes
+
+    def test_injected_refit_faults_keep_last_known_good(self, base):
+        """Acceptance: with every refit attempt failing, the server keeps
+        serving the last-known-good model and the stream completes."""
+        model, *_ = base
+        records = make_records(512, 42, shift=3.0)
+        harness = FaultHarness(seed=0)
+        harness.fail_when("refit", lambda ctx: True,
+                          lambda: TransientScoringError("refit down"))
+        trainer, metrics, sm, server = self._run(
+            base, records, harness=harness, refit_kw={"max_retries": 1})
+        assert metrics["refit_failures"] >= 1
+        assert metrics["promotions"] == 0
+        assert sm["swap"]["swaps"] == 0
+        assert sm["swap"]["active_version"] == 1  # never swapped
+        bm = sm["batcher"]
+        assert bm["completed"] == bm["submitted"] == len(records)
+        assert any(d.code == "TM805" for d in trainer.diagnostics)
+
+    def test_bootstrap_mode_with_staged_candidate_does_not_crash(self, base):
+        """Embedded use: a candidate staged through the public server API
+        while the trainer is still bootstrapping its baseline (detector
+        None) must not crash the loop on gate refusal/promotion paths."""
+        model, train, raws, train_ds, snap = base
+        cand = RefitController(model).refit(train_ds).model
+        records = make_records(192, 47)
+        server = ScoringServer(model, max_batch=64, max_wait_ms=1.0,
+                               max_queue=8192)
+        trainer = ContinualTrainer(
+            server, model, stream_reader(records, batch=64),
+            snapshot=None, bootstrap_records=10_000,  # never bootstraps
+            gate=PromotionGate(min_shadow_records=64),
+            probation_batches=2)
+        try:
+            server.stage_candidate(cand)
+            metrics = trainer.run()  # must complete, not AttributeError
+            assert metrics["records"] == len(records)
+            # the staged candidate reached the gate and promoted cleanly
+            assert server.swap_metrics()["swaps"] == 1
+        finally:
+            server.close()
+
+    def test_injected_swap_fault_retries_then_promotes(self, base):
+        model, *_ = base
+        records = make_records(640, 43, shift=3.0)
+        harness = FaultHarness(seed=0)
+        harness.script("swap", [TransientScoringError("swap outage")])
+        trainer, metrics, sm, server = self._run(base, records,
+                                                 harness=harness)
+        assert metrics["swap_failures"] == 1
+        assert metrics["promotions"] == 1  # retried on the next batch
+        assert sm["swap"]["active_version"] == 2
+
+    def test_shadow_failures_refuse_promotion(self, base):
+        """A candidate whose shadow scoring fails never swaps (TM806)."""
+        model, *_ = base
+        records = make_records(512, 44, shift=3.0)
+        harness = FaultHarness(seed=0)
+        harness.fail_when("shadow", lambda ctx: True,
+                          lambda: TransientScoringError("shadow down"))
+        trainer, metrics, sm, server = self._run(base, records,
+                                                 harness=harness)
+        assert metrics["refits"] >= 1
+        assert metrics["promotions"] == 0
+        assert metrics["gate_rejections"] >= 1
+        assert sm["swap"]["active_version"] == 1
+        assert any(d.code == "TM806" for d in trainer.diagnostics)
+
+    def test_post_swap_trip_rolls_back_through_the_loop(self, base, tmp_path):
+        """After the loop promotes, device faults inside the still-open
+        probation window trip the breaker and restore the last-known-good
+        model — and the trainer's rollback observer re-syncs its generation
+        state: TM808 recorded, base model restored, CURRENT pointer
+        reverted (cleared here: the pre-swap model was never checkpointed)."""
+        model, train, raws, train_ds, snap = base
+        records = make_records(512, 45, shift=3.0)
+        ckpt_dir = str(tmp_path / "cks")
+        server = ScoringServer(model, max_batch=64, max_wait_ms=1.0,
+                               max_queue=8192,
+                               resilience={"max_retries": 0,
+                                           "failure_threshold": 2,
+                                           "recovery_batches": 8})
+        trainer = ContinualTrainer(
+            server, model, stream_reader(records), snapshot=snap,
+            refit=RefitController(model, sleep=lambda s: None,
+                                  checkpoint_dir=ckpt_dir),
+            gate=PromotionGate(min_shadow_records=64),
+            window_records=N_TRAIN, drift_params={"min_records": 128},
+            probation_batches=16)  # outlives the stream
+        try:
+            metrics = trainer.run()
+            assert metrics["promotions"] == 1
+            assert server.in_probation()
+            # the promoted candidate's checkpoint became CURRENT
+            with open(os.path.join(ckpt_dir, "CURRENT")) as fh:
+                assert fh.read().strip() == "model-0001"
+            promoted = trainer._model
+            assert promoted is not model
+            harness = FaultHarness(seed=0)
+            harness.script("device", [TransientScoringError("dead"),
+                                      TransientScoringError("dead")])
+            probe = [{k: v for k, v in r.items() if k != "label"}
+                     for r in make_records(4, 46, shift=3.0)]
+            with harness:
+                for r in probe[:3]:
+                    server.score(r, timeout=5)  # host fallback, then trip
+            m = server.swap_metrics()
+            assert m["rollbacks"] == 1 and m["active_version"] == 1
+            # the trainer observes the rollback on its next tick
+            trainer._tick()
+            assert any(d.code == "TM808" for d in trainer.diagnostics)
+            assert trainer._model is model  # generation state restored
+            # CURRENT no longer names the rolled-back candidate: the
+            # pre-swap model was never checkpointed, so the pointer clears
+            assert not os.path.exists(os.path.join(ckpt_dir, "CURRENT"))
+            assert os.path.isdir(os.path.join(ckpt_dir, "model-0001"))
+        finally:
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# cli serve --follow
+# ---------------------------------------------------------------------------
+
+class TestCliFollow:
+    def test_follow_refit_end_to_end(self, base, tmp_path):
+        """`cli serve --follow --refit` drives MicroBatchStreamingReader end
+        to end: tailed JSONL in, scored JSONL out, offsets committed, drift
+        -> refit -> promotion recorded, checkpoint CURRENT written."""
+        model, train, raws, train_ds, snap = base
+        model_dir = str(tmp_path / "model")
+        model.save(model_dir)
+        baseline = str(tmp_path / "baseline.json")
+        snap.save(baseline)
+        # two drift segments (+3 then -3): the post-promotion rebase must
+        # re-arm the detector AND the rebased RefitController must keep its
+        # checkpoint_dir across generations
+        records = make_records(512, 51, shift=3.0) \
+            + make_records(512, 53, shift=-3.0)
+        stream = tmp_path / "stream.jsonl"
+        stream.write_text("".join(json.dumps(r) + "\n" for r in records))
+        out_file = tmp_path / "scores.jsonl"
+        metrics_file = tmp_path / "metrics.json"
+        offsets = str(tmp_path / "offsets.json")
+        ckpt_dir = str(tmp_path / "ckpts")
+
+        from transmogrifai_tpu.cli.gen import main
+
+        rc = main(["serve", "--model", model_dir,
+                   "--records", str(stream),
+                   "--output", str(out_file),
+                   "--metrics-out", str(metrics_file),
+                   "--follow", "--refit",
+                   "--offsets", offsets,
+                   "--baseline", baseline,
+                   "--batch-interval", "0",
+                   "--max-empty-polls", "1",
+                   "--max-batch-records", "128",
+                   "--drift-min-records", "128",
+                   "--window-records", str(N_TRAIN),
+                   "--shadow-records", "64",
+                   "--probation-batches", "2",
+                   "--checkpoint-dir", ckpt_dir,
+                   "--max-wait-ms", "1"])
+        assert rc == 0
+        rows = [json.loads(line) for line in
+                out_file.read_text().splitlines()]
+        assert len(rows) == len(records)
+        assert not any("error" in r for r in rows)
+        metrics = json.loads(metrics_file.read_text())
+        assert metrics["refits"] >= 2
+        assert metrics["promotions"] >= 2
+        assert metrics["last_refit"]["backend_compiles"] == 0
+        assert metrics["server"]["swap"]["swaps"] >= 2
+        # offsets committed through the end of the stream
+        committed = json.load(open(offsets))
+        assert committed["jsonl:stream.jsonl"] == stream.stat().st_size
+        # EVERY generation saved a version (the rebased controller kept its
+        # checkpoint_dir across promotions); CURRENT names a PROMOTED one
+        with open(os.path.join(ckpt_dir, "CURRENT")) as fh:
+            current = fh.read().strip()
+        assert current.startswith("model-")
+        assert int(current.split("-")[1]) <= metrics["refits"]
+        assert os.path.isdir(os.path.join(ckpt_dir, "model-0001"))
+        RefitController.load_checkpoint(ckpt_dir)
+
+    def test_follow_without_refit_streams_and_commits(self, base, tmp_path):
+        model, *_ = base
+        model_dir = str(tmp_path / "m2")
+        model.save(model_dir)
+        records = make_records(64, 52)
+        stream = tmp_path / "s2.jsonl"
+        stream.write_text("".join(json.dumps(r) + "\n" for r in records))
+        out_file = tmp_path / "o2.jsonl"
+        offsets = str(tmp_path / "off2.json")
+
+        from transmogrifai_tpu.cli.gen import main
+
+        args = ["serve", "--model", model_dir, "--records", str(stream),
+                "--output", str(out_file), "--metrics-out",
+                str(tmp_path / "m2.json"), "--follow",
+                "--offsets", offsets, "--batch-interval", "0",
+                "--max-empty-polls", "1", "--max-wait-ms", "1"]
+        rc = main(args)
+        assert rc == 0
+        assert len(out_file.read_text().splitlines()) == len(records)
+        assert json.load(open(offsets))["jsonl:s2.jsonl"] \
+            == stream.stat().st_size
+        # resume regression: a second run with committed offsets scores
+        # nothing new and must NOT truncate the already-written output
+        rc2 = main(args)
+        assert rc2 == 0
+        assert len(out_file.read_text().splitlines()) == len(records)

@@ -516,3 +516,45 @@ class TestStaticGate:
         r = _run([sys.executable, "tools/static_gate.py", "--skip-ir"])
         assert r.returncode != 0
         assert "refusing" in r.stderr + r.stdout
+
+
+class TestShadowPrefixFamily:
+    def test_swap_candidate_dedups_to_scoring_prefix_golden(self):
+        """ISSUE 9 satellite: the blue/green swap path's shadow-scoring
+        prefix needs no separate golden family — a candidate built through
+        the server's swap machinery for the corpus fixture model lowers to
+        the EXACT canonical IR (and content fingerprint) already pinned as
+        ``serve.plan.scoring_prefix``, so ``tools/ir_gate.py`` keeps the
+        swap path covered for free."""
+        from transmogrifai_tpu.checkers.irsnap import (
+            _plan_fixture_runners,
+            _Shim,
+            default_goldens_dir,
+            load_corpus,
+            snapshot_scoring_plan,
+        )
+        from transmogrifai_tpu.serve import ScoringServer
+
+        goldens, _index = load_corpus(default_goldens_dir())
+        golden = goldens["serve.plan.scoring_prefix"]
+
+        features, _runners = _plan_fixture_runners()
+        shim = _Shim(features, {})
+        with measure_compiles() as probe:
+            with ScoringServer(shim, max_batch=64, min_bucket=8,
+                               warm=False) as server:
+                server.stage_candidate(shim, warm=False)
+                active_fp = server.plan.fingerprint
+                # reach the staged candidate's plan through the swapper
+                server.promote(probation_batches=0)
+                candidate_plan = server.plan
+        # the swap shared the active plan's fingerprint (frozen prefix)...
+        assert candidate_plan.fingerprint == active_fp
+        snap = snapshot_scoring_plan(candidate_plan, bucket=64)
+        # ...and the lowered program is bit-identical to the checked-in
+        # golden: same canonical StableHLO text, same IR fingerprint (the
+        # content fingerprint bakes in per-process stage uids, so identity
+        # is asserted at the IR level — exactly what ir_gate diffs)
+        assert snap.ir_fingerprint == golden.ir_fingerprint
+        assert snap.text == golden.text
+        assert probe.backend_compiles == 0  # lower-only, zero compiles

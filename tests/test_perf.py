@@ -364,6 +364,19 @@ class TestBenchSmoke:
         assert sv["degraded_backend_compiles"] == 0, sv
         assert sv["degraded_host_rps"] > 0 and sv["throughput_rps"] > 0
         assert sv["degraded_fallback_records"] == sv["records"], sv
+        # continual control plane (ISSUE 9): the stream section pushes
+        # records through drift-check + shadow-score, and the frozen-prep
+        # warm refit must recompile NOTHING (plan cache + sweep executable
+        # cache) while the swap shares the prefix executables
+        assert secs["stream"]["status"] == "ok", secs["stream"]
+        st = parsed["stream"]
+        assert st["warm_refit_backend_compiles"] == 0, st
+        assert st["zero_refit_compile_gate"] is True
+        assert st["prefix_reused"] is True
+        assert st["swap_shared_prefix"] is True
+        assert st["records_per_sec"] > 0
+        assert st["shadow_mirrored"] == st["records"], st
+        assert st["shadow_failures"] == 0, st
         # static cost model (ISSUE 6): predicted FLOPs/bytes recorded beside
         # the measured transform/sweep numbers, calibration within the band
         assert tr["predicted_flops"] > 0, tr

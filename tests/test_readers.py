@@ -450,6 +450,205 @@ class TestMicroBatchStreaming:
         with pytest.raises(ValueError, match="malformed JSONL"):
             src.poll(10)
 
+    def test_jsonl_rotation_to_larger_file_resets(self, tmp_path):
+        """Satellite regression: a rotated file that happens to be LONGER
+        than the committed offset must restart from its head — the size
+        heuristic alone would resume mid-file and silently skip records."""
+        import json
+
+        from transmogrifai_tpu.readers import JsonlTailSource
+
+        p = str(tmp_path / "rot2.jsonl")
+        with open(p, "w") as fh:
+            for i in range(3):
+                fh.write(json.dumps({"v": i}) + "\n")
+        src = JsonlTailSource(p)
+        recs, off = src.poll(100)
+        assert [r["v"] for r in recs] == [0, 1, 2]
+
+        # rotate: replace with a DIFFERENT, LONGER file (new inode and head)
+        tmp = str(tmp_path / "rot2.jsonl.new")
+        with open(tmp, "w") as fh:
+            for i in range(100, 120):
+                fh.write(json.dumps({"v": i}) + "\n")
+        import os
+
+        os.replace(tmp, p)
+        assert os.path.getsize(p) > off  # the case the size check misses
+        recs2, _ = src.poll(100)
+        assert [r["v"] for r in recs2][:3] == [100, 101, 102], \
+            "rotated-to-larger file must be read from its head"
+        assert len(recs2) == 20
+
+    def test_jsonl_copytruncate_rotation_same_inode(self, tmp_path):
+        """In-place rewrite (copytruncate rotation) keeps the inode; the
+        head-prefix heuristic must still catch it when the new file is
+        longer than the committed offset."""
+        import json
+
+        from transmogrifai_tpu.readers import JsonlTailSource
+
+        p = str(tmp_path / "rot3.jsonl")
+        with open(p, "w") as fh:
+            fh.write(json.dumps({"v": 1}) + "\n")
+        src = JsonlTailSource(p)
+        recs, off = src.poll(100)
+        assert [r["v"] for r in recs] == [1]
+        # rewrite in place (same path, same inode on most filesystems),
+        # longer than the committed offset, different head bytes
+        with open(p, "r+") as fh:
+            for i in range(200, 210):
+                fh.write(json.dumps({"value": i, "pad": "x" * 10}) + "\n")
+        recs2, _ = src.poll(100)
+        assert recs2 and recs2[0] == {"value": 200, "pad": "x" * 10}
+        assert len(recs2) == 10
+
+    def test_rotation_while_process_down_detected_via_checkpoint(
+            self, tmp_path):
+        """The rotation pins (inode + consumed head) persist BESIDE the
+        committed offset: a file rotated to a LONGER one while the process
+        was down is detected by the fresh reader and read from its head —
+        without the persisted pins it would resume mid-file in the new
+        file and silently skip its head records."""
+        import json
+        import os
+
+        from transmogrifai_tpu.readers import (JsonlTailSource,
+                                               MicroBatchStreamingReader,
+                                               OffsetCheckpoint)
+
+        p = str(tmp_path / "live.jsonl")
+        with open(p, "w") as fh:
+            for i in range(3):
+                fh.write(json.dumps({"v": float(i)}) + "\n")
+        cpath = str(tmp_path / "off.json")
+        raws = self._raws()
+
+        def fresh_reader():
+            return MicroBatchStreamingReader(
+                JsonlTailSource(p, source_id="live"),
+                checkpoint=OffsetCheckpoint(cpath), batch_interval=0.0,
+                max_batch_records=100, max_empty_polls=1)
+
+        r1 = fresh_reader()
+        it = r1.stream_datasets(raws)
+        assert np.asarray(next(it)["v"].data).tolist() == [0.0, 1.0, 2.0]
+        r1.commit()
+        del it, r1  # process exits; offset + rotation pins are durable
+
+        # while down: logrotate swaps in a NEW, LONGER file
+        tmp = p + ".new"
+        with open(tmp, "w") as fh:
+            for i in range(100, 130):
+                fh.write(json.dumps({"v": float(i)}) + "\n")
+        os.replace(tmp, p)
+        committed = OffsetCheckpoint(cpath).load("live")
+        assert os.path.getsize(p) > committed  # size check alone is blind
+
+        r2 = fresh_reader()
+        got = []
+        for ds in r2.stream_datasets(raws):
+            got.extend(np.asarray(ds["v"].data).tolist())
+            r2.commit()
+        assert got[:3] == [100.0, 101.0, 102.0], \
+            "rotated-while-down file must be read from its head"
+        assert len(got) == 30
+
+    def test_skip_malformed_mode_advances_past_poison_line(self, tmp_path):
+        """Follow-mode regression: with skip_malformed=True a poison line
+        sitting exactly at the committed offset is skipped-and-counted
+        instead of raising forever at the same byte; default stays loud."""
+        import json
+
+        from transmogrifai_tpu.readers import JsonlTailSource
+
+        p = str(tmp_path / "poison.jsonl")
+        with open(p, "w") as fh:
+            fh.write(json.dumps({"v": 1}) + "\n")
+            fh.write("{not json}\n")
+            fh.write(json.dumps({"v": 2}) + "\n")
+        src = JsonlTailSource(p, skip_malformed=True)
+        recs, off = src.poll(10)
+        assert [r["v"] for r in recs] == [1]  # good prefix first
+        src.seek(off)
+        recs2, _ = src.poll(10)  # poison skipped, stream continues
+        assert [r["v"] for r in recs2] == [2]
+        assert src.skipped_malformed == 1
+        # the loud default still raises at the same spot
+        strict = JsonlTailSource(p)
+        strict.seek(off)
+        with pytest.raises(ValueError, match="malformed JSONL"):
+            strict.poll(10)
+
+    def test_offset_checkpoint_cleans_stale_tmp(self, tmp_path):
+        """Satellite: a crash between writing the tmp file and the atomic
+        rename leaves a stale .tmp that must not survive (or be mistaken
+        for the store) on the next load; the committed store still reads."""
+        from transmogrifai_tpu.readers import OffsetCheckpoint
+
+        path = str(tmp_path / "off.json")
+        ckpt = OffsetCheckpoint(path)
+        ckpt.commit("s", 42)
+        # simulated crash mid-commit: tmp written, rename never happened
+        with open(path + ".tmp", "w") as fh:
+            fh.write("{torn")
+        assert ckpt.load("s") == 42
+        import os
+
+        assert not os.path.exists(path + ".tmp")
+
+    def test_crash_replay_with_file_source_and_checkpoint(self, tmp_path):
+        """Satellite: at-least-once over the DURABLE pair (JsonlTailSource +
+        OffsetCheckpoint) across simulated process restarts — an uncommitted
+        batch is re-polled by a fresh reader, a committed one is not, and
+        the backpressure target recovers after the slow batches."""
+        import json
+
+        from transmogrifai_tpu.readers import (JsonlTailSource,
+                                               MicroBatchStreamingReader,
+                                               OffsetCheckpoint)
+
+        p = str(tmp_path / "events.jsonl")
+        with open(p, "w") as fh:
+            for i in range(9):
+                fh.write(json.dumps({"v": float(i)}) + "\n")
+        cpath = str(tmp_path / "off.json")
+
+        def fresh_reader(**kw):
+            t = [0.0]
+            return MicroBatchStreamingReader(
+                JsonlTailSource(p, source_id="ev"),
+                checkpoint=OffsetCheckpoint(cpath), batch_interval=1.0,
+                max_batch_records=3, min_batch_records=1,
+                max_empty_polls=1, clock=lambda: t[0],
+                sleep=lambda s: t.__setitem__(0, t[0] + s), **kw), t
+
+        raws = self._raws()
+        # "process 1": consume one batch, commit, consume another, CRASH
+        # before committing it
+        r1, _ = fresh_reader()
+        it = r1.stream_datasets(raws)
+        b1 = np.asarray(next(it)["v"].data).tolist()
+        r1.commit()
+        b2 = np.asarray(next(it)["v"].data).tolist()
+        assert (b1, b2) == ([0.0, 1.0, 2.0], [3.0, 4.0, 5.0])
+        del it, r1  # crash: batch 2 never committed
+
+        # "process 2": batch 2 replays (at-least-once), batch 1 does not
+        r2, t2 = fresh_reader()
+        seen = []
+        slow = [True, True, False, False]
+        targets = []
+        for i, ds in enumerate(r2.stream_datasets(raws)):
+            if i < len(slow) and slow[i]:
+                t2[0] += 4.0  # slow consumer: shrink the target
+            seen.extend(np.asarray(ds["v"].data).tolist())
+            targets.append(r2.progress["target_records"])
+            r2.commit()
+        assert seen == [3.0, 4.0, 5.0, 6.0, 7.0, 8.0]
+        # backpressure target shrank under the slow batches then recovered
+        assert min(targets) < 3 and targets[-1] > min(targets)
+
     def test_dataframe_batch_without_label_scores(self, tmp_path):
         """Columnar (DataFrame) micro-batches may omit the response column at
         scoring time, same as record-iterator batches; a PRESENT-but-malformed

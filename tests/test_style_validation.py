@@ -85,21 +85,24 @@ class TestStyleValidation:
             + "\n".join(findings))
 
     def test_serve_perf_full_function_lint(self):
-        """serve/, perf/, checkers/, cli/, and workflow/ hold hot paths NOT
-        named transform_columns/fit_columns/device_transform, so the default
-        gate above never saw them.  Lint EVERY function there
+        """serve/, perf/, checkers/, cli/, workflow/, and readers/ hold hot
+        paths NOT named transform_columns/fit_columns/device_transform, so
+        the default gate above never saw them.  Lint EVERY function there
         (``only_names=None``) plus the TM306 concurrency rule: module-level
         mutable caches (the executable caches, the plan cache, the analyzer
         memo, the source-fingerprint memo) must only be mutated under their
         locks, and jit construction in those layers must be memoized
-        (marked inline where it is — workflow/plan.py, checkers/irsnap.py)."""
+        (marked inline where it is — workflow/plan.py, checkers/irsnap.py).
+        readers/ joined the gate with the continual-training control plane:
+        its offset caches and the serve-side swap state are exactly the
+        shared-mutable-state shape TM306 exists to police."""
         from transmogrifai_tpu.checkers.opcheck import (
             lint_file,
             lint_file_concurrency,
         )
 
         findings = []
-        for sub in ("serve", "perf", "checkers", "cli", "workflow"):
+        for sub in ("serve", "perf", "checkers", "cli", "workflow", "readers"):
             d = os.path.join(PKG_ROOT, sub)
             for f in sorted(os.listdir(d)):
                 if not f.endswith(".py"):

@@ -25,6 +25,10 @@ Code families:
   (checkers/irsnap.py): classified IR drift of every emitted program
   family (benign text / fusion-layout / collectives / dtype widening /
   the GSPMD sharded-sort miscompile class) across jax upgrades
+- ``TM8xx`` continual    — the streaming retrain control plane
+  (workflow/continual.py): covariate drift against the train-time
+  snapshot (PSI / mean shift / missing rate), refit failures, shadow
+  promotion-gate refusals, swap commits, and post-swap rollbacks
 """
 
 from __future__ import annotations
@@ -139,6 +143,17 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "batcher's max_wait_ms, so every request that waits for a "
               "full flush window expires in the queue and is evicted "
               "unscored; raise the deadline or lower max_wait_ms"),
+    "TM507": (Severity.ERROR, "candidate model incompatible with serving schema",
+              "the staged candidate does not serve the same result feature "
+              "names as the active model; a swap would silently change the "
+              "response schema under live clients — refit the same workflow "
+              "(same result features) or deploy as a new server instead"),
+    "TM508": (Severity.INFO, "blue/green swap compiles a fresh prefix",
+              "the candidate's fused-prefix fingerprint differs from the "
+              "active plan's, so the swap cannot reuse the cached "
+              "executables (a warm refit that froze the prep stages would); "
+              "the swap is still atomic, but the candidate pays XLA "
+              "compilation at stage time instead of sharing the cache"),
     # -- plan cost (jaxpr-level static analysis, checkers/plancheck.py) -----
     "TM601": (Severity.ERROR, "plan exceeds the HBM budget",
               "the fused program's peak live-buffer estimate at its largest "
@@ -207,6 +222,57 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "before PR 4 pinned metric inputs to replicated; replicate "
               "the sort operand (models/base.py:_replicator) or shard a "
               "batch dimension instead"),
+    # -- continual training (drift-gated warm refit, workflow/continual.py) --
+    "TM801": (Severity.WARNING, "covariate drift: PSI beyond threshold",
+              "the streamed distribution of this feature diverged from its "
+              "train-time snapshot (population stability index over the "
+              "snapshot's quantile bins); the serving model was fitted on a "
+              "population that no longer matches live traffic — let the "
+              "refit controller retrain, or raise psi_threshold if this "
+              "feature is expected to wander"),
+    "TM802": (Severity.WARNING, "feature mean shift beyond z threshold",
+              "the streamed mean of this feature sits more than z_threshold "
+              "standard errors from its train-time mean (two-sample z over "
+              "the snapshot moments); investigate an upstream pipeline "
+              "change, or let the refit controller retrain"),
+    "TM803": (Severity.WARNING, "missing-rate shift beyond threshold",
+              "the fraction of missing values in this feature moved beyond "
+              "missing_shift from its train-time rate — often an upstream "
+              "extraction outage rather than real drift; check the producer "
+              "before trusting a refit on the degraded window"),
+    "TM804": (Severity.INFO, "insufficient streamed rows for drift evaluation",
+              "fewer than min_records rows observed since the last refit "
+              "anchor; drift statistics at this sample size would fire on "
+              "noise, so the evaluation is deferred — stream more data or "
+              "lower min_records"),
+    "TM805": (Severity.ERROR, "warm refit failed; serving model unchanged",
+              "every bounded retry of the drift-triggered refit failed; the "
+              "server keeps the last-known-good model and the stream keeps "
+              "scoring — inspect the attached cause, then retrigger by "
+              "streaming more drifted data or refitting manually"),
+    "TM806": (Severity.WARNING, "shadow gate failed; candidate not promoted",
+              "the candidate model's mirrored-traffic scores violated the "
+              "promotion gate (shadow failures, non-finite or oversized "
+              "prediction deltas, or a metric regression); the candidate "
+              "was discarded and the active model keeps serving — loosen "
+              "max_prediction_delta only if the delta is the expected "
+              "consequence of real drift"),
+    "TM807": (Severity.INFO, "model swap committed",
+              "informational: the candidate passed the shadow gate and an "
+              "atomic blue/green swap made it the active serving model; "
+              "the previous model is retained for rollback through the "
+              "probation window"),
+    "TM808": (Severity.WARNING, "post-swap rollback to last-known-good",
+              "the promoted model tripped its circuit breaker inside the "
+              "probation window and the server rolled back to the retained "
+              "last-known-good model; treat the candidate as bad — inspect "
+              "its refit window before promoting again"),
+    "TM809": (Severity.WARNING, "warm refit recompiled the transform prefix",
+              "the refit was expected to reuse the cached fused-prefix "
+              "executables (frozen prep stages, matching row bucket) but "
+              "new backend compiles were observed; check that the prep "
+              "stages are really frozen and the refit window pads to an "
+              "already-compiled bucket"),
     # -- leakage ------------------------------------------------------------
     "TM401": (Severity.ERROR, "label leaks into feature path",
               "a response(-derived) feature reaches the model's feature input "

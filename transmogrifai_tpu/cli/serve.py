@@ -60,6 +60,46 @@ def add_serve_parser(sub) -> None:
                    help="disable the fault-tolerance layer (quarantine, "
                         "retry, circuit breaker); one bad record then fails "
                         "its whole co-batch")
+    # -- streaming follow / continual refit mode (workflow/continual.py) ----
+    p.add_argument("--follow", action="store_true",
+                   help="tail --records as a live JSONL stream through the "
+                        "micro-batch streaming reader (offset-checkpointed, "
+                        "at-least-once) instead of a one-shot replay")
+    p.add_argument("--offsets", default=None,
+                   help="offset checkpoint JSON path (follow mode); resume "
+                        "lands exactly after the last committed batch")
+    p.add_argument("--batch-interval", type=float, default=0.5,
+                   help="follow-mode micro-batch tick seconds (default 0.5)")
+    p.add_argument("--max-batch-records", type=int, default=1024,
+                   help="follow-mode per-tick record ceiling (default 1024)")
+    p.add_argument("--max-empty-polls", type=int, default=None,
+                   help="stop after this many consecutive empty ticks "
+                        "(default: tail forever)")
+    p.add_argument("--refit", action="store_true",
+                   help="enable the drift-gated continual retrain loop "
+                        "(labeled stream required): drift fires a warm "
+                        "refit, the candidate shadow-scores mirrored "
+                        "traffic, and promotion is an atomic model swap "
+                        "with post-swap rollback")
+    p.add_argument("--baseline", default=None,
+                   help="train-time TrainingSnapshot JSON for the drift "
+                        "baseline; omitted, the baseline bootstraps from "
+                        "the head of the stream")
+    p.add_argument("--drift-psi", type=float, default=0.25,
+                   help="PSI threshold per feature (default 0.25)")
+    p.add_argument("--drift-min-records", type=int, default=200,
+                   help="rows required before a drift evaluation counts")
+    p.add_argument("--window-records", type=int, default=512,
+                   help="labeled-record window a warm refit trains on")
+    p.add_argument("--shadow-records", type=int, default=64,
+                   help="mirrored records required before the promotion "
+                        "gate evaluates")
+    p.add_argument("--probation-batches", type=int, default=8,
+                   help="post-swap batches during which a breaker trip "
+                        "auto-rolls back")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="atomic model checkpoint directory for promoted "
+                        "refits (CURRENT pointer names last-known-good)")
 
 
 def _read_records(path: str) -> Tuple[List[Dict[str, Any]], int]:
@@ -96,11 +136,95 @@ def _resolve(future) -> Tuple[Dict[str, Any], bool]:
         return {"error": str(e), "error_type": type(e).__name__}, False
 
 
+def _run_follow(ns, model) -> int:
+    """Follow mode: drive the micro-batch streaming reader end-to-end —
+    tail the JSONL file, score every batch through the server, write one
+    JSON row per record, commit offsets AFTER the rows are written, and
+    (with ``--refit``) run the drift-gated continual retrain loop."""
+    from ..readers import (JsonlTailSource, MicroBatchStreamingReader,
+                           OffsetCheckpoint)
+    from ..serve import ScoringServer
+    from ..workflow.continual import (ContinualTrainer, DriftDetector,
+                                      PromotionGate, RefitController,
+                                      TrainingSnapshot)
+
+    if ns.records == "-":
+        raise SystemExit("serve: --follow needs a tailable file, not stdin")
+    # skip_malformed: a poison line at the committed offset must not wedge
+    # the long-running follow loop (mirrors the one-shot replay's
+    # skip-and-count contract)
+    source = JsonlTailSource(ns.records, skip_malformed=True)
+    reader = MicroBatchStreamingReader(
+        source,
+        checkpoint=OffsetCheckpoint(ns.offsets) if ns.offsets else None,
+        batch_interval=ns.batch_interval,
+        max_batch_records=ns.max_batch_records,
+        max_empty_polls=ns.max_empty_polls)
+
+    # APPEND, never truncate: committed offsets mean a resumed follow run
+    # skips already-scored records — truncating would permanently lose
+    # their output rows despite the at-least-once offset contract
+    out = sys.stdout if ns.output == "-" else open(ns.output, "a")
+    errors = 0
+
+    def on_batch(_records, results):
+        nonlocal errors
+        for r in results:
+            if isinstance(r, dict) and "error_type" in r:
+                errors += 1
+            out.write(json.dumps(r, default=str) + "\n")
+        out.flush()
+
+    detector = None
+    if ns.baseline:
+        detector = DriftDetector(TrainingSnapshot.load(ns.baseline),
+                                 psi_threshold=ns.drift_psi,
+                                 min_records=ns.drift_min_records)
+    refit = RefitController(model, checkpoint_dir=ns.checkpoint_dir) \
+        if ns.refit else None
+    try:
+        with ScoringServer(model, max_batch=ns.max_batch,
+                           max_wait_ms=ns.max_wait_ms,
+                           max_queue=ns.max_queue, min_bucket=ns.min_bucket,
+                           warm=not ns.no_warm,
+                           resilience=not ns.no_resilience,
+                           deadline_ms=ns.deadline_ms) as server:
+            trainer = ContinualTrainer(
+                server, model, reader,
+                detector=detector,
+                refit=refit,
+                gate=PromotionGate(min_shadow_records=ns.shadow_records),
+                window_records=ns.window_records,
+                bootstrap_records=max(ns.drift_min_records, 1),
+                probation_batches=ns.probation_batches,
+                drift_params={"psi_threshold": ns.drift_psi,
+                              "min_records": ns.drift_min_records},
+                on_batch=on_batch,
+                # --refit off: the loop still streams, scores, commits, and
+                # tracks drift statistics — it just never retrains
+                refit_enabled=ns.refit)
+            metrics = trainer.run()
+            metrics["server"] = server.metrics()
+            metrics["skipped_malformed"] = source.skipped_malformed
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    blob = json.dumps(metrics, indent=2, default=str)
+    if ns.metrics_out:
+        with open(ns.metrics_out, "w") as fh:
+            fh.write(blob + "\n")
+    else:
+        print(blob, file=sys.stderr)
+    return 0 if errors == 0 else 1
+
+
 def run_serve(ns) -> int:
     from ..serve import ScoringServer
     from ..workflow.workflow import WorkflowModel
 
     model = WorkflowModel.load(ns.model)
+    if ns.follow:
+        return _run_follow(ns, model)
     records, skipped = _read_records(ns.records)
 
     from collections import deque

@@ -26,11 +26,14 @@ Pieces:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
 
 from .base import rows_to_dataset
+
+log = logging.getLogger(__name__)
 
 
 class RecordSource:
@@ -71,25 +74,97 @@ class JsonlTailSource(RecordSource):
     """Tails a JSON-lines file; offset = byte position of the next unread
     line, so a resume lands exactly where the last commit left off even
     mid-file.  A trailing partial line (a writer mid-append) is left for
-    the next poll."""
+    the next poll.
 
-    def __init__(self, path: str, source_id: Optional[str] = None):
+    Rotation is detected two ways, BOTH required because a rotated file may
+    be longer than the committed offset (size alone would silently skip the
+    new file's head): the inode changing (rename-style rotation swaps a new
+    file under the path) and the head-prefix changing (copytruncate-style
+    rotation rewrites in place).  Either resets the offset to 0.
+
+    ``skip_malformed=True`` advances past (and counts) undecodable lines
+    instead of raising — a long-running follow loop must not wedge forever
+    on one poison line that sits exactly at the committed offset.  The
+    default stays loud (one-shot replays and tests want the error).
+    """
+
+    #: bytes of the file head remembered for the rotation heuristic
+    _HEAD_PROBE = 64
+
+    def __init__(self, path: str, source_id: Optional[str] = None,
+                 skip_malformed: bool = False):
         self.path = path
         self.source_id = source_id or f"jsonl:{os.path.basename(path)}"
+        self.skip_malformed = bool(skip_malformed)
+        #: undecodable lines skipped-and-counted (skip_malformed mode)
+        self.skipped_malformed = 0
         self._offset = 0
+        self._ino: Optional[int] = None
+        self._head: Optional[bytes] = None
 
     def seek(self, offset: int) -> None:
         self._offset = int(offset)
+
+    def _rotated(self, fh) -> bool:
+        """Same path, different file: inode changed, or the first bytes of
+        the file no longer match the remembered head.  The head probe only
+        pins bytes the reader has actually consumed (min(head, offset)) —
+        a first poll at offset 0 has consumed nothing and pins nothing."""
+        ino = os.fstat(fh.fileno()).st_ino
+        if self._ino is not None and ino != self._ino:
+            self._ino = ino
+            self._head = None
+            return True
+        self._ino = ino
+        probe = min(self._HEAD_PROBE, self._offset)
+        if probe <= 0 or self._head is None:
+            return False
+        fh.seek(0)
+        head = fh.read(probe)
+        common = min(len(head), len(self._head))
+        if head[:common] != self._head[:common]:
+            self._head = None
+            return True
+        return False
+
+    def _remember_head(self, fh) -> None:
+        """Pin the consumed head bytes for the next poll's rotation check
+        (called at poll end, when the offset reflects consumed records)."""
+        probe = min(self._HEAD_PROBE, self._offset)
+        if probe > (0 if self._head is None else len(self._head)):
+            fh.seek(0)
+            self._head = fh.read(probe)
+
+    # -- durable rotation pins (ride the offset checkpoint) ------------------
+    def checkpoint_state(self) -> dict:
+        """Inode + consumed-head pins, persisted BESIDE the committed offset
+        by the streaming reader: a rotation that happens while the process
+        is down is detected on restart (restored pins vs the live file),
+        instead of silently resuming mid-file in the new file."""
+        return {"ino": self._ino,
+                "head": self._head.hex() if self._head is not None else None}
+
+    def restore_state(self, meta: dict) -> None:
+        ino = meta.get("ino")
+        head = meta.get("head")
+        self._ino = int(ino) if ino is not None else None
+        self._head = bytes.fromhex(head) if head else None
 
     def poll(self, max_records: int):
         records: List[Any] = []
         if not os.path.exists(self.path):
             return records, self._offset
         if os.path.getsize(self.path) < self._offset:
-            # truncation / rotation: the committed offset points past the
-            # new EOF — restart from the head (standard tail -F behavior)
+            # truncation / rotation-to-smaller: the committed offset points
+            # past the new EOF — restart from the head (tail -F behavior)
             self._offset = 0
+            self._head = None
         with open(self.path, "rb") as fh:
+            if self._rotated(fh):
+                # rotation to a file LONGER than the committed offset: the
+                # size check above cannot see it, and resuming mid-file
+                # would silently skip the new file's head records
+                self._offset = 0
             fh.seek(self._offset)
             for _ in range(max_records):
                 line = fh.readline()
@@ -103,12 +178,22 @@ class JsonlTailSource(RecordSource):
                         parsed = json.loads(text)
                     except ValueError:
                         if records:
-                            return records, self._offset  # deliver the good prefix
+                            break  # deliver the good prefix first
+                        if self.skip_malformed:
+                            # advance past the poison line so a follow loop
+                            # cannot wedge at the committed offset forever
+                            self.skipped_malformed += 1
+                            log.warning(
+                                "skipping malformed JSONL at byte %d of "
+                                "%s: %r", self._offset, self.path, text[:80])
+                            self._offset = fh.tell()
+                            continue
                         raise ValueError(
                             f"malformed JSONL at byte {self._offset} of "
                             f"{self.path}: {text[:80]!r}")
                     records.append(parsed)
                 self._offset = fh.tell()
+            self._remember_head(fh)
         return records, self._offset
 
 
@@ -119,13 +204,31 @@ class OffsetCheckpoint:
         self.path = path
 
     def load(self, source_id: str, default: int = 0) -> int:
+        # a stale .tmp is a commit that crashed BEFORE its atomic rename —
+        # its content never became the committed state; drop it so it can
+        # neither be mistaken for the store nor accumulate
+        try:
+            os.remove(self.path + ".tmp")
+        except OSError:
+            pass
         try:
             with open(self.path) as fh:
                 return int(json.load(fh).get(source_id, default))
         except (OSError, ValueError):
             return default
 
-    def commit(self, source_id: str, offset: int) -> None:
+    def load_meta(self, source_id: str) -> Optional[dict]:
+        """Source-specific state committed beside the offset (e.g. the tail
+        source's rotation pins); None when absent or unreadable."""
+        try:
+            with open(self.path) as fh:
+                meta = json.load(fh).get(source_id + "#meta")
+                return dict(meta) if isinstance(meta, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def commit(self, source_id: str, offset: int,
+               meta: Optional[dict] = None) -> None:
         state = {}
         try:
             with open(self.path) as fh:
@@ -133,9 +236,17 @@ class OffsetCheckpoint:
         except (OSError, ValueError):
             pass
         state[source_id] = int(offset)
+        if meta is not None:
+            state[source_id + "#meta"] = meta
         tmp = self.path + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(state, fh)
+            # fsync BEFORE the rename: os.replace makes the tmp file the
+            # store atomically, but without the flush+fsync a crash right
+            # after could leave the rename durable while the DATA is not —
+            # an empty/torn offset file where a valid one used to be
+            fh.flush()
+            os.fsync(fh.fileno())
         os.replace(tmp, self.path)  # atomic on POSIX
 
 
@@ -174,8 +285,20 @@ class MicroBatchStreamingReader:
         self._sleep = sleep
         self._target = self.max_batch_records
         self._pending_offset: Optional[int] = None
+        #: raw records of the batch most recently yielded — the continual
+        #: control loop (workflow/continual.py) mirrors them through the
+        #: record-shaped serving path while the Dataset feeds drift stats
+        self.last_records: List[Any] = []
         self._committed = self.checkpoint.load(source.source_id) \
             if self.checkpoint else 0
+        if self.checkpoint is not None:
+            # restore source-side rotation pins committed beside the offset,
+            # so a file rotated while the process was down is detected on
+            # the first poll instead of resumed mid-file
+            meta = self.checkpoint.load_meta(source.source_id)
+            restore = getattr(source, "restore_state", None)
+            if meta and callable(restore):
+                restore(meta)
         #: batches yielded / records seen / current rate target (metrics)
         self.progress = {"batches": 0, "records": 0,
                          "target_records": self._target}
@@ -188,7 +311,10 @@ class MicroBatchStreamingReader:
             return
         self._committed = self._pending_offset
         if self.checkpoint is not None:
-            self.checkpoint.commit(self.source.source_id, self._committed)
+            state_fn = getattr(self.source, "checkpoint_state", None)
+            self.checkpoint.commit(
+                self.source.source_id, self._committed,
+                meta=state_fn() if callable(state_fn) else None)
         self._pending_offset = None
 
     # -- the micro-batch clock --------------------------------------------
@@ -210,6 +336,7 @@ class MicroBatchStreamingReader:
             # suspends there, and the consumer calls commit() while we
             # are suspended
             self._pending_offset = next_offset
+            self.last_records = list(records)
             # scoring-time batches carry no label (allow_missing_response)
             yield rows_to_dataset(records, raw_features,
                                   allow_missing_response=True)

@@ -25,10 +25,12 @@ from .faults import (
 from .plan import CompiledScoringPlan, compile_plan
 from .resilience import CircuitBreaker, ResilientScorer
 from .server import ScoringServer
+from .swap import ModelEntry, SwappableScorer, prediction_delta
 from .validator import (
     check_plan_admission,
     check_resilience_config,
     check_servability,
+    check_swap_compatibility,
 )
 
 __all__ = [
@@ -39,14 +41,18 @@ __all__ = [
     "DeadlineExceededError",
     "FaultHarness",
     "MicroBatcher",
+    "ModelEntry",
     "PoisonRecordError",
     "QueueFullError",
     "ResilientScorer",
     "ScoringServer",
+    "SwappableScorer",
     "TransientScoringError",
     "check_plan_admission",
     "check_resilience_config",
     "check_servability",
+    "check_swap_compatibility",
     "compile_plan",
     "is_retryable",
+    "prediction_delta",
 ]

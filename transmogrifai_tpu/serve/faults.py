@@ -18,6 +18,18 @@ Fault points (fired by ``CompiledScoringPlan.score``):
   resource-exhausted / XLA runtime errors surface);
 - ``host``   — the interpreted host-remainder stages.
 
+Continual-training fault points (the streaming retrain control plane,
+workflow/continual.py + serve/swap.py — each fires BEFORE its phase
+mutates anything, so an injected fault provably leaves the serving model
+untouched):
+
+- ``drift``      — drift evaluation over the stream accumulators;
+- ``refit``      — each warm-refit attempt (bounded retry wraps it);
+- ``checkpoint`` — the atomic versioned model checkpoint;
+- ``shadow``     — mirroring a flushed batch to the staged candidate;
+- ``swap``       — the blue/green promotion (before the atomic flip);
+- ``rollback``   — restoring the retained last-known-good model.
+
 Usage in tests::
 
     harness = FaultHarness(seed=0)

@@ -18,6 +18,7 @@ up front — TM505/TM506, serve/validator.py).
 
 from __future__ import annotations
 
+import itertools
 import logging
 from concurrent.futures import Future
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Union
@@ -25,6 +26,7 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Union
 from .batcher import MicroBatcher
 from .plan import CompiledScoringPlan
 from .resilience import ResilientScorer
+from .swap import ModelEntry, SwappableScorer
 
 log = logging.getLogger(__name__)
 
@@ -50,7 +52,11 @@ class ScoringServer:
     - ``score(record)`` — synchronous convenience over ``submit``.
     - ``score_batch(records)`` — bypasses the queue straight into the plan
       (bulk/offline callers that already hold a batch; no fault isolation).
-    - ``metrics()`` — plan + batcher + resilience counters as one plain dict.
+    - ``stage_candidate(model)`` / ``promote()`` / ``rollback()`` — shadow
+      scoring and atomic blue/green model swap (serve/swap.py): mirrored
+      traffic scores the candidate, promotion swaps atomically with the old
+      model retained, and a post-swap breaker trip auto-rolls back.
+    - ``metrics()`` — plan + batcher + resilience + swap counters, one dict.
     """
 
     def __init__(self, model, max_batch: int = 256, max_wait_ms: float = 2.0,
@@ -64,17 +70,12 @@ class ScoringServer:
             # serves the largest flush the batcher can produce
             max_bucket = max(1 << (max(max_batch, 1) - 1).bit_length(),
                              min_bucket)
-        # hbm_budget arms the TM601 admission gate (serve/validator.py):
-        # a model whose fused prefix cannot fit the device budget is
-        # rejected here, before any executable compiles or request queues
-        self.plan = CompiledScoringPlan(model, min_bucket=min_bucket,
-                                        max_bucket=max_bucket,
-                                        hbm_budget=hbm_budget)
-        if warm:
-            self.plan.warm()
+        self.min_bucket = min_bucket
+        self.max_bucket = max_bucket
+        self.hbm_budget = hbm_budget
         self.default_deadline_ms = deadline_ms
 
-        self.resilience: Optional[ResilientScorer] = None
+        self._resilience_params: Optional[Dict[str, Any]] = None
         if resilience:
             params = dict(_RESILIENCE_DEFAULTS)
             if isinstance(resilience, Mapping):
@@ -84,12 +85,37 @@ class ScoringServer:
                         f"unknown resilience parameter(s): {sorted(unknown)}")
                 params.update(resilience)
             self._validate_resilience(params, deadline_ms, max_wait_ms)
-            self.resilience = ResilientScorer(self.plan, **params)
-        score_fn: Any = self.resilience if self.resilience is not None \
-            else self.plan.score
-        self.batcher = MicroBatcher(score_fn, max_batch=max_batch,
+            self._resilience_params = params
+        self._versions = itertools.count(1)
+        # every model (initial and staged candidates) builds through one
+        # path; the swapper is the batcher-facing atomic reference so a
+        # blue/green swap can never split an in-flight batch across models
+        self._swapper = SwappableScorer(self._build_entry(model, warm=warm))
+        self.batcher = MicroBatcher(self._swapper, max_batch=max_batch,
                                     max_wait_ms=max_wait_ms,
                                     max_queue=max_queue)
+
+    def _build_entry(self, model, warm: bool = True) -> ModelEntry:
+        # hbm_budget arms the TM601 admission gate (serve/validator.py):
+        # a model whose fused prefix cannot fit the device budget is
+        # rejected here, before any executable compiles or request queues
+        plan = CompiledScoringPlan(model, min_bucket=self.min_bucket,
+                                   max_bucket=self.max_bucket,
+                                   hbm_budget=self.hbm_budget)
+        if warm:
+            plan.warm()
+        res = ResilientScorer(plan, **self._resilience_params) \
+            if self._resilience_params is not None else None
+        return ModelEntry(model, plan, res, next(self._versions))
+
+    # -- active-entry views (the pre-swap public attribute surface) ----------
+    @property
+    def plan(self) -> CompiledScoringPlan:
+        return self._swapper.active.plan
+
+    @property
+    def resilience(self) -> Optional[ResilientScorer]:
+        return self._swapper.active.resilience
 
     @staticmethod
     def _validate_resilience(params: Dict[str, Any],
@@ -128,6 +154,63 @@ class ScoringServer:
                     ) -> List[Dict[str, Any]]:
         return self.plan.score(records)
 
+    # -- blue/green swap (serve/swap.py, workflow/continual.py) --------------
+    def stage_candidate(self, model, warm: bool = True) -> str:
+        """Build + stage a candidate model for shadow scoring.
+
+        The candidate compiles its own :class:`CompiledScoringPlan` (sharing
+        cached executables when its fused-prefix fingerprint matches the
+        active plan's — the warm-refit frozen-prep contract) and, when the
+        fault-tolerance layer is on, its own fresh ResilientScorer/breaker.
+        Refuses incompatible candidates with TM507 (serve/validator.py);
+        returns the candidate's plan fingerprint.
+        """
+        from ..checkers.diagnostics import OpCheckError
+        from .validator import check_swap_compatibility
+
+        # build unwarmed: plan construction is partition+fingerprint only
+        # (no XLA), so an incompatible candidate is refused BEFORE any
+        # bucket executable compiles
+        entry = self._build_entry(model, warm=False)
+        report = check_swap_compatibility(self.plan, entry.plan)
+        if report.errors():
+            raise OpCheckError(report)
+        for d in report:
+            log.info("%s", d.pretty())
+        if warm:
+            entry.plan.warm()
+        self._swapper.stage(entry)
+        return entry.fingerprint
+
+    def discard_candidate(self) -> None:
+        self._swapper.discard_candidate()
+
+    def shadow_report(self) -> Dict[str, Any]:
+        """Mirrored-traffic statistics of the staged candidate (promotion
+        gate input): mirrored/failed record counts and prediction deltas."""
+        return self._swapper.shadow_report()
+
+    def has_candidate(self) -> bool:
+        return self._swapper.has_candidate()
+
+    def in_probation(self) -> bool:
+        return self._swapper.in_probation()
+
+    def promote(self, probation_batches: int = 8) -> Dict[str, Any]:
+        """Atomic blue/green swap to the staged candidate: in-flight batches
+        complete on the old model, the old entry is retained as the rollback
+        target, and a breaker trip within ``probation_batches`` flushed
+        batches auto-rolls back.  Returns the swap record (plan
+        fingerprints + versions)."""
+        return self._swapper.promote(probation_batches=probation_batches)
+
+    def rollback(self) -> Dict[str, Any]:
+        """Manually restore the retained last-known-good model."""
+        return self._swapper.rollback()
+
+    def swap_metrics(self) -> Dict[str, Any]:
+        return self._swapper.metrics()
+
     # -- lifecycle -----------------------------------------------------------
     def close(self, drain: bool = True, timeout: Optional[float] = None) -> None:
         self.batcher.shutdown(drain=drain, timeout=timeout)
@@ -141,7 +224,8 @@ class ScoringServer:
     # -- observability -------------------------------------------------------
     def metrics(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"plan": self.plan.metrics(),
-                               "batcher": self.batcher.metrics()}
+                               "batcher": self.batcher.metrics(),
+                               "swap": self._swapper.metrics()}
         if self.resilience is not None:
             out["resilience"] = self.resilience.metrics()
         return out

@@ -20,6 +20,10 @@ hazards surface from ``workflow.validate(serving=True)``, ``cli lint
   checks (:func:`check_resilience_config`) — invalid retry/breaker numbers,
   and a default deadline the flush wait makes unmeetable.  Run by
   :class:`~.server.ScoringServer` before any request is accepted.
+- **TM507** (error) / **TM508** (info): blue/green swap admission
+  (:func:`check_swap_compatibility`) — a staged candidate must serve the
+  same result feature names as the active model, and a fingerprint-changing
+  swap (candidate cannot share the cached prefix executables) is called out.
 - **TM601** (error): HBM admission (:func:`check_plan_admission`) — the
   plan's static peak live-buffer estimate at its largest padding bucket
   (checkers/plancheck.py, abstract jaxpr trace) exceeds the configured
@@ -105,6 +109,35 @@ def check_plan_admission(plan, hbm_budget: float) -> DiagnosticReport:
     report.plan_cost = cost
     report.extend(d for d in cost_diagnostics(cost, hbm_budget=hbm_budget)
                   if d.code == "TM601")
+    return report
+
+
+def check_swap_compatibility(active_plan, candidate_plan) -> DiagnosticReport:
+    """Blue/green swap admission (TM507/TM508).
+
+    TM507 (error): the candidate does not serve the same result feature
+    names as the active plan — a swap would silently change the response
+    schema under live clients.  TM508 (info): the candidate's fused-prefix
+    fingerprint differs from the active plan's, so the swap cannot reuse the
+    cached executables (a frozen-prep warm refit would); still admitted, but
+    the compile cost is called out.
+    """
+    report = DiagnosticReport()
+    active_names = sorted(f.name for f in active_plan.result_features)
+    cand_names = sorted(f.name for f in candidate_plan.result_features)
+    if active_names != cand_names:
+        report.extend([make_diagnostic(
+            "TM507",
+            f"candidate serves result features {cand_names} but the active "
+            f"model serves {active_names}; refusing a schema-changing swap")])
+        return report
+    if candidate_plan.fingerprint != active_plan.fingerprint:
+        report.extend([make_diagnostic(
+            "TM508",
+            "candidate fused-prefix fingerprint "
+            f"{candidate_plan.fingerprint[:12]} differs from the active "
+            f"plan's {active_plan.fingerprint[:12]}; the swap compiles a "
+            "fresh prefix instead of sharing the executable cache")])
     return report
 
 
