@@ -395,6 +395,19 @@ class TestBenchSmoke:
             cal = parsed["irls_sweep_flops_calibration"]
             assert 0.2 <= cal <= 5.0, \
                 f"static FLOP model drifted from the analytic count: {cal}"
+        # Pallas kernel dispatch section (ISSUE 10): runs in interpret mode
+        # under --smoke, always emits, inline exact-int8 parity must hold,
+        # and the JSON carries the tuning provenance of the run
+        assert secs["pallas"]["status"] == "ok", secs["pallas"]
+        pz = parsed["pallas"]
+        assert pz["measured"] in ("pallas", "interpret")
+        assert pz["interpret_parity_ok"] is True, pz
+        assert pz["gate_hist_ge_xla"] is True, pz
+        assert pz["hist_kernel_gbs"] > 0 and pz["hist_xla_gbs"] > 0
+        assert pz["split_scan_kernel_nodes_per_sec"] > 0
+        tuning = parsed["tuning"]
+        assert tuning["kernel_mode"] in ("xla", "pallas", "interpret")
+        assert tuning["hist_chunk"] >= 1 and tuning["hist_unroll"] >= 1
 
     def test_bench_emits_json_under_sigterm_mid_section(self):
         """Regression for the PR 3 signal handlers (the BENCH_r05 rc=124 run

@@ -95,14 +95,18 @@ class TestStyleValidation:
         (marked inline where it is — workflow/plan.py, checkers/irsnap.py).
         readers/ joined the gate with the continual-training control plane:
         its offset caches and the serve-side swap state are exactly the
-        shared-mutable-state shape TM306 exists to police."""
+        shared-mutable-state shape TM306 exists to police; perf/kernels/
+        joined with the Pallas dispatch layer (ISSUE 10) — kernel bodies and
+        the dispatch-mode state are hot-path code the default gate never
+        named."""
         from transmogrifai_tpu.checkers.opcheck import (
             lint_file,
             lint_file_concurrency,
         )
 
         findings = []
-        for sub in ("serve", "perf", "checkers", "cli", "workflow", "readers"):
+        for sub in ("serve", "perf", "perf/kernels", "checkers", "cli",
+                    "workflow", "readers"):
             d = os.path.join(PKG_ROOT, sub)
             for f in sorted(os.listdir(d)):
                 if not f.endswith(".py"):

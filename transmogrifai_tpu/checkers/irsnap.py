@@ -91,6 +91,12 @@ _LOC_LINE_RE = re.compile(r"^#loc.*$", re.MULTILINE)
 _SSA_RE = re.compile(r"%[A-Za-z0-9_]+")
 _DENSE_RE = re.compile(r"dense<([^<>]*)>")
 _MODULE_RE = re.compile(r"module @[A-Za-z0-9_.$-]+")
+#: serialized kernel payloads (the Mosaic module a Pallas ``tpu_custom_call``
+#: carries in ``backend_config``) are NOT byte-stable across processes — the
+#: canonical form elides them entirely; the kernel's semantics stay pinned
+#: by the interpret-mode family of the same kernel, and the custom_call's
+#: presence/target/operand types stay in this family's text
+_BACKEND_CONFIG_RE = re.compile(r'backend_config = "((?:[^"\\]|\\.)*)"')
 
 
 def _hash_payload(payload: str) -> str:
@@ -113,6 +119,10 @@ def canonicalize_stablehlo(text: str) -> str:
     text = _LOC_LINE_RE.sub("", text)
     text = _LOC_RE.sub("", text)
     text = _MODULE_RE.sub("module @m", text)
+    text = _BACKEND_CONFIG_RE.sub(
+        lambda m: 'backend_config = "#elided"'
+        if len(m.group(1)) > _CONST_HASH_THRESHOLD else m.group(0),
+        text)
     text = _DENSE_RE.sub(
         lambda m: _hash_payload(m.group(1))
         if len(m.group(1)) > _CONST_HASH_THRESHOLD else m.group(0),
@@ -141,7 +151,14 @@ def ir_fingerprint(canonical_text: str) -> str:
 # ---------------------------------------------------------------------------
 
 _OP_RE = re.compile(r'"?((?:stablehlo|chlo|vhlo|mhlo|sdy)\.[A-Za-z_0-9]+)"?')
+#: custom_call target in the PRETTY printer form (``custom_call @Target``)
 _CUSTOM_CALL_RE = re.compile(r"custom_call @([A-Za-z0-9_]+)")
+#: ... and in the GENERIC printer form (``"stablehlo.custom_call"(...)
+#: <{call_target_name = "Target", ...}>``) — Pallas kernels lower to
+#: ``tpu_custom_call`` and must count by their target name, not lump under
+#: one opaque ``stablehlo.custom_call`` entry, whichever form the MLIR
+#: printer of the day emits
+_CALL_TARGET_RE = re.compile(r'call_target_name\s*=\s*"([A-Za-z0-9_.$-]+)"')
 _TENSOR_DTYPE_RE = re.compile(
     r"tensor<(?:[0-9?]+x)*([a-z][a-z0-9]*(?:<[^<>]*>)?)>")
 _SHARDING_ATTR_RE = re.compile(r'mhlo\.sharding = "([^"]*)"')
@@ -165,9 +182,13 @@ def _op_histogram(text: str) -> Dict[str, int]:
     for m in _OP_RE.finditer(text):
         name = m.group(1)
         counts[name] = counts.get(name, 0) + 1
-    for m in _CUSTOM_CALL_RE.finditer(text):
-        key = f"custom_call@{m.group(1)}"
-        counts[key] = counts.get(key, 0) + 1
+    # per-target custom_call counts, across both printer forms (one op
+    # prints EITHER ``@Target`` or ``call_target_name = "Target"``, never
+    # both, so summing the two never double-counts)
+    for regex in (_CUSTOM_CALL_RE, _CALL_TARGET_RE):
+        for m in regex.finditer(text):
+            key = f"custom_call@{m.group(1)}"
+            counts[key] = counts.get(key, 0) + 1
     return counts
 
 
@@ -847,9 +868,104 @@ class _Shim:
         self.fitted = dict(fitted)
 
 
+class CorpusUnavailable(RuntimeError):
+    """Raised by a family builder when this environment cannot lower it
+    (e.g. no TPU cross-lowering support in the jax build) — build_corpus
+    records the family as skipped instead of failing the whole snapshot."""
+
+
+def _kernel_entries() -> List[CorpusEntry]:
+    """The Pallas kernel program families (perf/kernels/, ISSUE 10).
+
+    Two pins per design: the ``@interpret`` families lower the emulation on
+    CPU — plain StableHLO, the kernel BODY's full semantics golden — and
+    ``hist@tpu`` cross-lowers the compiled form, pinning the
+    ``custom_call @tpu_custom_call`` interface (operand layout, dtypes,
+    call count; the volatile Mosaic payload is elided by
+    ``canonicalize_stablehlo``).  All lower-only: zero backend compiles.
+    """
+    import jax
+
+    from ..perf.programs import cache_key_fingerprint
+
+    L, n, two_k, d, nn, n_bins = 2, 256, 2, 4, 2, 8
+    B = n_bins + 1
+
+    def _hist_fn(interpret: bool):
+        from ..perf.kernels.histogram import hist_level_pallas
+
+        def hist_program(local, ghT, binned):
+            return hist_level_pallas(local, ghT, binned, nn, n_bins,
+                                     int_exact=True, interpret=interpret,
+                                     chunk=128)
+
+        return hist_program
+
+    _hist_specs = [_spec(L, n, dtype="int32"),
+                   _spec(L, two_k, n, dtype="int8"),
+                   _spec(n, d, dtype="int32")]
+
+    def hist_interpret():
+        fn = jax.jit(_hist_fn(True))  # opcheck: allow(TM303) lower-only snapshot path, zero backend compiles
+        return snapshot_lowered(
+            "perf.kernels.hist@interpret", fn.lower(*_hist_specs),
+            content_fingerprint=cache_key_fingerprint(fn, *_hist_specs))
+
+    def hist_tpu():
+        fn = jax.jit(_hist_fn(False))  # opcheck: allow(TM303) lower-only snapshot path, zero backend compiles
+        try:
+            lowered = fn.trace(*_hist_specs).lower(
+                lowering_platforms=("tpu",))
+        except Exception as e:  # noqa: BLE001 — env-dependent cross-lowering
+            raise CorpusUnavailable(
+                f"TPU cross-lowering unavailable: {type(e).__name__}: {e}")
+        return snapshot_lowered(
+            "perf.kernels.hist@tpu", lowered,
+            content_fingerprint=cache_key_fingerprint(fn, *_hist_specs))
+
+    def split_interpret():
+        from ..perf.kernels.splitscan import split_scan_pallas
+
+        def split_program(hg, hh, G, H, mask, reg_lambda, alpha, gamma, mcw):
+            return split_scan_pallas(hg, hh, G, H, mask, n_bins, reg_lambda,
+                                     alpha, gamma, mcw, interpret=True)
+
+        fn = jax.jit(split_program)  # opcheck: allow(TM303) lower-only snapshot path, zero backend compiles
+        specs = [_spec(L, nn, 1, d, B), _spec(L, nn, 1, d, B),
+                 _spec(L, nn, 1), _spec(L, nn, 1), _spec(L, d),
+                 _spec(), _spec(), _spec(), _spec()]
+        return snapshot_lowered(
+            "perf.kernels.split_scan@interpret", fn.lower(*specs),
+            content_fingerprint=cache_key_fingerprint(fn, *specs))
+
+    def encode_interpret():
+        import jax.numpy as jnp
+
+        from ..perf.kernels.encode import bucketize_right_encode, onehot_codes
+
+        def encode_program(x, splits, codes):
+            buckets = bucketize_right_encode(x, splits, True, False,
+                                             interpret=True)
+            levels = onehot_codes(codes, 7, interpret=True)
+            return jnp.concatenate([buckets, levels], axis=1)
+
+        fn = jax.jit(encode_program)  # opcheck: allow(TM303) lower-only snapshot path, zero backend compiles
+        specs = [_spec(n), _spec(5), _spec(n, dtype="int32")]
+        return snapshot_lowered(
+            "perf.kernels.encode@interpret", fn.lower(*specs),
+            content_fingerprint=cache_key_fingerprint(fn, *specs))
+
+    return [
+        CorpusEntry("perf.kernels.hist@interpret", hist_interpret),
+        CorpusEntry("perf.kernels.hist@tpu", hist_tpu),
+        CorpusEntry("perf.kernels.split_scan@interpret", split_interpret),
+        CorpusEntry("perf.kernels.encode@interpret", encode_interpret),
+    ]
+
+
 def corpus_entries() -> List[CorpusEntry]:
     """Every builtin program family, in stable key order."""
-    return _sweep_entries() + _plan_entries()
+    return _sweep_entries() + _plan_entries() + _kernel_entries()
 
 
 def build_corpus(families: Optional[Sequence[str]] = None
@@ -873,7 +989,12 @@ def build_corpus(families: Optional[Sequence[str]] = None
                      entry.key, entry.min_devices, n_dev)
             skipped.append(entry.key)
             continue
-        snap = entry.build()
+        try:
+            snap = entry.build()
+        except CorpusUnavailable as e:
+            log.info("irsnap: skipping %s (%s)", entry.key, e)
+            skipped.append(entry.key)
+            continue
         snap.min_devices = entry.min_devices
         snaps[snap.key] = snap
     return snaps, skipped

@@ -52,6 +52,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.dataset import Column
+from ..perf.kernels import dispatch as _kdispatch
+from ..perf.kernels import histogram as _khist
+from ..perf.kernels import splitscan as _ksplit
 from ..stages.base import Param
 from .base import PredictionEstimatorBase, PredictionModelBase
 from .prediction import PredictionColumn
@@ -65,18 +68,24 @@ from .prediction import PredictionColumn
 DEFAULT_BINS = 32
 
 #: histogram-accumulation row-chunk size (see _grow_tree); module-level so
-#: tests can shrink it to exercise the chunked path on small data.
+#: tests can shrink it to exercise the chunked path on small data, and
+#: env-overridable (``TMOG_HIST_CHUNK``, read through the one tuning-knob
+#: helper ``perf.kernels.dispatch.tuning_int`` and recorded in the bench
+#: JSON provenance so BENCH rounds are self-describing about their tuning).
 #: 2048 measured 3.8x faster than 8192 on v5e at 1M x 128 (64 bins): the
 #: per-step (chunk, B*d) bin one-hot operand is small enough for XLA to keep
 #: the one-hot -> matmul pipeline on-chip instead of spilling through HBM.
 #: Re-measured at the 32-bin default (r4): 2048 and 4096 tie (RF cv 3.4s,
 #: GBT cv 2.4s) while 8192 still regresses GBT 3.4x — 2048 stands.
-_HIST_CHUNK = 2048
+_HIST_CHUNK = _kdispatch.tuning_int("TMOG_HIST_CHUNK",
+                                    _kdispatch.HIST_CHUNK_DEFAULT)
 
-#: unroll factor for the histogram chunk scans — r5 tuning knob: the 1M-row
-#: growth runs ~500 scan steps per level, and per-step sequencing overhead
-#: is material at 32 bins where each step's matmul is small
-_HIST_UNROLL = 1
+#: unroll factor for the histogram chunk scans — r5 tuning knob
+#: (``TMOG_HIST_UNROLL``): the 1M-row growth runs ~500 scan steps per level,
+#: and per-step sequencing overhead is material at 32 bins where each
+#: step's matmul is small
+_HIST_UNROLL = _kdispatch.tuning_int("TMOG_HIST_UNROLL",
+                                     _kdispatch.HIST_UNROLL_DEFAULT)
 
 #: forest-CV lane layout: True = vmap over folds with the T tree lanes
 #: folded into each fold's GEMM (k small batched GEMMs of M=T*nn*2K);
@@ -90,6 +99,19 @@ _RF_FOLD_VMAP = False
 #: resident operand (n_padded * (bins+1) * d int8) never risks HBM.
 _GBT_MAT_BINOH = True
 _BINOH_MAT_MAX_BYTES = 6_000_000_000
+
+
+def _hist_admit(L: int, nn: int, K: int, B: int, d: int, elem_bytes: int,
+                chunk: int):
+    """THE histogram-kernel admission call (perf/kernels/dispatch.hist_mode)
+    with the working-set formula written once: ``_level_hist`` consults it
+    per level, and ``_fit_gbt_lanes`` consults it for the deepest level to
+    decide whether the premade mat-binoh operand is still needed — the two
+    decisions must never diverge."""
+    return _kdispatch.hist_mode(
+        L * nn * 2 * K, B * d, chunk,
+        lanes_bytes_per_row=4 * (L + L * 2 * K + d),
+        elem_bytes=elem_bytes)
 
 
 def _materialize_bin_oh(binned: jnp.ndarray, n_bins: int):
@@ -261,9 +283,10 @@ class Tree(NamedTuple):
     value: jnp.ndarray         # (m, K) float32 leaf value vector (eta-scaled)
 
 
-def _soft_threshold(g, alpha):
-    """XGBoost L1 shrinkage on the gradient sum."""
-    return jnp.sign(g) * jnp.maximum(jnp.abs(g) - alpha, 0.0)
+#: XGBoost L1 shrinkage on the gradient sum — ONE definition shared with the
+#: split-scan kernels (perf/kernels/splitscan.py) so leaf values and split
+#: gains can never drift apart across dispatch modes
+_soft_threshold = _ksplit.soft_threshold
 
 
 def _row_select(binned: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
@@ -462,7 +485,16 @@ def _grow_trees(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     def _level_hist(local, nn):
         """(L, nn, 2K, d, B) histograms; negative ``local`` rows contribute 0."""
-        if n_chunks:
+        kmode = _hist_admit(L, nn, K, B, d, jnp.dtype(hdt).itemsize, CHUNK)
+        if kmode is not None:
+            # fused Pallas build: row chunks stream through VMEM, the
+            # (M, B*d) accumulator stays resident across the whole pass —
+            # the premade bin one-hot (_GBT_MAT_BINOH) is unnecessary here,
+            # the kernel constructs its one-hots in VMEM per chunk
+            hist = _khist.hist_level_pallas(
+                local, ghT, binned, nn, n_bins, int_exact=int_exact,
+                mxu_dtype=hdt, interpret=kmode == "interpret", chunk=CHUNK)
+        elif n_chunks:
             local_c = local.reshape(L, n_chunks, CHUNK).swapaxes(0, 1)
             premade = bin_oh_c is not None
 
@@ -518,30 +550,10 @@ def _grow_trees(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         H = hist_h[:, :, :, 0, :].sum(-1)
         node_val = _leaf_all(G, H)
 
-        # split search: left = bins [0..b]; missing tried on both sides
-        gl = jnp.cumsum(hist_g[..., :n_bins], axis=-1)[..., :-1]  # (L,nodes,K,d,b-1)
-        hl = jnp.cumsum(hist_h[..., :n_bins], axis=-1)[..., :-1]
-        g_miss = hist_g[..., n_bins][..., None]
-        h_miss = hist_h[..., n_bins][..., None]
-        Gt = G[..., None, None]
-        Ht = H[..., None, None]
-
-        def gain_of(gl_, hl_):
-            gr_, hr_ = Gt - gl_, Ht - hl_
-            # child-weight constraint on the mean hessian across classes so the
-            # K=1 case reduces exactly to the scalar XGBoost rule
-            ok = (hl_.mean(2) >= min_child_weight) & (hr_.mean(2) >= min_child_weight)
-            eps = 1e-12  # empty-child guard: 0^2/0 counts as zero gain
-            raw = (_soft_threshold(gl_, alpha) ** 2 / (hl_ + reg_lambda + eps)
-                   + _soft_threshold(gr_, alpha) ** 2 / (hr_ + reg_lambda + eps)
-                   - _soft_threshold(Gt, alpha) ** 2 / (Ht + reg_lambda + eps))
-            raw = raw.sum(axis=2)  # sum per-class contributions -> (L, nodes, d, bins)
-            return jnp.where(ok, 0.5 * raw - gamma, -jnp.inf)
-
-        gain_mr = gain_of(gl, hl)                    # missing goes right
-        gain_ml = gain_of(gl + g_miss, hl + h_miss)  # missing goes left
-        gain = jnp.maximum(gain_mr, gain_ml)
-
+        # split search: left = bins [0..b]; missing tried on both sides.
+        # The cumsum + gain + argmax math lives in perf/kernels/splitscan.py
+        # — ONE definition shared by the XLA reference and the fused Pallas
+        # kernel, dispatched there (TMOG_PALLAS / VMEM admission).
         level_mask = feat_mask                       # (L, d)
         if colsample_bylevel < 1.0:
             # salt 3 keeps level draws independent of the subsample (salt 1)
@@ -551,17 +563,11 @@ def _grow_trees(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             level_key = jax.random.fold_in(jax.random.fold_in(key, 3), depth)
             level_mask = feat_mask * _colsample_mask(level_key, d,
                                                      colsample_bylevel)[None, :]
-        gain = jnp.where(level_mask[:, None, :, None] > 0, gain, -jnp.inf)
-
-        flat = gain.reshape(L, n_nodes, -1)
-        best = flat.argmax(axis=-1)                              # (L, nodes)
-        best_gain = jnp.take_along_axis(flat, best[..., None], -1)[..., 0]
+        best, best_gain, bml = _ksplit.split_scan(
+            hist_g, hist_h, G, H, level_mask, n_bins,
+            reg_lambda, alpha, gamma, min_child_weight)   # (L, nodes) each
         bf = (best // (n_bins - 1)).astype(jnp.int32)
         bb = (best % (n_bins - 1)).astype(jnp.int32)
-        ml_flat = gain_ml.reshape(L, n_nodes, -1)
-        mr_flat = gain_mr.reshape(L, n_nodes, -1)
-        bml = jnp.take_along_axis(ml_flat, best[..., None], -1)[..., 0] >= \
-            jnp.take_along_axis(mr_flat, best[..., None], -1)[..., 0]
 
         # nodes with no positive gain (or no rows) become leaves now
         leaf_now = (best_gain <= 0.0) | (H.mean(-1) <= 0.0)
@@ -577,7 +583,14 @@ def _grow_trees(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             # split's left/right sums, already sitting in the cumulative
             # histograms — deriving leaf values from them eliminates the
             # former deepest-level totals pass over the data entirely
-            # (one full (n, d) scan per tree per round saved)
+            # (one full (n, d) scan per tree per round saved).  The cumsums
+            # rebuild here on the tiny per-level tensors: on the XLA split
+            # path they CSE with split_scan's own, on the Pallas path they
+            # are the only HBM-visible copy.
+            gl = jnp.cumsum(hist_g[..., :n_bins], axis=-1)[..., :-1]
+            hl = jnp.cumsum(hist_h[..., :n_bins], axis=-1)[..., :-1]
+            g_miss = hist_g[..., n_bins][..., None]
+            h_miss = hist_h[..., n_bins][..., None]
             bidx = jnp.broadcast_to(best[:, :, None, None],
                                     (L, n_nodes, K, 1))
             gl_best = jnp.take_along_axis(
@@ -694,8 +707,18 @@ def _fit_gbt_lanes(binned, y, w_lanes, key, n_rounds: int, max_depth: int,
         y_onehot = jax.nn.one_hot(y.astype(jnp.int32), K, dtype=jnp.float32)
 
     # one int8 bin one-hot shared by every round x level (None when the
-    # unchunked path applies or the operand would exceed the HBM cap)
-    bin_oh_c = _materialize_bin_oh(binned, n_bins) if _GBT_MAT_BINOH else None
+    # unchunked path applies or the operand would exceed the HBM cap).
+    # Pallas dispatch makes it moot — the kernel builds its one-hots in VMEM
+    # per chunk — but ONLY when the kernel is actually admitted at the
+    # DEEPEST fresh-histogram level (the largest per-level working set, nn =
+    # 2^(max_depth-2) left children): if VMEM admission will route the deep
+    # levels back to the XLA scan, the premade operand must still exist or
+    # those levels lose the measured mat-binoh win.
+    nn_deep = max(1, 2 ** max(max_depth - 2, 0))
+    deep_kmode = _hist_admit(L, nn_deep, K, n_bins + 1, d,
+                             jnp.dtype(_hist_dtype()).itemsize, _HIST_CHUNK)
+    bin_oh_c = _materialize_bin_oh(binned, n_bins) \
+        if _GBT_MAT_BINOH and deep_kmode is None else None
 
     def round_fn(margin, r):
         rkey = jax.random.fold_in(key, r)
@@ -1199,7 +1222,8 @@ class _GBTBase(_TreeEstimatorBase):
             statics=dict(objective=objective, num_class=num_class,
                          metric_fn=metric_fn, **self._fit_config()),
             key_extras=dict(mat_binoh=_GBT_MAT_BINOH,
-                            hist_chunk=_HIST_CHUNK),
+                            hist_chunk=_HIST_CHUNK,
+                            hist_unroll=_HIST_UNROLL),
             label=f"{type(self).__name__}/cv_program")
 
 
@@ -1358,7 +1382,8 @@ class _ForestBase(_TreeEstimatorBase):
                          # targets: exact int8 when fold weights are 0/1 and
                          # targets are class indicators
                          int_exact=weights01 and self.classification),
-            key_extras=dict(fold_vmap=_RF_FOLD_VMAP, hist_chunk=_HIST_CHUNK),
+            key_extras=dict(fold_vmap=_RF_FOLD_VMAP, hist_chunk=_HIST_CHUNK,
+                            hist_unroll=_HIST_UNROLL),
             label=f"{type(self).__name__}/cv_program")
 
 

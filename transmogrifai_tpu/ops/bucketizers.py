@@ -168,6 +168,18 @@ def device_bucketize_right(x, splits, track_nulls: bool, track_invalid: bool):
         return null_col if track_nulls else jnp.zeros((x.shape[0], 0),
                                                       jnp.float32)
     n_buckets = n_splits - 1
+    # kernel dispatch (perf/kernels): the whole bucketize one-hot fuses into
+    # one Pallas pass on TPU (interpret-mode in parity tests); the XLA path
+    # below stays the always-available reference (TMOG_PALLAS=0)
+    from ..perf.kernels import dispatch as _kdispatch
+
+    width = n_buckets + (1 if track_invalid else 0) + (1 if track_nulls else 0)
+    kmode = _kdispatch.encode_mode(width)
+    if kmode is not None:
+        from ..perf.kernels.encode import bucketize_right_encode
+
+        return bucketize_right_encode(x, splits, track_nulls, track_invalid,
+                                      interpret=kmode == "interpret")
     finite = present & jnp.isfinite(x)
     v0 = jnp.nan_to_num(x)
     idx = jnp.clip(jnp.searchsorted(splits, v0, side="left") - 1,

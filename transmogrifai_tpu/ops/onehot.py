@@ -158,12 +158,25 @@ class OneHotVectorizerModel(Transformer):
 
     def device_transform(self, *codes):
         """One-hot scatter of the precomputed level codes, one block per
-        input feature — the device half of ``transform_columns``."""
+        input feature — the device half of ``transform_columns``.  Dispatches
+        to the fused Pallas encode kernel (perf/kernels/encode.py) on TPU /
+        in interpret-mode parity runs; ``jax.nn.one_hot`` stays the
+        always-available XLA reference (TMOG_PALLAS=0)."""
         import jax
         import jax.numpy as jnp
 
-        blocks = [jax.nn.one_hot(c, self._slot_width(slot), dtype=jnp.float32)
-                  for slot, c in enumerate(codes)]
+        from ..perf.kernels import dispatch as _kdispatch
+
+        def one_block(slot, c):
+            width = self._slot_width(slot)
+            kmode = _kdispatch.encode_mode(width)
+            if kmode is not None:
+                from ..perf.kernels.encode import onehot_codes
+
+                return onehot_codes(c, width, interpret=kmode == "interpret")
+            return jax.nn.one_hot(c, width, dtype=jnp.float32)
+
+        blocks = [one_block(slot, c) for slot, c in enumerate(codes)]
         return blocks[0] if len(blocks) == 1 else jnp.concatenate(blocks, axis=1)
 
     def _meta(self) -> VectorMetadata:

@@ -1,0 +1,198 @@
+"""Kernel dispatch layer: who runs a tree/encode hot loop, and how we know.
+
+Reference role (SURVEY §2.9): the reference dispatches its tree hot loops to
+XGBoost's native C++ kernels through the JNI when the library is present and
+falls back to Spark MLlib's JVM trees otherwise.  This module is that
+decision point for the TPU port — Pallas kernels vs the tuned XLA reference
+formulation — with the decision itself made observable and cacheable:
+
+- ``kernel_mode()`` resolves the effective mode from ``TMOG_PALLAS``:
+
+  =============  ==========================================================
+  ``TMOG_PALLAS``  effective mode
+  =============  ==========================================================
+  unset / ``1`` / ``auto``   ``pallas`` on a TPU backend, ``xla`` elsewhere
+  ``0`` / ``off`` / ``xla``  ``xla`` everywhere — the escape hatch
+  ``interpret``              ``pallas.interpret=True`` emulation (CPU/CI
+                             parity tests; jittable, runs anywhere)
+  ``pallas``                 force compiled Pallas even off-TPU (expert)
+  =============  ==========================================================
+
+- ``cache_token()`` is the kernel-choice fingerprint.  It rides EVERY
+  ``perf.programs.run_cached`` key and every plan content fingerprint
+  (``workflow.plan.stage_content_fingerprint``), so flipping the dispatch
+  mode can never serve a stale executable compiled for the other mode —
+  the same fallback discipline the fused transform planner established
+  (``TMOG_FUSED_TRANSFORM``, PR 4).
+- VMEM admission guards (``hist_mode``/``split_mode``/``encode_mode``):
+  compiled Pallas keeps its accumulator and operands resident in VMEM, so a
+  shape whose working set exceeds the budget (``TMOG_PALLAS_VMEM_BUDGET``,
+  default 10 MB of the ~16 MB/core) falls back to the XLA path instead of
+  failing to compile.  Interpret mode has no such limit.
+- ``tuning_int()`` is the one helper every env-overridable tuning knob
+  reads through (``TMOG_HIST_CHUNK``, ``TMOG_HIST_UNROLL``, the VMEM
+  budget); ``kernel_provenance()`` reports the live values so BENCH rounds
+  are self-describing about the tuning they ran under.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+#: test override installed by force_kernel_mode(); None = resolve from env.
+#: A scalar rebind (not a mutated container) — single-writer test usage.
+_FORCED: Optional[str] = None
+
+#: resolved default VMEM budget for compiled kernels (bytes): leave head
+#: room under the ~16 MB/core for double buffering and the epilogue
+_DEFAULT_VMEM_BUDGET = 10 * 1024 * 1024
+
+#: the histogram tuning-knob defaults — ONE definition; models/trees.py and
+#: perf/kernels/histogram.py both resolve their knobs against these
+HIST_CHUNK_DEFAULT = 2048
+HIST_UNROLL_DEFAULT = 1
+
+
+def tuning_int(name: str, default: int, minimum: int = 1) -> int:
+    """THE env-knob reader: ``int(os.environ[name])`` clamped below by
+    ``minimum``, ``default`` when unset or unparseable.  Every tuning knob
+    (``TMOG_HIST_CHUNK``, ``TMOG_HIST_UNROLL``, ``TMOG_PALLAS_VMEM_BUDGET``)
+    funnels through here so provenance reporting cannot drift from the
+    values actually used."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return int(default)
+    try:
+        return max(int(minimum), int(raw))
+    except ValueError:
+        return int(default)
+
+
+def _env_mode() -> str:
+    raw = os.environ.get("TMOG_PALLAS", "").strip().lower()
+    if raw in ("0", "off", "false", "no", "xla"):
+        return "xla"
+    if raw in ("interpret", "emulate"):
+        return "interpret"
+    if raw in ("pallas", "force"):
+        return "pallas"
+    # "", "1", "on", "true", "auto": backend-resolved below
+    return "auto"
+
+
+def kernel_mode() -> str:
+    """Effective kernel dispatch mode: ``"xla"`` | ``"pallas"`` |
+    ``"interpret"`` (see module docstring for the ``TMOG_PALLAS`` table).
+
+    Resolved at call time — which for jitted programs means trace time; the
+    choice is baked into the traced program and isolated per mode by
+    ``cache_token()`` riding every executable-cache key and plan
+    fingerprint."""
+    if _FORCED is not None:
+        return _FORCED
+    mode = _env_mode()
+    if mode != "auto":
+        return mode
+    import jax
+
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+@contextmanager
+def force_kernel_mode(mode: str):
+    """Pin the dispatch mode for a ``with`` block (parity tests: run the
+    same growth once per mode and compare).  Not re-entrant across threads —
+    test-only, like the planner's ``fused=`` overrides."""
+    global _FORCED
+    if mode not in ("xla", "pallas", "interpret"):
+        raise ValueError(f"unknown kernel mode {mode!r}")
+    prev = _FORCED
+    _FORCED = mode
+    try:
+        yield
+    finally:
+        _FORCED = prev
+
+
+def cache_token() -> str:
+    """Kernel-choice component of every program cache key / plan
+    fingerprint.  Distinct per effective mode so executables never alias
+    across dispatch modes (acceptance: ISSUE 10).  In compiled-Pallas mode
+    the VMEM admission budget rides the token too: the budget decides which
+    call sites trace the kernel vs the XLA fallback, so two budgets are two
+    program families even at one mode."""
+    mode = kernel_mode()
+    if mode == "pallas":
+        return f"kernels:pallas:vmem={vmem_budget()}"
+    return f"kernels:{mode}"
+
+
+def vmem_budget() -> int:
+    return tuning_int("TMOG_PALLAS_VMEM_BUDGET", _DEFAULT_VMEM_BUDGET)
+
+
+def _admit(working_set_bytes: int) -> Optional[str]:
+    """Mode for a kernel whose VMEM working set is ``working_set_bytes``:
+    None = run the XLA reference path."""
+    mode = kernel_mode()
+    if mode == "xla":
+        return None
+    if mode == "pallas" and working_set_bytes > vmem_budget():
+        return None
+    return mode
+
+
+def hist_mode(m_rows: int, bd_cols: int, chunk: int, lanes_bytes_per_row: int,
+              elem_bytes: int = 1) -> Optional[str]:
+    """Dispatch decision for the histogram kernel: the VMEM working set is
+    the (M, B*d) accumulator + the per-chunk (M, chunk) activation +
+    (chunk, B*d) bin one-hot + streamed operand blocks.  ``elem_bytes`` is
+    the MXU dtype width of the one-hot operands (1 = int8-exact, 2 = bf16,
+    4 = f32) — undersizing it would admit shapes that fail to compile
+    instead of falling back."""
+    ws = (m_rows * bd_cols * 4                  # accumulator (f32/int32)
+          + m_rows * chunk * elem_bytes         # activation
+          + chunk * bd_cols * elem_bytes        # bin one-hot
+          + chunk * lanes_bytes_per_row)        # local + gh + codes blocks
+    return _admit(ws)
+
+
+def split_mode(per_lane_hist_bytes: int) -> Optional[str]:
+    """Dispatch decision for the split-scan kernel (grid over lanes: one
+    (nn, 2K, d, B) histogram block + its cumsums resident per step)."""
+    return _admit(4 * per_lane_hist_bytes)
+
+
+def encode_mode(width: int, block_rows: int = 1024) -> Optional[str]:
+    """Dispatch decision for the serving encode kernels; degenerate widths
+    stay on the XLA path (zero-column outputs are host-shape plumbing, not
+    a kernel)."""
+    if width <= 0:
+        return None
+    return _admit(2 * block_rows * (width + 2) * 4)
+
+
+def kernel_provenance() -> Dict[str, Any]:
+    """Dispatch + tuning snapshot for BENCH JSON provenance.
+
+    ``hist_chunk``/``hist_unroll`` report the values BOUND into
+    models/trees.py (import-time env resolution, the values traced programs
+    actually used — incl. test monkeypatches), falling back to a live env
+    read only when the trees module is absent."""
+    prov = {
+        "kernel_mode": kernel_mode(),
+        "tmog_pallas": os.environ.get("TMOG_PALLAS", ""),
+        "hist_chunk": tuning_int("TMOG_HIST_CHUNK", HIST_CHUNK_DEFAULT),
+        "hist_unroll": tuning_int("TMOG_HIST_UNROLL", HIST_UNROLL_DEFAULT),
+        "pallas_vmem_budget": vmem_budget(),
+    }
+    try:
+        from ...models import trees as _trees
+
+        prov["hist_chunk"] = int(_trees._HIST_CHUNK)
+        prov["hist_unroll"] = int(_trees._HIST_UNROLL)
+    except Exception:  # pragma: no cover — trees not importable
+        pass
+    return prov

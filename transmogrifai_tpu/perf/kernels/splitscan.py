@@ -1,0 +1,181 @@
+"""Fused split-scan: bin cumsums + XGBoost gain + argmax, kernel + reference.
+
+Reference capability (SURVEY §2.9): XGBoost's ``EnumerateSplit`` over the
+built histograms — prefix-sum the per-bin grad/hess, score every
+(feature, bin) candidate with the second-order gain formula (L2
+``reg_lambda``, L1 ``alpha`` soft-threshold, complexity ``gamma``,
+``min_child_weight``), try missing values on both sides, and argmax.
+
+This module holds the ONE definition of that math for the TPU port:
+
+- :func:`split_scan_xla` — the formulation ``models/trees.py`` historically
+  inlined per level, moved here verbatim so the XLA path, the Pallas
+  kernel, the parity tests, and the bench baseline all share it;
+- :func:`split_scan_pallas` — the fused kernel: grid over lanes, each step
+  holds one lane's (nn, 2K, d, B) histogram block in VMEM and produces the
+  per-node best split index / gain / missing-direction without any of the
+  intermediate (L, nodes, d, bins) gain tensors touching HBM — the
+  histogram epilogue fused to its decision;
+- :func:`split_scan` — the dispatcher (``perf.kernels.dispatch`` mode +
+  VMEM admission).
+
+Selection parity: the kernel runs the same jnp ops in the same order as the
+reference (cumsum, gain, argmax); the only formulation difference is
+gather-free best-element selection (a masked max picks the identical
+element exactly).  On the exact-int8 histogram path every operand of the
+gain formula is an integer-valued f32, so gains — and therefore split
+decisions — are bitwise-identical across paths (tier-1 pinned,
+tests/test_kernels.py).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import dispatch as _dispatch
+
+
+def soft_threshold(g, alpha):
+    """XGBoost L1 shrinkage on the gradient sum (shared with trees' leaf
+    values — one definition, models/trees.py aliases it)."""
+    return jnp.sign(g) * jnp.maximum(jnp.abs(g) - alpha, 0.0)
+
+
+def _gain_terms(gl, hl, Gt, Ht, reg_lambda, alpha, gamma, min_child_weight,
+                class_axis: int):
+    """Gain of every (feature, bin) candidate given left sums ``gl``/``hl``;
+    the trees formula verbatim (eps guards empty children as zero gain)."""
+    gr, hr = Gt - gl, Ht - hl
+    ok = (hl.mean(class_axis) >= min_child_weight) \
+        & (hr.mean(class_axis) >= min_child_weight)
+    eps = 1e-12
+    raw = (soft_threshold(gl, alpha) ** 2 / (hl + reg_lambda + eps)
+           + soft_threshold(gr, alpha) ** 2 / (hr + reg_lambda + eps)
+           - soft_threshold(Gt, alpha) ** 2 / (Ht + reg_lambda + eps))
+    raw = raw.sum(axis=class_axis)
+    return jnp.where(ok, 0.5 * raw - gamma, -jnp.inf)
+
+
+def split_scan_xla(hist_g, hist_h, G, H, level_mask, n_bins: int,
+                   reg_lambda, alpha, gamma, min_child_weight
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Reference split search over (L, nn, K, d, B) histograms.
+
+    Returns (best flat (feature, bin) index (L, nn) int32, best gain
+    (L, nn) f32, missing-goes-left (L, nn) bool).  ``level_mask`` is the
+    (L, d) 1/0 feature mask (colsample); masked features score -inf.
+    """
+    L, nn = hist_g.shape[:2]
+    gl = jnp.cumsum(hist_g[..., :n_bins], axis=-1)[..., :-1]
+    hl = jnp.cumsum(hist_h[..., :n_bins], axis=-1)[..., :-1]
+    g_miss = hist_g[..., n_bins][..., None]
+    h_miss = hist_h[..., n_bins][..., None]
+    Gt = G[..., None, None]
+    Ht = H[..., None, None]
+    args = (reg_lambda, alpha, gamma, min_child_weight)
+    gain_mr = _gain_terms(gl, hl, Gt, Ht, *args, class_axis=2)
+    gain_ml = _gain_terms(gl + g_miss, hl + h_miss, Gt, Ht, *args,
+                          class_axis=2)
+    gain = jnp.maximum(gain_mr, gain_ml)
+    gain = jnp.where(level_mask[:, None, :, None] > 0, gain, -jnp.inf)
+
+    flat = gain.reshape(L, nn, -1)
+    best = flat.argmax(axis=-1).astype(jnp.int32)
+    best_gain = jnp.take_along_axis(flat, best[..., None], -1)[..., 0]
+    ml_flat = gain_ml.reshape(L, nn, -1)
+    mr_flat = gain_mr.reshape(L, nn, -1)
+    bml = jnp.take_along_axis(ml_flat, best[..., None], -1)[..., 0] >= \
+        jnp.take_along_axis(mr_flat, best[..., None], -1)[..., 0]
+    return best, best_gain, bml
+
+
+def split_scan_pallas(hist_g, hist_h, G, H, level_mask, n_bins: int,
+                      reg_lambda, alpha, gamma, min_child_weight, *,
+                      interpret: bool = False
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused per-lane split scan; same contract as :func:`split_scan_xla`."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    L, nn, K, d, B = hist_g.shape
+    F = d * (n_bins - 1)
+    params = jnp.stack([
+        jnp.asarray(reg_lambda, jnp.float32),
+        jnp.asarray(alpha, jnp.float32),
+        jnp.asarray(gamma, jnp.float32),
+        jnp.asarray(min_child_weight, jnp.float32)]).reshape(1, 4)
+
+    def kernel(hg_ref, hh_ref, g_ref, h_ref, mask_ref, p_ref,
+               best_ref, gain_ref, bml_ref):
+        hg = hg_ref[0]                                      # (nn, K, d, B)
+        hh = hh_ref[0]
+        reg_l, alph = p_ref[0, 0], p_ref[0, 1]
+        gam, mcw = p_ref[0, 2], p_ref[0, 3]
+        gl = jnp.cumsum(hg[..., :n_bins], axis=-1)[..., :-1]
+        hl = jnp.cumsum(hh[..., :n_bins], axis=-1)[..., :-1]
+        g_miss = hg[..., n_bins][..., None]
+        h_miss = hh[..., n_bins][..., None]
+        Gt = g_ref[0][..., None, None]                      # (nn, K, 1, 1)
+        Ht = h_ref[0][..., None, None]
+        args = (reg_l, alph, gam, mcw)
+        gain_mr = _gain_terms(gl, hl, Gt, Ht, *args, class_axis=1)
+        gain_ml = _gain_terms(gl + g_miss, hl + h_miss, Gt, Ht, *args,
+                              class_axis=1)
+        gain = jnp.maximum(gain_mr, gain_ml)
+        gain = jnp.where(mask_ref[0][None, :, None] > 0, gain, -jnp.inf)
+
+        flat = gain.reshape(nn, F)
+        best = flat.argmax(axis=-1).astype(jnp.int32)
+        # gather-free selection: the masked max picks the exact element
+        col = jax.lax.broadcasted_iota(jnp.int32, (nn, F), 1)
+        sel = col == best[:, None]
+        gain_ref[0] = jnp.max(jnp.where(sel, flat, -jnp.inf), axis=-1)
+        sel_ml = jnp.max(jnp.where(sel, gain_ml.reshape(nn, F), -jnp.inf),
+                         axis=-1)
+        sel_mr = jnp.max(jnp.where(sel, gain_mr.reshape(nn, F), -jnp.inf),
+                         axis=-1)
+        best_ref[0] = best
+        bml_ref[0] = (sel_ml >= sel_mr).astype(jnp.int8)
+
+    hist_spec = pl.BlockSpec((1, nn, K, d, B), lambda l: (l, 0, 0, 0, 0),
+                             memory_space=pltpu.VMEM)
+    gh_spec = pl.BlockSpec((1, nn, K), lambda l: (l, 0, 0),
+                           memory_space=pltpu.VMEM)
+    out_spec = pl.BlockSpec((1, nn), lambda l: (l, 0),
+                            memory_space=pltpu.VMEM)
+    best, best_gain, bml = pl.pallas_call(
+        kernel,
+        grid=(L,),
+        in_specs=[
+            hist_spec, hist_spec, gh_spec, gh_spec,
+            pl.BlockSpec((1, d), lambda l: (l, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 4), lambda l: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=(out_spec, out_spec, out_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((L, nn), jnp.int32),
+            jax.ShapeDtypeStruct((L, nn), jnp.float32),
+            jax.ShapeDtypeStruct((L, nn), jnp.int8),
+        ),
+        interpret=bool(interpret),
+    )(hist_g, hist_h, G, H, level_mask, params)
+    return best, best_gain, bml != 0
+
+
+def split_scan(hist_g, hist_h, G, H, level_mask, n_bins: int,
+               reg_lambda, alpha, gamma, min_child_weight
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Dispatching split scan (the entry ``models/trees.py`` calls)."""
+    per_lane = int(hist_g.size // hist_g.shape[0]) * 8  # g+h blocks, f32
+    mode = _dispatch.split_mode(per_lane)
+    if mode is not None:
+        return split_scan_pallas(
+            hist_g, hist_h, G, H, level_mask, n_bins, reg_lambda, alpha,
+            gamma, min_child_weight, interpret=mode == "interpret")
+    return split_scan_xla(hist_g, hist_h, G, H, level_mask, n_bins,
+                          reg_lambda, alpha, gamma, min_child_weight)

@@ -146,6 +146,8 @@ def _mesh_sig():
 def _make_key(fn, args, kwargs: Dict[str, Any], statics: Dict[str, Any],
               key_extras: Dict[str, Any]) -> Tuple[tuple, str, str]:
     """(in-memory key, stable fingerprint, shapes summary)."""
+    from .kernels.dispatch import cache_token
+
     src_fp = _source_fingerprint(fn)
     arg_sigs = tuple(_arg_sig(a) for a in args)
     kwarg_sigs = tuple(sorted((k, _arg_sig(v)) for k, v in kwargs.items()))
@@ -155,7 +157,11 @@ def _make_key(fn, args, kwargs: Dict[str, Any], statics: Dict[str, Any],
         (k, _static_item_sig(v)) for k, v in key_extras.items()))
     mesh = _mesh_sig()
     name = f"{getattr(fn, '__module__', '?')}.{getattr(fn, '__qualname__', repr(fn))}"
-    stable = (name, src_fp, arg_sigs, kwarg_sigs, static_sig, extra_sig, mesh)
+    # the kernel dispatch mode (perf/kernels/dispatch.py) is baked into every
+    # traced program, so it keys EVERY executable: flipping TMOG_PALLAS can
+    # never serve a stale executable compiled for the other dispatch mode
+    stable = (name, src_fp, arg_sigs, kwarg_sigs, static_sig, extra_sig,
+              mesh, cache_token())
     fp = hashlib.blake2b(repr(stable).encode(), digest_size=16).hexdigest()
     # the in-memory key also carries the function OBJECT (jit-cache
     # semantics): two closures from one factory share source but bake in
@@ -201,6 +207,15 @@ def run_cached(fn, *args, kwargs: Optional[Dict[str, Any]] = None,
     later calls dispatch straight into the cached executable.  Falls back to
     a plain ``fn`` call when AOT lowering is unsupported for the given
     operands (stat: ``fallbacks``).
+
+    Caveat on the fallback path only: ``fn``'s own jit cache keys on
+    avals/statics, NOT on the kernel dispatch token, so a program that
+    negative-cached under one ``TMOG_PALLAS`` mode and is re-called under
+    another in the SAME process serves the first mode's jit executable.
+    The AOT path (every program in practice — ``fallbacks`` counts the
+    exceptions) is fully mode-keyed; in-process mode flips are a test-only
+    pattern and the kernel parity tests call the kernel entry points
+    directly.
     """
     kwargs = kwargs or {}
     statics = statics or {}

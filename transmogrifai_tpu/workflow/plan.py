@@ -188,6 +188,7 @@ def stage_content_fingerprint(stages: Sequence[Any],
     counter, NOT id() — recycled ids would let a new plan inherit a dead
     plan's executables).
     """
+    from ..perf.kernels.dispatch import cache_token
     from ..stages.base import Estimator
     from .serde import _Encoder, encode_stage
 
@@ -197,6 +198,10 @@ def stage_content_fingerprint(stages: Sequence[Any],
             "stages": [encode_stage(s, enc, full=not isinstance(s, Estimator))
                        for s in stages],
             "extra": extra or {},
+            # kernel dispatch mode (perf/kernels/dispatch.py): encode/
+            # bucketize stages trace to Pallas or XLA kernels depending on
+            # it, so plans in different modes must never share executables
+            "kernels": cache_token(),
         }
         h = hashlib.sha256(
             json.dumps(payload, sort_keys=True, default=repr).encode())
