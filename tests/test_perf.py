@@ -364,6 +364,19 @@ class TestBenchSmoke:
         assert sv["degraded_backend_compiles"] == 0, sv
         assert sv["degraded_host_rps"] > 0 and sv["throughput_rps"] > 0
         assert sv["degraded_fallback_records"] == sv["records"], sv
+        # unified telemetry (ISSUE 11): enabled-vs-disabled serve overhead
+        # at identical fixtures gates < 5% (paired-median protocol), and a
+        # warm replay with the flight recorder attached logs ZERO backend
+        # compile events
+        assert secs["obs"]["status"] == "ok", secs["obs"]
+        ob = parsed["obs"]
+        assert ob["gate_overhead_lt_5pct"] is True, ob
+        assert ob["gate_zero_warm_compiles"] is True, ob
+        assert ob["warm_serve_backend_compiles"] == 0, ob
+        assert ob["flight_compile_events"] == 0, ob
+        assert ob["unexpected_compiles"] == 0, ob
+        assert ob["disabled_rps"] > 0 and ob["enabled_rps"] > 0
+        assert ob["trace_events"] > 0  # the tracer actually recorded spans
         # continual control plane (ISSUE 9): the stream section pushes
         # records through drift-check + shadow-score, and the frozen-prep
         # warm refit must recompile NOTHING (plan cache + sweep executable

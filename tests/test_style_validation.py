@@ -98,7 +98,10 @@ class TestStyleValidation:
         shared-mutable-state shape TM306 exists to police; perf/kernels/
         joined with the Pallas dispatch layer (ISSUE 10) — kernel bodies and
         the dispatch-mode state are hot-path code the default gate never
-        named."""
+        named; obs/ joined with the unified telemetry backbone (ISSUE 11) —
+        the process-global tracer/recorder installs and the metrics
+        registry are exactly the module-level-mutable-state pattern TM306
+        exists for, and every span site is hot-path code."""
         from transmogrifai_tpu.checkers.opcheck import (
             lint_file,
             lint_file_concurrency,
@@ -106,7 +109,7 @@ class TestStyleValidation:
 
         findings = []
         for sub in ("serve", "perf", "perf/kernels", "checkers", "cli",
-                    "workflow", "readers"):
+                    "workflow", "readers", "obs"):
             d = os.path.join(PKG_ROOT, sub)
             for f in sorted(os.listdir(d)):
                 if not f.endswith(".py"):

@@ -29,6 +29,10 @@ Code families:
   (workflow/continual.py): covariate drift against the train-time
   snapshot (PSI / mean shift / missing rate), refit failures, shadow
   promotion-gate refusals, swap commits, and post-swap rollbacks
+- ``TM9xx`` telemetry    — runtime observability findings (obs/): an
+  unexpected backend recompile observed by the flight recorder inside a
+  path declared warm (the dynamic counterpart of the TM602 static
+  recompile-hazard map)
 """
 
 from __future__ import annotations
@@ -273,6 +277,15 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "new backend compiles were observed; check that the prep "
               "stages are really frozen and the refit window pads to an "
               "already-compiled bucket"),
+    # -- telemetry (flight recorder, obs/flight.py) -------------------------
+    "TM901": (Severity.WARNING, "unexpected backend recompile in warm path",
+              "a backend compilation fired inside a path declared warm (a "
+              "warmed serving plan or a frozen-prep refit) — the plan/"
+              "executable caches were expected to serve it at zero "
+              "compiles; check the flight-recorder compile event's site + "
+              "fingerprint against the TM602 static recompile-hazard map "
+              "(an unkeyed shape/static, a cache eviction, or prep that is "
+              "not actually frozen)"),
     # -- leakage ------------------------------------------------------------
     "TM401": (Severity.ERROR, "label leaks into feature path",
               "a response(-derived) feature reaches the model's feature input "

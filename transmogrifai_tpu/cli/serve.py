@@ -100,6 +100,28 @@ def add_serve_parser(sub) -> None:
     p.add_argument("--checkpoint-dir", default=None,
                    help="atomic model checkpoint directory for promoted "
                         "refits (CURRENT pointer names last-known-good)")
+    # -- unified telemetry (obs/, docs/observability.md) --------------------
+    p.add_argument("--telemetry", default=None, metavar="DIR",
+                   help="enable the obs telemetry backbone and write "
+                        "trace.json (Chrome trace, Perfetto-loadable), "
+                        "flight.json (flight-recorder dump), metrics.jsonl "
+                        "and metrics.prom under DIR on exit (default: the "
+                        "TMOG_TELEMETRY env var; unset = telemetry off)")
+    p.add_argument("--trace-detail", default="batch",
+                   choices=("batch", "requests"),
+                   help="trace granularity on the serve path: per-batch "
+                        "spans (default) or additionally one instant event "
+                        "per enqueued request")
+    p.add_argument("--snapshot-interval", type=float, default=None,
+                   metavar="SECONDS",
+                   help="follow mode: emit a metrics-snapshot JSONL line at "
+                        "least this many seconds apart (0 = every batch) "
+                        "alongside the scores; scoring output and offset "
+                        "commits are unaffected")
+    p.add_argument("--snapshots-out", default=None,
+                   help="destination for the periodic metrics-snapshot "
+                        "JSONL lines (default: metrics.jsonl in the "
+                        "--telemetry dir, else stderr)")
 
 
 def _read_records(path: str) -> Tuple[List[Dict[str, Any]], int]:
@@ -136,11 +158,30 @@ def _resolve(future) -> Tuple[Dict[str, Any], bool]:
         return {"error": str(e), "error_type": type(e).__name__}, False
 
 
+def _resolve_cli_telemetry(ns):
+    """The CLI's Telemetry bundle (or None): --telemetry DIR wins, else the
+    TMOG_TELEMETRY env var — BOTH honor --trace-detail."""
+    import os
+
+    from ..obs import TELEMETRY_ENV, Telemetry, telemetry_active
+
+    out_dir = ns.telemetry or os.environ.get(TELEMETRY_ENV, "")
+    if not out_dir or (not ns.telemetry and telemetry_active()):
+        return None  # off, or an outer session already owns telemetry
+    return Telemetry(out_dir=out_dir,
+                     detail=getattr(ns, "trace_detail", "batch"))
+
+
 def _run_follow(ns, model) -> int:
     """Follow mode: drive the micro-batch streaming reader end-to-end —
     tail the JSONL file, score every batch through the server, write one
     JSON row per record, commit offsets AFTER the rows are written, and
-    (with ``--refit``) run the drift-gated continual retrain loop."""
+    (with ``--refit``) run the drift-gated continual retrain loop.
+
+    Observability: ``--snapshot-interval`` emits a periodic metrics-snapshot
+    JSONL line (canonical registry names + stream progress) to
+    ``--snapshots-out`` / the telemetry dir / stderr — a long-running loop
+    is inspectable without disturbing scores or offsets."""
     from ..readers import (JsonlTailSource, MicroBatchStreamingReader,
                            OffsetCheckpoint)
     from ..serve import ScoringServer
@@ -167,6 +208,43 @@ def _run_follow(ns, model) -> int:
     out = sys.stdout if ns.output == "-" else open(ns.output, "a")
     errors = 0
 
+    tel = _resolve_cli_telemetry(ns)
+
+    # periodic metrics-snapshot stream: its own sink (never the scores file)
+    snap_fh = None
+    snap_close = False
+    if ns.snapshot_interval is not None:
+        if ns.snapshots_out:
+            snap_fh, snap_close = open(ns.snapshots_out, "a"), True
+        elif tel is not None and tel.out_dir:
+            import os as _os
+
+            _os.makedirs(tel.out_dir, exist_ok=True)
+            snap_fh = open(_os.path.join(tel.out_dir, "metrics.jsonl"), "a")
+            snap_close = True
+        else:
+            snap_fh = sys.stderr
+    snap_state = {"last": 0.0, "server": None, "trainer": None, "lines": 0}
+
+    def _maybe_snapshot():
+        import time as _time
+
+        now = _time.monotonic()
+        if now - snap_state["last"] < (ns.snapshot_interval or 0.0):
+            return
+        snap_state["last"] = now
+        server = snap_state["server"]
+        trainer = snap_state["trainer"]
+        if server is None:
+            return
+        extra = {"type": "metrics_snapshot"}
+        if trainer is not None:
+            extra["continual"] = trainer.counters
+        # one serializer for the snapshot line format (obs/metrics.py)
+        server.registry.write_jsonl(snap_fh, extra=extra)
+        snap_fh.flush()
+        snap_state["lines"] += 1
+
     def on_batch(_records, results):
         nonlocal errors
         for r in results:
@@ -174,6 +252,8 @@ def _run_follow(ns, model) -> int:
                 errors += 1
             out.write(json.dumps(r, default=str) + "\n")
         out.flush()
+        if snap_fh is not None:
+            _maybe_snapshot()
 
     detector = None
     if ns.baseline:
@@ -182,13 +262,18 @@ def _run_follow(ns, model) -> int:
                                  min_records=ns.drift_min_records)
     refit = RefitController(model, checkpoint_dir=ns.checkpoint_dir) \
         if ns.refit else None
+    metrics: Dict[str, Any] = {}
+    prom = None
     try:
+        if tel is not None:
+            tel.start()
         with ScoringServer(model, max_batch=ns.max_batch,
                            max_wait_ms=ns.max_wait_ms,
                            max_queue=ns.max_queue, min_bucket=ns.min_bucket,
                            warm=not ns.no_warm,
                            resilience=not ns.no_resilience,
                            deadline_ms=ns.deadline_ms) as server:
+            snap_state["server"] = server
             trainer = ContinualTrainer(
                 server, model, reader,
                 detector=detector,
@@ -203,12 +288,24 @@ def _run_follow(ns, model) -> int:
                 # --refit off: the loop still streams, scores, commits, and
                 # tracks drift statistics — it just never retrains
                 refit_enabled=ns.refit)
+            snap_state["trainer"] = trainer
             metrics = trainer.run()
             metrics["server"] = server.metrics()
             metrics["skipped_malformed"] = source.skipped_malformed
+            metrics["metrics_snapshots_emitted"] = snap_state["lines"]
+            prom = server.prometheus()
     finally:
+        # dump INSIDE the finally: a crashed follow loop is exactly when
+        # the flight-recorder postmortem matters most
+        if tel is not None:
+            tel.stop()
+            tel.dump(metrics_payload={"source": "cli serve --follow",
+                                      "metrics": metrics},
+                     prometheus=prom)
         if out is not sys.stdout:
             out.close()
+        if snap_close and snap_fh is not None:
+            snap_fh.close()
     blob = json.dumps(metrics, indent=2, default=str)
     if ns.metrics_out:
         with open(ns.metrics_out, "w") as fh:
@@ -232,28 +329,44 @@ def run_serve(ns) -> int:
     from ..serve import QueueFullError
 
     errors = 0
-    with ScoringServer(model, max_batch=ns.max_batch,
-                       max_wait_ms=ns.max_wait_ms, max_queue=ns.max_queue,
-                       min_bucket=ns.min_bucket, warm=not ns.no_warm,
-                       resilience=not ns.no_resilience,
-                       deadline_ms=ns.deadline_ms) as server:
-        futures: deque = deque()
-        results = []
-        for r in records:
-            while True:
-                try:
-                    futures.append(server.submit(r))
-                    break
-                except QueueFullError:
-                    # backpressure: wait for the oldest in-flight request
-                    row, ok = _resolve(futures.popleft())
-                    errors += not ok
-                    results.append(row)
-        for f in futures:
-            row, ok = _resolve(f)
-            errors += not ok
-            results.append(row)
-        metrics = server.metrics()
+    tel = _resolve_cli_telemetry(ns)
+    metrics: Dict[str, Any] = {}
+    prom = None
+    try:
+        if tel is not None:
+            tel.start()
+        with ScoringServer(model, max_batch=ns.max_batch,
+                           max_wait_ms=ns.max_wait_ms,
+                           max_queue=ns.max_queue,
+                           min_bucket=ns.min_bucket, warm=not ns.no_warm,
+                           resilience=not ns.no_resilience,
+                           deadline_ms=ns.deadline_ms) as server:
+            futures: deque = deque()
+            results = []
+            for r in records:
+                while True:
+                    try:
+                        futures.append(server.submit(r))
+                        break
+                    except QueueFullError:
+                        # backpressure: wait for the oldest in-flight request
+                        row, ok = _resolve(futures.popleft())
+                        errors += not ok
+                        results.append(row)
+            for f in futures:
+                row, ok = _resolve(f)
+                errors += not ok
+                results.append(row)
+            metrics = server.metrics()
+            prom = server.prometheus()
+    finally:
+        # dump INSIDE the finally: a crashed replay is exactly when the
+        # flight-recorder postmortem matters most
+        if tel is not None:
+            tel.stop()
+            tel.dump(metrics_payload={"source": "cli serve",
+                                      "metrics": metrics},
+                     prometheus=prom)
     metrics["replay"] = {"records": len(records),
                          "skipped_malformed": skipped,
                          "record_errors": errors}

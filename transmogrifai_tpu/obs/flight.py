@@ -1,0 +1,281 @@
+"""Flight recorder — a bounded ring buffer of structured serving/refit
+events, dumpable to JSON on demand and automatically on injected faults.
+
+Reference role: aviation flight recorders answer "*why* did it crash" from
+the last N minutes of structured state; the serving analogue (Clipper's
+instrumentation layer, NSDI'17 §5) answers "why was this request slow / why
+did that swap roll back" without re-running anything.  The recorder keeps
+the newest ``capacity`` events of:
+
+- ``backend_compile`` — every XLA backend compilation (via the same
+  ``jax.monitoring`` probe perf/timers.py counts), tagged with the
+  :func:`compile_context` active at the compile site: the plan/executable
+  fingerprint and whether the path was EXPECTED to be warm.  A compile
+  inside a warm context is an *unexpected recompile*: the event is flagged
+  and a typed **TM901** diagnostic is recorded (closing the loop with the
+  TM602 static recompile-hazard map — the static analyzer predicts where
+  recompiles CAN happen; the recorder catches one that DID).
+- ``breaker_transition`` — circuit-breaker state changes
+  (serve/resilience.py).
+- ``swap`` / ``rollback`` — blue/green promotions and restores with their
+  plan fingerprints (serve/swap.py).
+- ``drift`` — drift evaluations that fired TM801-TM803
+  (workflow/continual.py).
+- ``quarantine`` / ``dead_letter`` — poison-record isolation outcomes.
+- ``fault_injected`` — every failure the deterministic
+  :class:`~..serve.faults.FaultHarness` injected; when the recorder has a
+  ``dump_dir``, each injected fault auto-dumps the ring buffer (bounded
+  count), so the harness run leaves a postmortem artifact without test
+  plumbing.
+
+Like the fault harness, the recorder is process-global while installed (the
+batcher flusher is another thread, so a contextvar would not reach it);
+:func:`compile_context` — the *tagging* side — IS contextvar-based and
+inherits the warm expectation from enclosing contexts, so a warm-refit
+context marks compiles as unexpected even when an inner dispatch layer
+opens its own context.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+_DEFAULT_CAPACITY = 4096
+#: at most this many automatic fault dumps per recorder (a scripted storm
+#: of faults must not write unbounded files)
+_MAX_AUTO_DUMPS = 8
+
+#: jax.monitoring event carrying one backend compilation
+_EV_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+
+#: stack of compile-site tags; the innermost entry names the site, the warm
+#: expectation is inherited (sticky) from every enclosing entry
+_COMPILE_CTX: "contextvars.ContextVar[tuple]" = contextvars.ContextVar(
+    "transmogrifai_tpu_obs_compile_ctx", default=())
+
+
+@contextlib.contextmanager
+def compile_context(site: str, fingerprint: Optional[str] = None,
+                    warm: bool = False):
+    """Tag backend compiles performed inside the block.
+
+    ``fingerprint`` is the plan/executable content fingerprint of the
+    program being compiled; ``warm=True`` declares the path is expected to
+    be compile-free (a warm refit, a warmed serving plan) — a compile seen
+    under it records as unexpected (TM901).  Contexts nest; the warm flag
+    is inherited from enclosing contexts, and a missing fingerprint falls
+    back to the enclosing one.
+    """
+    stack = _COMPILE_CTX.get()
+    if stack:
+        warm = warm or stack[-1]["warm"]
+        if fingerprint is None:
+            fingerprint = stack[-1]["fingerprint"]
+    entry = {"site": site, "fingerprint": fingerprint, "warm": warm}
+    token = _COMPILE_CTX.set(stack + (entry,))
+    try:
+        yield
+    finally:
+        _COMPILE_CTX.reset(token)
+
+
+def current_compile_context() -> Optional[Dict[str, Any]]:
+    stack = _COMPILE_CTX.get()
+    return dict(stack[-1]) if stack else None
+
+
+class FlightRecorder:
+    """Bounded structured event log with JSON dumps.
+
+    ``dump_dir`` enables automatic dumps: one JSON file per
+    fault-harness-injected failure (bounded), plus :meth:`dump` on demand.
+    """
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY,
+                 dump_dir: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._events: "deque[dict]" = deque(maxlen=int(capacity))
+        self._seq = 0
+        self.dropped = 0
+        self.dump_dir = dump_dir
+        self._auto_dumps = 0
+        self.unexpected_compiles = 0
+        #: bounded TM901 findings (dict form; .diagnostics() types them)
+        self._diags: "deque[dict]" = deque(maxlen=64)
+
+    # -- recording -----------------------------------------------------------
+    def record(self, kind: str, **data) -> None:
+        ev = {"seq": None, "ts": round(time.time(), 6), "kind": kind,
+              "data": data}
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(ev)
+
+    def _on_backend_compile(self, ctx: Optional[Dict[str, Any]],
+                            seconds: float) -> None:
+        ctx = ctx or {"site": "untracked", "fingerprint": None,
+                      "warm": False}
+        unexpected = bool(ctx["warm"])
+        self.record("backend_compile", site=ctx["site"],
+                    fingerprint=ctx["fingerprint"],
+                    seconds=round(seconds, 4), unexpected=unexpected)
+        if unexpected:
+            fp = ctx["fingerprint"]
+            msg = (f"unexpected backend compile in warm path "
+                   f"{ctx['site']!r}"
+                   + (f" (plan fingerprint {fp[:16]})" if fp else "")
+                   + f": {seconds:.3f}s — the executable/plan caches were "
+                   "expected to serve this path at zero compiles")
+            with self._lock:
+                self.unexpected_compiles += 1
+                self._diags.append({"code": "TM901", "message": msg,
+                                    "location": ctx["site"]})
+            log.warning("TM901 %s", msg)
+
+    def on_fault_injected(self, point: str, error: str) -> None:
+        """Record an injected fault; auto-dump when a dump_dir is set."""
+        self.record("fault_injected", point=point, error=error)
+        if self.dump_dir is None:
+            return
+        with self._lock:
+            if self._auto_dumps >= _MAX_AUTO_DUMPS:
+                return
+            self._auto_dumps += 1
+            n = self._auto_dumps
+        try:
+            self.dump(os.path.join(self.dump_dir,
+                                   f"flight-fault-{n:03d}.json"),
+                      reason=f"fault_injected:{point}")
+        except OSError as e:  # pragma: no cover — disk trouble only
+            log.warning("flight auto-dump failed: %s", e)
+
+    # -- introspection -------------------------------------------------------
+    def events(self, kind: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            evs = [dict(ev) for ev in self._events]
+        if kind is not None:
+            evs = [ev for ev in evs if ev["kind"] == kind]
+        return evs
+
+    def diagnostics(self) -> List[Any]:
+        """The recorded TM901 findings as typed Diagnostics."""
+        from ..checkers.diagnostics import make_diagnostic
+
+        with self._lock:
+            raw = list(self._diags)
+        return [make_diagnostic(d["code"], d["message"],
+                                location=d["location"]) for d in raw]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    # -- export --------------------------------------------------------------
+    def to_payload(self, reason: str = "on_demand") -> Dict[str, Any]:
+        """JSON-able dump payload with stable key ordering."""
+        with self._lock:
+            events = [dict(ev) for ev in self._events]
+            diags = list(self._diags)
+        return {"dropped": self.dropped,
+                "dumped_at": round(time.time(), 3),
+                "events": events,
+                "reason": reason,
+                "tm_diagnostics": diags,
+                "unexpected_compiles": self.unexpected_compiles}
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "on_demand") -> str:
+        if path is None:
+            if self.dump_dir is None:
+                raise ValueError("no path given and no dump_dir configured")
+            path = os.path.join(self.dump_dir, "flight.json")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(self.to_payload(reason), fh, sort_keys=True,
+                      default=str)
+        return path
+
+
+#: the one installed recorder (process-global, like the fault harness: the
+#: scoring threads must reach it without contextvar propagation)
+_RECORDER: Optional[FlightRecorder] = None
+_RECORDER_LOCK = threading.Lock()
+_LISTENER_REGISTERED = False
+
+
+def _on_duration_event(name: str, secs: float, **kw) -> None:
+    if name != _EV_BACKEND_COMPILE:
+        return
+    rec = _RECORDER
+    if rec is None:
+        return
+    # the monitoring listener runs synchronously on the compiling thread,
+    # so the contextvar tag set around .compile() is visible here
+    rec._on_backend_compile(current_compile_context(), secs)
+
+
+def _ensure_listener() -> None:
+    global _LISTENER_REGISTERED
+    if _LISTENER_REGISTERED:
+        return
+    with _RECORDER_LOCK:
+        if _LISTENER_REGISTERED:
+            return
+        try:
+            from jax import monitoring
+        except Exception:  # pragma: no cover — jax without monitoring
+            _LISTENER_REGISTERED = True
+            return
+        monitoring.register_event_duration_secs_listener(_on_duration_event)
+        _LISTENER_REGISTERED = True
+
+
+def install_recorder(recorder: FlightRecorder) -> FlightRecorder:
+    """Install ``recorder`` process-wide; raises if another is active."""
+    global _RECORDER
+    _ensure_listener()
+    with _RECORDER_LOCK:
+        if _RECORDER is not None:
+            raise RuntimeError("another FlightRecorder is already installed")
+        _RECORDER = recorder
+    return recorder
+
+
+def uninstall_recorder(recorder: Optional[FlightRecorder] = None) -> None:
+    global _RECORDER
+    with _RECORDER_LOCK:
+        if recorder is None or _RECORDER is recorder:
+            _RECORDER = None
+
+
+def active_recorder() -> Optional[FlightRecorder]:
+    return _RECORDER
+
+
+def record_event(kind: str, **data) -> None:
+    """Record into the installed recorder; disabled cost: one global read."""
+    rec = _RECORDER
+    if rec is None:
+        return
+    rec.record(kind, **data)
+
+
+def record_fault(point: str, error: BaseException) -> None:
+    """Hook for the fault harness: record + (configured) auto-dump."""
+    rec = _RECORDER
+    if rec is None:
+        return
+    rec.on_fault_injected(point, type(error).__name__)

@@ -1,0 +1,74 @@
+"""``TMOG_PROFILE=<dir>`` — opt-in ``jax.profiler`` capture of the fused
+sweep/serve dispatch.
+
+Reference role: the reference leans on Spark's UI for executor profiles;
+the TPU-native equivalent is the XLA profiler (xplane traces viewable in
+TensorBoard/XProf).  Setting ``TMOG_PROFILE`` to a directory wraps every
+fused dispatch (:func:`~..perf.programs.run_cached` executions and the
+compiled serving-plan device call) in ``jax.profiler`` start/stop; unset,
+the hook is a single ``os.environ`` read — no profiler import, no cost.
+
+Captures do not nest: when a trace is already in flight (an outer dispatch,
+another thread), inner dispatches run unprofiled instead of crashing the
+profiler — the artifact stays one capture per dispatch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+
+log = logging.getLogger(__name__)
+
+_LOCK = threading.Lock()
+_ACTIVE = False
+
+
+def profile_dir() -> str:
+    """The configured profile directory ('' when profiling is off)."""
+    return os.environ.get("TMOG_PROFILE", "")
+
+
+@contextlib.contextmanager
+def maybe_profile(tag: str):
+    """Wrap a dispatch in a ``jax.profiler`` capture when ``TMOG_PROFILE``
+    is set; otherwise (or when a capture is already active) a no-op.  The
+    traced computation is NEVER altered — a profiler failure logs and the
+    dispatch proceeds unprofiled, so the score path stays bitwise
+    identical."""
+    d = profile_dir()
+    if not d:
+        yield
+        return
+    global _ACTIVE
+    with _LOCK:
+        claimed = not _ACTIVE
+        if claimed:
+            _ACTIVE = True
+    started = False
+    if claimed:
+        try:
+            os.makedirs(d, exist_ok=True)
+            import jax
+
+            jax.profiler.start_trace(d)
+            started = True
+        except Exception as e:  # noqa: BLE001 — never break the dispatch
+            log.warning("TMOG_PROFILE capture (%s) failed to start: %s",
+                        tag, e)
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001 — never break the dispatch
+                log.warning("TMOG_PROFILE capture (%s) failed to stop: %s",
+                            tag, e)
+        if claimed:
+            with _LOCK:
+                _ACTIVE = False

@@ -39,6 +39,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..obs import flight as obs_flight
+from ..obs.profile import maybe_profile
 from .timers import measure_compiles, phase
 
 log = logging.getLogger(__name__)
@@ -240,6 +242,9 @@ def run_cached(fn, *args, kwargs: Optional[Dict[str, Any]] = None,
                 t0 = time.perf_counter()
                 try:
                     with phase(f"compile.{stats.label}"), \
+                            obs_flight.compile_context(
+                                f"perf.run_cached:{stats.label}",
+                                fingerprint=fp), \
                             measure_compiles() as delta:
                         compiled = fn.lower(*args, **kwargs,
                                             **statics).compile()
@@ -271,7 +276,8 @@ def run_cached(fn, *args, kwargs: Optional[Dict[str, Any]] = None,
                 return out
     with _LOCK:
         stats.hits += 1
-    return compiled(*args, **kwargs)
+    with maybe_profile("sweep"):  # TMOG_PROFILE hook; unset = one env read
+        return compiled(*args, **kwargs)
 
 
 def evict_program_entries(fns) -> int:

@@ -23,6 +23,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from ..obs import trace as obs_trace
+
 
 # ---------------------------------------------------------------------------
 # Phase timers
@@ -101,29 +103,52 @@ def record_phases(recorder: Optional[PhaseRecorder] = None):
         _RECORDERS.reset(token)
 
 
-@contextlib.contextmanager
-def phase(name: str):
-    """Time a phase.  No-op (zero overhead beyond a contextvar read) when no
-    recorder is active.  Phases nest: ``phase("fit")`` inside
-    ``phase("validate")`` records as path ``validate.fit``."""
-    recorders = _RECORDERS.get()
-    if not recorders:
-        yield
-        return
-    stack = _PHASE_STACK.get()
-    token = _PHASE_STACK.set(stack + (name,))
-    parts = stack + (name,)
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        _PHASE_STACK.reset(token)
-        for rec in recorders:
-            rel = parts[rec._base:]  # path relative to the recorder's base
+class _Phase:
+    """Slotted class-based context manager (cheaper than a generator CM on
+    both the active and no-op paths — phases sit on hot per-batch loops)."""
+
+    __slots__ = ("name", "recorders", "tracer", "token", "parts", "t0")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self) -> "_Phase":
+        recorders = _RECORDERS.get()
+        tracer = obs_trace.active_tracer()
+        self.recorders = recorders
+        self.tracer = tracer
+        if not recorders and tracer is None:
+            self.token = None
+            return self
+        stack = _PHASE_STACK.get()
+        self.token = _PHASE_STACK.set(stack + (self.name,))
+        self.parts = stack + (self.name,)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.token is None:
+            return
+        dt = time.perf_counter() - self.t0
+        _PHASE_STACK.reset(self.token)
+        for rec in self.recorders:
+            rel = self.parts[rec._base:]  # path relative to recorder base
             if rel:
-                rec.add(Span(name=name, path=".".join(rel), start=t0,
-                             seconds=dt))
+                rec.add(Span(name=self.name, path=".".join(rel),
+                             start=self.t0, seconds=dt))
+        if self.tracer is not None:
+            self.tracer.add_complete(".".join(self.parts), "train",
+                                     self.t0, dt, {})
+
+
+def phase(name: str) -> _Phase:
+    """Time a phase.  No-op (zero overhead beyond a contextvar read and a
+    tracer-global read) when no recorder AND no trace sink is active.
+    Phases nest: ``phase("fit")`` inside ``phase("validate")`` records as
+    path ``validate.fit``.  When an ``obs`` tracer is installed
+    (docs/observability.md), every phase additionally lands there as a
+    ``train``-category span under its full dotted path."""
+    return _Phase(name)
 
 
 # ---------------------------------------------------------------------------

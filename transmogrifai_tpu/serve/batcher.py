@@ -27,10 +27,14 @@ Per-record fault isolation: a scorer exposing ``score_isolated(records) ->
 routed to per-record futures — a poison record fails only its own future
 instead of co-failing the whole flushed batch.
 
-Counters (submissions, rejections, cancellations, deadline evictions,
-batch-size histogram, queue depth, and a bounded latency reservoir for
-p50/p95/p99) export as a plain dict — the benchmark/CLI surface, no metrics
-dependency.
+Observability: every counter lives in an :class:`~..obs.metrics
+.MetricsRegistry` under the canonical ``tmog_serve_batcher_*`` names
+(docs/observability.md) — ``metrics()`` remains the historical plain-dict
+VIEW over the registry (deprecated aliases), so the benchmark/CLI surface
+is unchanged while Prometheus exposition and JSONL snapshots come for
+free.  When an ``obs`` tracer is installed, each flushed batch emits a
+``serve.flush`` span (and, at ``detail="requests"``, each submit an
+``serve.enqueue`` instant) into the Chrome-trace timeline.
 """
 
 from __future__ import annotations
@@ -41,10 +45,9 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
 
+from ..obs import trace as obs_trace
+from ..obs.metrics import MetricsRegistry
 from .faults import DeadlineExceededError
-
-#: bounded reservoir of completed-request latencies (seconds)
-_LATENCY_WINDOW = 4096
 
 
 class QueueFullError(RuntimeError):
@@ -77,7 +80,8 @@ class MicroBatcher:
 
     def __init__(self, score_batch: Callable[[List[Any]], Sequence[Any]],
                  max_batch: int = 256, max_wait_ms: float = 2.0,
-                 max_queue: int = 4096):
+                 max_queue: int = 4096,
+                 registry: Optional[MetricsRegistry] = None):
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
         self._score = score_batch
@@ -92,11 +96,29 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._open = True
-        self._counters = {"submitted": 0, "rejected": 0, "completed": 0,
-                          "failed": 0, "cancelled": 0, "deadline_expired": 0,
-                          "batches": 0}
-        self._batch_sizes: Dict[int, int] = {}
-        self._latencies: "deque[float]" = deque(maxlen=_LATENCY_WINDOW)
+        # canonical counters (obs/metrics.py) — metrics() is the legacy view
+        self.registry = registry if registry is not None else MetricsRegistry()
+        from ..obs.metrics import canonical_help as _h
+
+        def _c(name):
+            return self.registry.counter(name, _h(name))
+
+        self._c_submitted = _c("tmog_serve_batcher_submitted_total")
+        self._c_rejected = _c("tmog_serve_batcher_rejected_total")
+        self._c_completed = _c("tmog_serve_batcher_completed_total")
+        self._c_failed = _c("tmog_serve_batcher_failed_total")
+        self._c_cancelled = _c("tmog_serve_batcher_cancelled_total")
+        self._c_deadline = _c("tmog_serve_batcher_deadline_expired_total")
+        self._c_batches = _c("tmog_serve_batcher_batches_total")
+        self._g_depth = self.registry.gauge(
+            "tmog_serve_batcher_queue_depth",
+            _h("tmog_serve_batcher_queue_depth"))
+        self._h_batch_size = self.registry.histogram(
+            "tmog_serve_batcher_batch_size",
+            _h("tmog_serve_batcher_batch_size"), exact=True)
+        self._h_latency = self.registry.histogram(
+            "tmog_serve_batcher_latency_seconds",
+            _h("tmog_serve_batcher_latency_seconds"))
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="transmogrifai-microbatcher")
         self._thread.start()
@@ -123,13 +145,22 @@ class MicroBatcher:
                     # rejecting a live one (deadline enforcement IN the queue)
                     expired = self._pop_expired_locked()
                 if len(self._pending) >= self.max_queue:
-                    self._counters["rejected"] += 1
+                    self._c_rejected.inc()
                     raise QueueFullError(
                         f"request queue at capacity ({self.max_queue}); "
                         "shed load or retry")
-                self._counters["submitted"] += 1
+                self._c_submitted.inc()
                 self._pending.append(req)
+                depth = len(self._pending)
+                # gauge set UNDER the batcher lock: a deferred write could
+                # land after the flusher's drain-side update and pin a
+                # phantom nonzero depth on an idle queue
+                self._g_depth.set(depth)
                 self._wake.notify_all()
+            tracer = obs_trace.active_tracer()
+            if tracer is not None and tracer.detail == "requests":
+                tracer.add_instant("serve.enqueue", "serve",
+                                   {"queue_depth": depth})
         finally:
             # resolve evicted futures OUTSIDE the lock: set_exception runs
             # client done-callbacks synchronously, and a callback touching
@@ -151,10 +182,10 @@ class MicroBatcher:
         for r in self._pending:
             if r.deadline is not None and r.deadline <= now:
                 if r.future.set_running_or_notify_cancel():
-                    self._counters["deadline_expired"] += 1
+                    self._c_deadline.inc()
                     expired.append(r)
                 else:
-                    self._counters["cancelled"] += 1
+                    self._c_cancelled.inc()
             else:
                 keep.append(r)
         self._pending = keep
@@ -182,7 +213,7 @@ class MicroBatcher:
                         evicted.append(req)
                     # server-side cancellation, not a scoring failure — same
                     # bucket as a client-side cancel() the claim filter sees
-                    self._counters["cancelled"] += 1
+                    self._c_cancelled.inc()
             self._wake.notify_all()
         for req in evicted:  # outside the lock: done-callbacks may re-enter
             req.future.set_exception(BatcherClosedError(
@@ -201,18 +232,26 @@ class MicroBatcher:
             return len(self._pending)
 
     def metrics(self) -> Dict[str, Any]:
-        """Counters as a plain dict (benchmark/CLI export surface)."""
+        """Counters as a plain dict — the historical (deprecated-alias) VIEW
+        over the canonical registry names (obs/metrics.py
+        ``CANONICAL_METRICS``); benchmark/CLI export surface."""
+        out: Dict[str, Any] = {
+            "submitted": self._c_submitted.value,
+            "rejected": self._c_rejected.value,
+            "completed": self._c_completed.value,
+            "failed": self._c_failed.value,
+            "cancelled": self._c_cancelled.value,
+            "deadline_expired": self._c_deadline.value,
+            "batches": self._c_batches.value,
+        }
         with self._lock:
-            out: Dict[str, Any] = dict(self._counters)
             out["queue_depth"] = len(self._pending)
-            out["batch_size_hist"] = {str(k): v for k, v in
-                                      sorted(self._batch_sizes.items())}
-            lats = sorted(self._latencies)
+        out["batch_size_hist"] = {str(k): v for k, v in sorted(
+            self._h_batch_size.exact_counts().items())}
         for q, name in ((0.50, "latency_p50_ms"), (0.95, "latency_p95_ms"),
                         (0.99, "latency_p99_ms")):
-            out[name] = round(
-                lats[min(int(len(lats) * q), len(lats) - 1)] * 1e3, 4) \
-                if lats else None
+            v = self._h_latency.quantile(q)
+            out[name] = round(v * 1e3, 4) if v is not None else None
         out["max_batch"] = self.max_batch
         out["max_wait_ms"] = self.max_wait_s * 1e3
         out["max_queue"] = self.max_queue
@@ -235,7 +274,9 @@ class MicroBatcher:
                     self._wake.wait(remaining)
             # shutdown drains immediately, full batches at a time
             take = min(self.max_batch, len(self._pending))
-            return [self._pending.popleft() for _ in range(take)]
+            batch = [self._pending.popleft() for _ in range(take)]
+            self._g_depth.set(len(self._pending))
+            return batch
 
     def _claim(self, batch: List[_Request]) -> List[_Request]:
         """Claim futures and evict expired requests before any device call.
@@ -260,10 +301,10 @@ class MicroBatcher:
                     "request deadline expired before flush"))
                 continue
             claimed.append(r)
-        if cancelled or expired:
-            with self._lock:
-                self._counters["cancelled"] += cancelled
-                self._counters["deadline_expired"] += expired
+        if cancelled:
+            self._c_cancelled.inc(cancelled)
+        if expired:
+            self._c_deadline.inc(expired)
         return claimed
 
     def _run(self) -> None:
@@ -274,38 +315,38 @@ class MicroBatcher:
             batch = self._claim(batch)
             if not batch:
                 continue
-            try:
-                if self._isolated:
-                    results = self._score.score_isolated(
-                        [r.record for r in batch])
-                else:
-                    results = self._score([r.record for r in batch])
-                if len(results) != len(batch):
-                    raise RuntimeError(
-                        f"score_batch returned {len(results)} results for "
-                        f"{len(batch)} records")
-            except Exception as e:  # noqa: BLE001 - failures go to futures
-                with self._lock:
-                    self._counters["failed"] += len(batch)
-                    self._counters["batches"] += 1
-                    size = len(batch)
-                    self._batch_sizes[size] = self._batch_sizes.get(size, 0) + 1
-                for r in batch:
-                    r.future.set_exception(e)
-                continue
-            now = time.monotonic()
-            ok = [not isinstance(res, Exception) for res in results]
-            with self._lock:
-                self._counters["completed"] += sum(ok)
-                self._counters["failed"] += len(batch) - sum(ok)
-                self._counters["batches"] += 1
-                size = len(batch)
-                self._batch_sizes[size] = self._batch_sizes.get(size, 0) + 1
+            # serve.flush: the whole batch lifecycle on this worker thread —
+            # the encode/device/host spans from plan.score nest inside it
+            with obs_trace.span("serve.flush", cat="serve",
+                                batch=len(batch)):
+                try:
+                    if self._isolated:
+                        results = self._score.score_isolated(
+                            [r.record for r in batch])
+                    else:
+                        results = self._score([r.record for r in batch])
+                    if len(results) != len(batch):
+                        raise RuntimeError(
+                            f"score_batch returned {len(results)} results "
+                            f"for {len(batch)} records")
+                except Exception as e:  # noqa: BLE001 - failures to futures
+                    self._c_failed.inc(len(batch))
+                    self._c_batches.inc()
+                    self._h_batch_size.observe(len(batch))
+                    for r in batch:
+                        r.future.set_exception(e)
+                    continue
+                now = time.monotonic()
+                ok = [not isinstance(res, Exception) for res in results]
+                self._c_completed.inc(sum(ok))
+                self._c_failed.inc(len(batch) - sum(ok))
+                self._c_batches.inc()
+                self._h_batch_size.observe(len(batch))
                 for r, good in zip(batch, ok):
                     if good:
-                        self._latencies.append(now - r.t_enqueue)
-            for r, res, good in zip(batch, results, ok):
-                if good:
-                    r.future.set_result(res)
-                else:
-                    r.future.set_exception(res)
+                        self._h_latency.observe(now - r.t_enqueue)
+                for r, res, good in zip(batch, results, ok):
+                    if good:
+                        r.future.set_result(res)
+                    else:
+                        r.future.set_exception(res)

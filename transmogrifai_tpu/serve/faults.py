@@ -205,10 +205,16 @@ class FaultHarness:
 
 def fault_point(point: str, **ctx) -> None:
     """Hook called from the scoring hot path; raises the scheduled fault when
-    a harness is active, otherwise costs one global read."""
+    a harness is active, otherwise costs one global read.  Every injected
+    fault is also recorded by the installed flight recorder (obs/flight.py)
+    — and auto-dumps the ring buffer when the recorder has a dump_dir — so
+    a harness run leaves its own postmortem artifact."""
     harness = _ACTIVE
     if harness is None:
         return
     err = harness._check(point, ctx)
     if err is not None:
+        from ..obs import flight as obs_flight
+
+        obs_flight.record_fault(point, err)
         raise err

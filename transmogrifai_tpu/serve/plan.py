@@ -35,6 +35,9 @@ import numpy as np
 
 from ..checkers.diagnostics import OpCheckError
 from ..data.dataset import Column, Dataset
+from ..obs import flight as obs_flight
+from ..obs import trace as obs_trace
+from ..obs.profile import maybe_profile
 from ..features.feature import Feature, _NamedExtract
 from ..features.generator import FeatureGeneratorStage
 from ..types import ColumnKind, NonNullableEmptyException
@@ -210,6 +213,9 @@ class CompiledScoringPlan:
                 raise OpCheckError(report)
 
         self._executables: Dict[int, Any] = {}
+        #: flips once warm() finishes: any later compile on this plan is an
+        #: UNEXPECTED warm-path recompile (flight-recorder TM901)
+        self._warmed = False
         self.compile_count = 0
         self._counters = {"scored_records": 0, "scored_batches": 0,
                           "bucket_batches": {}}
@@ -380,8 +386,11 @@ class CompiledScoringPlan:
                 specs = [jax.ShapeDtypeStruct((bucket,) + trailing,
                                               np.dtype(dtype))
                          for trailing, dtype in self._entry_specs]
-                compiled = jax.jit(self._fused).lower(  # opcheck: allow(TM303) once per bucket under _compile_lock, AOT-cached
-                    *specs).compile()
+                with obs_flight.compile_context(
+                        "serve.plan", fingerprint=self._fingerprint,
+                        warm=self._warmed):
+                    compiled = jax.jit(self._fused).lower(  # opcheck: allow(TM303) once per bucket under _compile_lock, AOT-cached
+                        *specs).compile()
                 self.compile_count += 1
                 with _EXEC_CACHE_LOCK:
                     _EXEC_CACHE[key] = compiled
@@ -395,6 +404,7 @@ class CompiledScoringPlan:
         two in [min_bucket, max_bucket]) so first requests never pay XLA."""
         if not self._prefix:
             return self
+        full_ladder = buckets is None
         if buckets is None:
             buckets, b = [], self.min_bucket
             while b <= self.max_bucket:
@@ -403,6 +413,10 @@ class CompiledScoringPlan:
         for b in buckets:
             self._ensure_compiled(_bucket_for(b, self.min_bucket,
                                               self.max_bucket))
+        if full_ladder:
+            # only a FULL bucket-ladder warm arms the TM901 expectation: a
+            # partial warm legitimately compiles its missing buckets later
+            self._warmed = True
         return self
 
     # -- scoring -------------------------------------------------------------
@@ -423,38 +437,50 @@ class CompiledScoringPlan:
 
         from ..readers.base import extract_columns
 
-        fault_point("encode", records=records)
-        host_cols = extract_columns(records, self._host_raw,
-                                    allow_missing_response=True)
+        with obs_trace.span("serve.encode", cat="serve", records=n):
+            fault_point("encode", records=records)
+            host_cols = extract_columns(records, self._host_raw,
+                                        allow_missing_response=True)
 
-        cols: Dict[str, Column] = dict(host_cols)
-        if self._prefix:
-            enc_cols = dict(host_cols)
-            for raw_name, gen in self._encoder_light.items():
-                enc_cols[raw_name] = _light_column(gen, records)
+            cols: Dict[str, Column] = dict(host_cols)
             entries = []
-            for key in self._entry_keys:
-                if key[0] == "lift":
-                    entries.append(self._entry_lifts[key](records))
-                else:
-                    runner, slot, raw_name = self._entry_encoders[key]
-                    col = enc_cols.get(raw_name)
-                    if col is None:  # a response-typed encoder input only
-                        raise ValueError(
-                            f"raw feature {raw_name!r} is required by "
-                            f"{runner.uid} but absent from the records")
-                    entries.append(np.asarray(
-                        runner.encode_device_input(slot, col)))
+            if self._prefix:
+                enc_cols = dict(host_cols)
+                for raw_name, gen in self._encoder_light.items():
+                    enc_cols[raw_name] = _light_column(gen, records)
+                for key in self._entry_keys:
+                    if key[0] == "lift":
+                        entries.append(self._entry_lifts[key](records))
+                    else:
+                        runner, slot, raw_name = self._entry_encoders[key]
+                        col = enc_cols.get(raw_name)
+                        if col is None:  # a response-typed encoder input only
+                            raise ValueError(
+                                f"raw feature {raw_name!r} is required by "
+                                f"{runner.uid} but absent from the records")
+                        entries.append(np.asarray(
+                            runner.encode_device_input(slot, col)))
+        if self._prefix:
             bucket = _bucket_for(n, self.min_bucket, self.max_bucket)
             compiled = self._ensure_compiled(bucket)
-            fault_point("device", records=records, bucket=bucket)
-            outs = compiled(*[_pad_rows(a, bucket) for a in entries])
+            with obs_trace.span("serve.device", cat="serve", records=n,
+                                bucket=bucket):
+                fault_point("device", records=records, bucket=bucket)
+                with maybe_profile("serve"):  # TMOG_PROFILE dispatch hook
+                    outs = compiled(*[_pad_rows(a, bucket) for a in entries])
             for f, dev in zip(self._out_features, outs):
                 cols[f.name] = self._materialize(f, np.asarray(dev)[:n])
 
-        fault_point("host", records=records)
-        ds = run_host_stages(Dataset(cols), self._remainder)
-        out = self._rows_from(ds, n)
+        with obs_trace.span("serve.host", cat="serve", records=n):
+            fault_point("host", records=records)
+            # per-stage phase spans only at the heavy "requests" detail:
+            # serve.host already times the whole remainder, and the default
+            # batch detail must stay inside the <5% enabled-overhead gate
+            tracer = obs_trace.active_tracer()
+            ds = run_host_stages(
+                Dataset(cols), self._remainder,
+                phases=tracer is None or tracer.detail == "requests")
+            out = self._rows_from(ds, n)
         with self._lock:
             self._counters["scored_records"] += n
             self._counters["scored_batches"] += 1
@@ -482,7 +508,14 @@ class CompiledScoringPlan:
         ds = Dataset(extract_columns(
             records, [(g.raw_name, g) for g in self._generators],
             allow_missing_response=True))
-        ds = run_host_stages(ds, self._runners)
+        with obs_trace.span("serve.host_fallback", cat="serve", records=n):
+            # same per-stage-span gating as score(): at the default batch
+            # detail the degraded path must not flood the tracer with one
+            # span per interpreted stage per batch mid-incident
+            tracer = obs_trace.active_tracer()
+            ds = run_host_stages(
+                ds, self._runners,
+                phases=tracer is None or tracer.detail == "requests")
         out = self._rows_from(ds, n)
         with self._lock:
             self._counters["host_scored_records"] = \

@@ -37,6 +37,8 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
 
+from ..obs import flight as obs_flight
+from ..obs.metrics import MetricsRegistry, canonical_help
 from .faults import CircuitOpenError, PoisonRecordError, is_retryable
 
 log = logging.getLogger(__name__)
@@ -56,7 +58,12 @@ class CircuitBreaker:
 
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
-    def __init__(self, failure_threshold: int = 3, recovery_batches: int = 8):
+    #: canonical numeric encoding of the state gauge
+    _STATE_CODE = {"closed": 0, "open": 1, "half_open": 2}
+
+    def __init__(self, failure_threshold: int = 3, recovery_batches: int = 8,
+                 registry: Optional[MetricsRegistry] = None,
+                 labels: Optional[Mapping[str, str]] = None):
         if failure_threshold < 1 or recovery_batches < 1:
             raise ValueError("failure_threshold and recovery_batches "
                              "must be >= 1")
@@ -67,14 +74,30 @@ class CircuitBreaker:
         self._consecutive = 0
         self._host_since_open = 0
         self._held_open = False
-        self._counters = {"opened": 0, "reclosed": 0, "probes": 0}
+        reg = registry if registry is not None else MetricsRegistry()
+        self.registry = reg
+
+        def _c(name):
+            return reg.counter(name, canonical_help(name), labels=labels)
+
+        self._c_opened = _c("tmog_serve_breaker_opened_total")
+        self._c_reclosed = _c("tmog_serve_breaker_reclosed_total")
+        self._c_probes = _c("tmog_serve_breaker_probes_total")
+        self._g_state = reg.gauge("tmog_serve_breaker_state",
+                                  canonical_help("tmog_serve_breaker_state"),
+                                  labels=labels)
         #: bounded: a flapping dependency must not grow memory or bloat
         #: every metrics() scrape; totals live in the counters
         self.transitions: "deque[str]" = deque(maxlen=64)
 
     def _to(self, state: str) -> None:
+        # flight-recorder event BEFORE the assignment so the record carries
+        # both sides of the transition (obs/flight.py; no-op uninstalled)
+        obs_flight.record_event("breaker_transition",
+                                **{"from": self.state, "to": state})
         self.transitions.append(f"{self.state}->{state}")
         self.state = state
+        self._g_state.set(self._STATE_CODE[state])
 
     # -- decision + outcome hooks (called once per batch) --------------------
     def allow_device(self) -> bool:
@@ -87,7 +110,7 @@ class CircuitBreaker:
                 return False
             if self._host_since_open >= self.recovery_batches:
                 self._to(self.HALF_OPEN)
-                self._counters["probes"] += 1
+                self._c_probes.inc()
                 return True
             return False
 
@@ -95,7 +118,7 @@ class CircuitBreaker:
         with self._lock:
             if self.state == self.HALF_OPEN:
                 self._to(self.CLOSED)
-                self._counters["reclosed"] += 1
+                self._c_reclosed.inc()
             self._consecutive = 0
 
     def record_failure(self) -> None:
@@ -104,14 +127,14 @@ class CircuitBreaker:
                 # a failed probe is a fresh open: operators watching
                 # "opened" must see the continuing incident, not one blip
                 self._to(self.OPEN)
-                self._counters["opened"] += 1
+                self._c_opened.inc()
                 self._host_since_open = 0
                 return
             self._consecutive += 1
             if self.state == self.CLOSED \
                     and self._consecutive >= self.failure_threshold:
                 self._to(self.OPEN)
-                self._counters["opened"] += 1
+                self._c_opened.inc()
                 self._host_since_open = 0
 
     def record_host_batch(self) -> None:
@@ -125,7 +148,7 @@ class CircuitBreaker:
         with self._lock:
             if self.state != self.OPEN:
                 self._to(self.OPEN)
-                self._counters["opened"] += 1
+                self._c_opened.inc()
             self._held_open = True
             self._host_since_open = 0
 
@@ -137,11 +160,18 @@ class CircuitBreaker:
             self._consecutive = 0
 
     def metrics(self) -> Dict[str, Any]:
+        """Legacy-alias view over the ``tmog_serve_breaker_*`` registry
+        counters (obs/metrics.py)."""
         with self._lock:
-            return {"state": self.state,
-                    "consecutive_failures": self._consecutive,
-                    "transitions": list(self.transitions),  # last 64
-                    **self._counters}
+            state = self.state
+            consecutive = self._consecutive
+            transitions = list(self.transitions)  # last 64
+        return {"state": state,
+                "consecutive_failures": consecutive,
+                "transitions": transitions,
+                "opened": self._c_opened.value,
+                "reclosed": self._c_reclosed.value,
+                "probes": self._c_probes.value}
 
 
 class ResilientScorer:
@@ -159,22 +189,31 @@ class ResilientScorer:
                  recovery_batches: int = 8,
                  dead_letter: Optional[Callable] = None,
                  seed: Optional[int] = None,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 registry: Optional[MetricsRegistry] = None,
+                 labels: Optional[Mapping[str, str]] = None):
         self._plan = plan
         self._host = host_score if host_score is not None \
             else getattr(plan, "score_host", None)
         self.max_retries = int(max_retries)
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_cap_s = float(backoff_cap_s)
+        reg = registry if registry is not None else MetricsRegistry()
+        self.registry = reg
         self.breaker = CircuitBreaker(failure_threshold=failure_threshold,
-                                      recovery_batches=recovery_batches)
+                                      recovery_batches=recovery_batches,
+                                      registry=reg, labels=labels)
         self._dead_letter = dead_letter
         self._rng = random.Random(seed)
         self._sleep = sleep
-        self._lock = threading.Lock()
-        self._counters = {"quarantined": 0, "retries": 0, "bucket_splits": 0,
-                          "bisect_batches": 0, "device_failures": 0,
-                          "fallback_batches": 0, "fallback_records": 0}
+
+        def _c(name):
+            return reg.counter(name, canonical_help(name), labels=labels)
+
+        self._c = {key: _c(f"tmog_serve_resilience_{key}_total")
+                   for key in ("quarantined", "retries", "bucket_splits",
+                               "bisect_batches", "device_failures",
+                               "fallback_batches", "fallback_records")}
 
     # -- public entry points -------------------------------------------------
     def score_isolated(self, records: Sequence[Mapping[str, Any]]
@@ -195,8 +234,7 @@ class ResilientScorer:
                     # a record problem — count it toward the breaker and
                     # serve THIS batch degraded from the host path
                     self.breaker.record_failure()
-                    with self._lock:
-                        self._counters["device_failures"] += 1
+                    self._c["device_failures"].inc()
                     log.warning("device scoring failed after retries (%s: "
                                 "%s); serving batch from the host path",
                                 type(e).__name__, e)
@@ -222,8 +260,9 @@ class ResilientScorer:
         return out
 
     def metrics(self) -> Dict[str, Any]:
-        with self._lock:
-            out = dict(self._counters)
+        """Legacy-alias view over the ``tmog_serve_resilience_*`` registry
+        counters (obs/metrics.py)."""
+        out: Dict[str, Any] = {k: c.value for k, c in self._c.items()}
         out["breaker"] = self.breaker.metrics()
         return out
 
@@ -242,14 +281,12 @@ class ResilientScorer:
                     # full jitter (seeded when the caller needs determinism)
                     self._sleep(delay * (0.5 + 0.5 * self._rng.random()))
                     attempt += 1
-                    with self._lock:
-                        self._counters["retries"] += 1
+                    self._c["retries"].inc()
                     continue
                 if len(records) > 1 and depth < _MAX_SPLIT_DEPTH:
                     # batch-shaped failure (resource exhaustion scales with
                     # the padding bucket): halve into smaller buckets
-                    with self._lock:
-                        self._counters["bucket_splits"] += 1
+                    self._c["bucket_splits"].inc()
                     mid = len(records) // 2
                     return (self._device_with_retry(records[:mid], depth + 1)
                             + self._device_with_retry(records[mid:],
@@ -264,8 +301,7 @@ class ResilientScorer:
         compiled plan, row-local kernels)."""
         if len(records) == 1:
             return [self._quarantine(records[0], exc)]
-        with self._lock:
-            self._counters["bisect_batches"] += 1
+        self._c["bisect_batches"].inc()
         mid = len(records) // 2
         out: List[Any] = []
         for half in (records[:mid], records[mid:]):
@@ -276,14 +312,18 @@ class ResilientScorer:
         return out
 
     def _quarantine(self, record, exc: BaseException) -> PoisonRecordError:
-        with self._lock:
-            self._counters["quarantined"] += 1
+        self._c["quarantined"].inc()
+        # flight-recorder postmortem trail (cause TYPE only — a record
+        # payload must never leak into a telemetry dump)
+        obs_flight.record_event("quarantine", cause=type(exc).__name__)
         err = PoisonRecordError(
             f"record quarantined: scoring failed with "
             f"{type(exc).__name__}: {exc}", cause=exc)
         if self._dead_letter is not None:
             try:
                 self._dead_letter(record, exc)
+                obs_flight.record_event("dead_letter",
+                                        cause=type(exc).__name__)
             except Exception as dl:  # noqa: BLE001 — DLQ must not break serving
                 log.warning("dead-letter callback failed: %s", dl)
         return err
@@ -300,8 +340,7 @@ class ResilientScorer:
             out = self._host(list(records))
         except Exception as e:  # noqa: BLE001 — isolate on the host path too
             out = self._isolate(list(records), self._host, e)
-        with self._lock:
-            self._counters["fallback_batches"] += 1
-            self._counters["fallback_records"] += sum(
-                1 for r in out if not isinstance(r, Exception))
+        self._c["fallback_batches"].inc()
+        self._c["fallback_records"].inc(
+            sum(1 for r in out if not isinstance(r, Exception)))
         return out

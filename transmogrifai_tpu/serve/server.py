@@ -23,6 +23,7 @@ import logging
 from concurrent.futures import Future
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Union
 
+from ..obs.metrics import MetricsRegistry
 from .batcher import MicroBatcher
 from .plan import CompiledScoringPlan
 from .resilience import ResilientScorer
@@ -64,7 +65,17 @@ class ScoringServer:
                  max_bucket: Optional[int] = None, warm: bool = True,
                  resilience: Union[bool, Mapping[str, Any]] = True,
                  deadline_ms: Optional[float] = None,
-                 hbm_budget: Optional[float] = None):
+                 hbm_budget: Optional[float] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        # ONE metrics registry backs the whole server: batcher, swapper, and
+        # every model entry's resilience layer (labeled by entry version)
+        # register here, so to_prometheus()/snapshot() cover the server.
+        # One registry per SERVER: the batcher/swap/breaker series are
+        # unlabeled fixed names, so two servers sharing a registry would
+        # merge counters (and one server's per-candidate shadow reset would
+        # zero the other's gate stats) — scrape multiple servers by
+        # concatenating their prometheus() outputs instead
+        self.registry = registry if registry is not None else MetricsRegistry()
         if max_bucket is None:
             # every flushed batch must fit one bucket, so a single fused call
             # serves the largest flush the batcher can produce
@@ -90,10 +101,12 @@ class ScoringServer:
         # every model (initial and staged candidates) builds through one
         # path; the swapper is the batcher-facing atomic reference so a
         # blue/green swap can never split an in-flight batch across models
-        self._swapper = SwappableScorer(self._build_entry(model, warm=warm))
+        self._swapper = SwappableScorer(self._build_entry(model, warm=warm),
+                                        registry=self.registry)
         self.batcher = MicroBatcher(self._swapper, max_batch=max_batch,
                                     max_wait_ms=max_wait_ms,
-                                    max_queue=max_queue)
+                                    max_queue=max_queue,
+                                    registry=self.registry)
 
     def _build_entry(self, model, warm: bool = True) -> ModelEntry:
         # hbm_budget arms the TM601 admission gate (serve/validator.py):
@@ -104,9 +117,12 @@ class ScoringServer:
                                    hbm_budget=self.hbm_budget)
         if warm:
             plan.warm()
-        res = ResilientScorer(plan, **self._resilience_params) \
+        version = next(self._versions)
+        res = ResilientScorer(plan, registry=self.registry,
+                              labels={"entry": str(version)},
+                              **self._resilience_params) \
             if self._resilience_params is not None else None
-        return ModelEntry(model, plan, res, next(self._versions))
+        return ModelEntry(model, plan, res, version)
 
     # -- active-entry views (the pre-swap public attribute surface) ----------
     @property
@@ -180,7 +196,26 @@ class ScoringServer:
         if warm:
             entry.plan.warm()
         self._swapper.stage(entry)
+        self._prune_entry_metrics()
         return entry.fingerprint
+
+    def _prune_entry_metrics(self) -> None:
+        """Evict registry series of model entries no longer referenced.
+
+        Every staged candidate registers per-entry labeled resilience/
+        breaker metrics; a continual loop stages one per refit, so dead
+        entries' series must be dropped or snapshots/scrapes grow without
+        bound.  Called from the control-plane staging path (the only place
+        new entries are built after construction), which bounds the
+        registry to the live active/previous/candidate generations."""
+        swapper = self._swapper
+        with swapper._lock:
+            live = {str(e.version)
+                    for e in (swapper._active, swapper._previous,
+                              swapper._candidate) if e is not None}
+        for version in self.registry.labeled_values("entry"):
+            if version not in live:
+                self.registry.drop_labeled("entry", version)
 
     def discard_candidate(self) -> None:
         self._swapper.discard_candidate()
@@ -229,3 +264,13 @@ class ScoringServer:
         if self.resilience is not None:
             out["resilience"] = self.resilience.metrics()
         return out
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition of the server's metrics registry
+        (canonical ``tmog_*`` names — docs/observability.md)."""
+        return self.registry.to_prometheus()
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Stable-key-ordered JSON-able snapshot of the registry (the
+        ``cli serve`` periodic JSONL line)."""
+        return self.registry.snapshot()

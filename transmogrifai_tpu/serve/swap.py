@@ -40,6 +40,9 @@ import threading
 from collections import deque
 from typing import Any, Dict, List, Mapping, Optional, Sequence
 
+from ..obs import flight as obs_flight
+from ..obs import trace as obs_trace
+from ..obs.metrics import MetricsRegistry, canonical_help
 from .faults import fault_point
 
 log = logging.getLogger(__name__)
@@ -111,16 +114,23 @@ class SwappableScorer:
     per-record outcomes regardless of which entry serves them.
     """
 
-    def __init__(self, entry: ModelEntry):
+    def __init__(self, entry: ModelEntry,
+                 registry: Optional[MetricsRegistry] = None):
         self._lock = threading.Lock()
         self._active = entry
         self._previous: Optional[ModelEntry] = None
         self._candidate: Optional[ModelEntry] = None
         self._probation_left = 0
         self._opened_at_swap = 0
-        self._counters = {"swaps": 0, "rollbacks": 0, "rollback_failures": 0,
-                          "shadow_mirrored": 0, "shadow_failures": 0,
-                          "shadow_batches": 0, "shadow_dropped": 0}
+        # canonical counters (obs/metrics.py); metrics() is the legacy view
+        reg = registry if registry is not None else MetricsRegistry()
+        self.registry = reg
+        self._c = {key: reg.counter(f"tmog_serve_swap_{key}_total",
+                                    canonical_help(
+                                        f"tmog_serve_swap_{key}_total"))
+                   for key in ("swaps", "rollbacks", "rollback_failures",
+                               "shadow_mirrored", "shadow_failures",
+                               "shadow_batches", "shadow_dropped")}
         self._delta_count = 0
         self._delta_sum = 0.0
         self._delta_max: Optional[float] = None
@@ -165,7 +175,7 @@ class SwappableScorer:
             # primary futures or expire live deadlines
             with self._shadow_cv:
                 if len(self._shadow_queue) >= _SHADOW_QUEUE_MAX:
-                    self._counters["shadow_dropped"] += len(records)
+                    self._c["shadow_dropped"].inc(len(records))
                 else:
                     self._ensure_shadow_thread_locked()
                     self._shadow_queue.append(
@@ -211,13 +221,15 @@ class SwappableScorer:
         after its candidate was discarded/replaced is dropped, never
         credited to a different candidate's gate."""
         try:
-            fault_point("shadow", records=records)
-            shadow = candidate.score_isolated(records)
+            with obs_trace.span("serve.shadow_mirror", cat="serve",
+                                records=len(records)):
+                fault_point("shadow", records=records)
+                shadow = candidate.score_isolated(records)
         except Exception as e:  # noqa: BLE001 — shadow never breaks primary
             with self._lock:
                 if self._candidate is candidate:
-                    self._counters["shadow_failures"] += len(records)
-                    self._counters["shadow_batches"] += 1
+                    self._c["shadow_failures"].inc(len(records))
+                    self._c["shadow_batches"].inc()
             log.warning("shadow scoring failed (%s: %s)",
                         type(e).__name__, e)
             return
@@ -236,9 +248,9 @@ class SwappableScorer:
         with self._lock:
             if self._candidate is not candidate:
                 return  # displaced mid-mirror: stats belong to no one
-            self._counters["shadow_mirrored"] += mirrored
-            self._counters["shadow_failures"] += failures
-            self._counters["shadow_batches"] += 1
+            self._c["shadow_mirrored"].inc(mirrored)
+            self._c["shadow_failures"].inc(failures)
+            self._c["shadow_batches"].inc()
             for d in deltas:
                 self._delta_count += 1
                 self._delta_sum += d
@@ -260,8 +272,7 @@ class SwappableScorer:
             try:
                 self.rollback(reason="breaker trip in probation")
             except Exception as e:  # noqa: BLE001 — injected rollback faults
-                with self._lock:
-                    self._counters["rollback_failures"] += 1
+                self._c["rollback_failures"].inc()
                 log.warning("automatic rollback failed (%s: %s); will retry "
                             "next batch", type(e).__name__, e)
                 with self._lock:
@@ -281,10 +292,12 @@ class SwappableScorer:
             self._reset_shadow_locked()
 
     def _reset_shadow_locked(self) -> None:
-        self._counters["shadow_mirrored"] = 0
-        self._counters["shadow_failures"] = 0
-        self._counters["shadow_batches"] = 0
-        self._counters["shadow_dropped"] = 0
+        # per-candidate statistics restart with each staged candidate (a
+        # documented counter reset — obs/metrics.py CANONICAL_METRICS)
+        self._c["shadow_mirrored"].reset()
+        self._c["shadow_failures"].reset()
+        self._c["shadow_batches"].reset()
+        self._c["shadow_dropped"].reset()
         self._delta_count = 0
         self._delta_sum = 0.0
         self._delta_max = None
@@ -298,10 +311,10 @@ class SwappableScorer:
                 "staged": self._candidate is not None,
                 "candidate_fingerprint":
                     self._candidate.fingerprint if self._candidate else None,
-                "mirrored_records": self._counters["shadow_mirrored"],
-                "shadow_failures": self._counters["shadow_failures"],
-                "shadow_batches": self._counters["shadow_batches"],
-                "shadow_dropped": self._counters["shadow_dropped"],
+                "mirrored_records": self._c["shadow_mirrored"].value,
+                "shadow_failures": self._c["shadow_failures"].value,
+                "shadow_batches": self._c["shadow_batches"].value,
+                "shadow_dropped": self._c["shadow_dropped"].value,
                 "compared_records": self._delta_count,
                 "mean_abs_delta": (self._delta_sum / self._delta_count
                                    if self._delta_count else None),
@@ -342,8 +355,9 @@ class SwappableScorer:
                       "to_version": candidate.version,
                       "shared_prefix": (self._previous.fingerprint
                                         == candidate.fingerprint)}
-            self._counters["swaps"] += 1
+            self._c["swaps"].inc()
             self._append_history_locked(record)
+        obs_flight.record_event("swap", **record)
         return record
 
     def rollback(self, reason: str = "manual") -> Dict[str, Any]:
@@ -361,8 +375,9 @@ class SwappableScorer:
                       "from": bad.fingerprint, "to": good.fingerprint,
                       "from_version": bad.version,
                       "to_version": good.version}
-            self._counters["rollbacks"] += 1
+            self._c["rollbacks"].inc()
             self._append_history_locked(record)
+        obs_flight.record_event("rollback", **record)
         log.warning("rolled back to model version %d (%s)",
                     good.version, reason)
         return record
@@ -374,8 +389,10 @@ class SwappableScorer:
 
     # -- observability -------------------------------------------------------
     def metrics(self) -> Dict[str, Any]:
+        """Legacy-alias view over the ``tmog_serve_swap_*`` registry
+        counters (obs/metrics.py)."""
         with self._lock:
-            out: Dict[str, Any] = dict(self._counters)
+            out: Dict[str, Any] = {k: c.value for k, c in self._c.items()}
             out.update({
                 "active_version": self._active.version,
                 "active_fingerprint": self._active.fingerprint,

@@ -55,6 +55,9 @@ import numpy as np
 from ..checkers.diagnostics import (Diagnostic, DiagnosticReport,
                                     make_diagnostic)
 from ..data.dataset import Dataset
+from ..obs import flight as obs_flight
+from ..obs import trace as obs_trace
+from ..obs.metrics import MetricsRegistry, canonical_help
 from ..serve.faults import fault_point
 from ..types import ColumnKind
 
@@ -498,7 +501,8 @@ class RefitController:
         from ..perf import measure_compiles
         from .fit import transform_dag
 
-        with measure_compiles() as probe:
+        with obs_flight.compile_context("continual.prime"), \
+                measure_compiles() as probe:
             transform_dag(dataset, self._features, self._base.fitted)
         self.prime_compiles = probe.backend_compiles
         return self.prime_compiles
@@ -530,7 +534,15 @@ class RefitController:
                 if getattr(self._base, "workflow_cv", False):
                     wf.with_workflow_cv()
                 wf._warm_models = dict(warm)
-                with measure_compiles() as probe:
+                # warm-path compile tagging: with a flight recorder
+                # installed, any backend compile in here records as an
+                # UNEXPECTED recompile (TM901 — the dynamic twin of TM809)
+                with obs_trace.span("continual.refit", cat="continual",
+                                    rows=window.n_rows, attempt=attempt), \
+                        obs_flight.compile_context(
+                            "continual.refit",
+                            warm=self.expect_zero_prefix_compiles), \
+                        measure_compiles() as probe:
                     model = wf.set_input_dataset(window).train()
                 ckpt = self.save_version(model) if self.checkpoint_dir \
                     else None
@@ -742,7 +754,8 @@ class ContinualTrainer:
                  swap_retries: int = 2,
                  drift_params: Optional[Mapping[str, Any]] = None,
                  on_batch: Optional[Callable] = None,
-                 refit_enabled: bool = True):
+                 refit_enabled: bool = True,
+                 registry: Optional[MetricsRegistry] = None):
         self._server = server
         self.refit_enabled = bool(refit_enabled)
         self._model = model
@@ -783,13 +796,34 @@ class ContinualTrainer:
         self.diagnostics: List[Diagnostic] = []
         self.max_diagnostics = 512
         self.last_refit: Optional[RefitResult] = None
-        self.counters: Dict[str, int] = {
-            "batches": 0, "records": 0, "record_errors": 0,
-            "drift_evaluations": 0, "drift_events": 0,
-            "refits": 0, "refit_failures": 0,
-            "candidates_staged": 0, "gate_rejections": 0,
-            "promotions": 0, "swap_failures": 0,
-        }
+        # canonical control-plane counters (obs/metrics.py): by default they
+        # join the SERVER's registry, so one Prometheus scrape / snapshot
+        # line covers serving and continual training together
+        if registry is None:
+            registry = getattr(server, "registry", None)
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self._c: Dict[str, Any] = {
+            key: self.registry.counter(
+                f"tmog_continual_{key}_total",
+                canonical_help(f"tmog_continual_{key}_total"))
+            for key in ("batches", "records", "record_errors",
+                        "drift_evaluations", "drift_events",
+                        "refits", "refit_failures",
+                        "candidates_staged", "gate_rejections",
+                        "promotions", "swap_failures")}
+        # per-trainer baseline: registry counters are cumulative for the
+        # process (the Prometheus contract), but THIS trainer's counters
+        # view — and run(max_batches=) — must start at zero even when a
+        # second trainer joins a server whose registry already has counts
+        self._c_base: Dict[str, int] = {k: c.value
+                                        for k, c in self._c.items()}
+
+    @property
+    def counters(self) -> Dict[str, int]:
+        """Legacy-alias dict view over the ``tmog_continual_*`` registry
+        counters (obs/metrics.py), relative to this trainer's start."""
+        return {k: c.value - self._c_base[k] for k, c in self._c.items()}
 
     # -- the loop ------------------------------------------------------------
     def run(self, max_batches: Optional[int] = None) -> Dict[str, Any]:
@@ -804,12 +838,13 @@ class ContinualTrainer:
             commit = getattr(self._reader, "commit", None)
             if commit is not None:
                 commit()
-            self.counters["batches"] += 1
-            self.counters["records"] += len(records)
+            self._c["batches"].inc()
+            self._c["records"].inc(len(records))
             self._ingest(ds, records)
             self._tick()
             if max_batches is not None \
-                    and self.counters["batches"] >= max_batches:
+                    and self._c["batches"].value \
+                    - self._c_base["batches"] >= max_batches:
                 break
         return self.metrics()
 
@@ -833,7 +868,7 @@ class ContinualTrainer:
         try:
             return future.result()
         except Exception as e:  # noqa: BLE001 — per-record outcome row
-            self.counters["record_errors"] += 1
+            self._c["record_errors"].inc()
             return {"error": str(e), "error_type": type(e).__name__}
 
     # -- drift bookkeeping ---------------------------------------------------
@@ -868,17 +903,23 @@ class ContinualTrainer:
         if self._detector is None:
             return
         try:
-            report = self._detector.evaluate()
+            with obs_trace.span("continual.drift", cat="continual",
+                                records=self._detector.records):
+                report = self._detector.evaluate()
         except Exception as e:  # noqa: BLE001 — injected drift faults
             log.warning("drift evaluation failed (%s: %s)",
                         type(e).__name__, e)
             return
-        self.counters["drift_evaluations"] += 1
+        self._c["drift_evaluations"].inc()
         self._note(d for d in report if d.code != "TM804")
         if self.refit_enabled and DriftDetector.drifted(report) \
                 and len(self._window) >= min(self.window_records,
                                              self._detector.min_records):
-            self.counters["drift_events"] += 1
+            self._c["drift_events"].inc()
+            obs_flight.record_event(
+                "drift", codes=sorted({d.code for d in report
+                                       if d.code != "TM804"}),
+                records=self._detector.records)
             self._refit_and_stage()
 
     def _observe_rollback(self) -> None:
@@ -940,7 +981,7 @@ class ContinualTrainer:
                 self._primed = True
             result = self._refit.refit(window_ds)
         except Exception as e:  # noqa: BLE001 — serving model untouched
-            self.counters["refit_failures"] += 1
+            self._c["refit_failures"].inc()
             if isinstance(e, RefitError):
                 self._note(e.diagnostics)
             else:
@@ -951,19 +992,19 @@ class ContinualTrainer:
                         type(e).__name__, e)
             self._reset_detector()  # re-accumulate before trying again
             return
-        self.counters["refits"] += 1
+        self._c["refits"].inc()
         self.last_refit = result
         self._note(result.diagnostics)
         self._last_window_ds = window_ds
         try:
             self._server.stage_candidate(result.model)
         except Exception as e:  # noqa: BLE001 — incompatible candidate
-            self.counters["refit_failures"] += 1
+            self._c["refit_failures"].inc()
             log.warning("candidate staging refused (%s: %s)",
                         type(e).__name__, e)
             self._reset_detector()
             return
-        self.counters["candidates_staged"] += 1
+        self._c["candidates_staged"].inc()
         self._swap_attempts = 0
         self._candidate_model = result.model
 
@@ -984,10 +1025,13 @@ class ContinualTrainer:
             return
         cand_metric = best_validation_metric(self._candidate_model) \
             if getattr(self, "_candidate_model", None) is not None else None
-        refusals = self._gate.check(shadow, self._active_metric, cand_metric)
+        with obs_trace.span("continual.gate", cat="continual",
+                            mirrored=shadow["mirrored_records"]):
+            refusals = self._gate.check(shadow, self._active_metric,
+                                        cand_metric)
         if refusals:
             self._note(refusals)
-            self.counters["gate_rejections"] += 1
+            self._c["gate_rejections"].inc()
             self._server.discard_candidate()
             self._reset_detector()
             return
@@ -998,10 +1042,11 @@ class ContinualTrainer:
                if self._detector is not None else None,
                "ckpt": self._marked_ckpt}
         try:
-            swap = self._server.promote(
-                probation_batches=self.probation_batches)
+            with obs_trace.span("continual.swap", cat="continual"):
+                swap = self._server.promote(
+                    probation_batches=self.probation_batches)
         except Exception as e:  # noqa: BLE001 — injected swap faults
-            self.counters["swap_failures"] += 1
+            self._c["swap_failures"].inc()
             self._swap_attempts += 1
             log.warning("swap failed (%s: %s); still serving the active "
                         "model", type(e).__name__, e)
@@ -1009,7 +1054,7 @@ class ContinualTrainer:
                 self._server.discard_candidate()
                 self._reset_detector()
             return
-        self.counters["promotions"] += 1
+        self._c["promotions"].inc()
         self._pre_swap = pre
         self._note([make_diagnostic(
             "TM807",

@@ -150,16 +150,26 @@ def partition_device_prefix(runners: Sequence[Any], entry_ok: Callable):
     return prefix, remainder, device_uids
 
 
-def run_host_stages(dataset: Dataset, runners: Sequence[Any]) -> Dataset:
+def run_host_stages(dataset: Dataset, runners: Sequence[Any],
+                    phases: bool = True) -> Dataset:
     """Shared host-remainder entry point: the per-stage interpreted transform
     loop.  Every fused-planner consumer (training transform, CV folds, the
     serving plan's remainder, AND the serving circuit breaker's degraded
     host path) runs host stages through here, so the fallback path is the
     same code in every mode — one loop to keep alive, one set of phase spans.
+
+    ``phases=False`` skips the per-stage phase spans: the serving hot path
+    passes it when a tracer is installed at the default ``batch`` detail,
+    keeping the per-flush telemetry cost inside the bench ``obs`` gate
+    (the enclosing ``serve.host`` span still times the whole remainder).
     """
     from ..perf.timers import phase
 
     out = dataset
+    if not phases:
+        for runner in runners:
+            out = runner.transform(out)
+        return out
     for runner in runners:
         with phase(f"transform.{type(runner).__name__}"):
             out = runner.transform(out)

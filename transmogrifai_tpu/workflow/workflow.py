@@ -145,7 +145,8 @@ class Workflow:
     # -- training ------------------------------------------------------------
     def train(self, test_fraction: float = 0.0, seed: int = 42,
               checkpointer=None, strict: bool = False,
-              hbm_budget: Optional[float] = None) -> "WorkflowModel":
+              hbm_budget: Optional[float] = None,
+              telemetry=None) -> "WorkflowModel":
         """Fit the DAG.  ``checkpointer`` (a StageCheckpointer) persists each
         fitted stage as it completes and resumes from disk on re-run —
         sweep-level resume for preemptible hardware (SURVEY §5.4).
@@ -160,7 +161,48 @@ class Workflow:
         compared against the budget and an over-budget plan raises
         :class:`OpCheckError` instead of launching a device job that will
         OOM minutes in.
+
+        ``telemetry`` (an output directory path, or a prebuilt
+        :class:`~transmogrifai_tpu.obs.Telemetry`; default: the
+        ``TMOG_TELEMETRY`` env var) wraps the fit in the obs backbone
+        (docs/observability.md): every ``perf.phase`` site lands as a trace
+        span and backend compiles land in the flight recorder, dumped as
+        ``trace.json`` / ``flight.json`` / a ``metrics.jsonl`` compile-stats
+        line under the directory when the fit finishes.
         """
+        from ..obs import resolve_telemetry
+
+        tel = resolve_telemetry(telemetry)
+        if tel is None:
+            return self._train(test_fraction=test_fraction, seed=seed,
+                               checkpointer=checkpointer, strict=strict,
+                               hbm_budget=hbm_budget)
+        from ..perf import PhaseRecorder, compile_snapshot, record_phases
+
+        # ownership-aware activation: a caller that already started this
+        # bundle keeps its session — we neither stop nor dump over it
+        owned = tel.activate()
+        t0 = compile_snapshot()
+        rec = PhaseRecorder()
+        try:
+            with record_phases(rec):
+                return self._train(test_fraction=test_fraction, seed=seed,
+                                   checkpointer=checkpointer, strict=strict,
+                                   hbm_budget=hbm_budget)
+        finally:
+            if owned:
+                # dump in the finally so a FAILED fit still leaves its
+                # trace/flight postmortem, with one export (not two)
+                tel.stop()
+                tel.dump(metrics_payload={
+                    "compile": compile_snapshot().minus(t0).to_dict(),
+                    "phases": rec.report(),
+                    "source": "Workflow.train",
+                })
+
+    def _train(self, test_fraction: float = 0.0, seed: int = 42,
+               checkpointer=None, strict: bool = False,
+               hbm_budget: Optional[float] = None) -> "WorkflowModel":
         if not self.result_features:
             raise ValueError("set_result_features before train()")
         if strict:
