@@ -740,6 +740,97 @@ def bench_stream(n_records: int):
     }
 
 
+def bench_fleet(n_records: int):
+    """Multi-tenant serving fleet (serve/registry.py): aggregate rps across
+    N tenants behind ONE shared SLO-tiered micro-batcher, per-tenant p99s,
+    fleet-wide executable dedup, and lowest-tier-first load shedding under
+    induced overload.
+
+    Gates: every tenant past the first registers at ZERO new backend
+    compiles (`fleet_shared_prefix_compiles` — the content-addressed
+    executable cache dedups identical plans across tenants), each tenant's
+    p99 is recorded (per-tenant labeled latency histograms), and under a
+    deliberately saturated queue every shed request comes from the bronze
+    tier while the gold burst is admitted and completes in full.
+    """
+    from transmogrifai_tpu.perf import measure_compiles
+    from transmogrifai_tpu.serve import FleetServer, LoadShedError
+
+    model, records = _serve_fixture(n_records)
+    tenants = [("t_gold", "gold"), ("t_silver", "silver"),
+               ("t_bronze", "bronze"), ("t_bulk", "bronze")]
+
+    out: dict = {"records": len(records), "tenants": len(tenants)}
+    with FleetServer(max_batch=64, max_wait_ms=1.0,
+                     max_queue=len(records) * len(tenants) + 1) as fleet:
+        fleet.register(tenants[0][0], model, slo=tenants[0][1])
+        # fleet-wide compile amortization: every further tenant shares the
+        # first registration's executables (same plan fingerprint)
+        with measure_compiles() as probe:
+            for t, slo in tenants[1:]:
+                fleet.register(t, model, slo=slo)
+        out["dedup_backend_compiles"] = probe.backend_compiles
+        m0 = fleet.metrics()["fleet"]
+        out["fleet_shared_prefix_compiles"] = m0["shared_prefix_registrations"]
+        out["gate_shared_prefix_dedup"] = bool(
+            probe.backend_compiles == 0
+            and m0["shared_prefix_registrations"] == len(tenants) - 1)
+
+        futs = []
+        t0 = time.perf_counter()
+        for r in records:
+            for t, _slo in tenants:
+                futs.append(fleet.submit(t, r))
+        for f in futs:
+            f.result(timeout=120)
+        dt = time.perf_counter() - t0
+        out["aggregate_rps"] = round(len(futs) / dt, 1)
+        m = fleet.metrics()
+        out["per_tenant_p99_ms"] = {
+            t: m["tenants"][t].get("latency_p99_ms")
+            for t, _slo in tenants}
+        out["gate_per_tenant_p99"] = bool(all(
+            v is not None and v > 0
+            for v in out["per_tenant_p99_ms"].values()))
+        out["clean_shed"] = m["batcher"]["shed"]
+
+    # induced overload: a tiny queue and a long flush window hold the
+    # pending set still; a bronze flood fills it, then a gold burst must
+    # shed bronze entries (lowest tier first) and itself be admitted
+    with FleetServer(max_batch=4096, max_wait_ms=250.0,
+                     max_queue=128) as fleet2:
+        fleet2.register("og", model, slo="gold")
+        fleet2.register("ob", model, slo="bronze")
+        flood = (records * ((128 // len(records)) + 1))[:128]
+        burst = records[:64]
+        bronze_futs = [fleet2.submit("ob", r) for r in flood]
+        gold_futs = [fleet2.submit("og", r) for r in burst]
+        gold_ok = sum(1 for f in gold_futs
+                      if not isinstance(f.exception(timeout=120), Exception))
+        shed_bronze = sum(1 for f in bronze_futs
+                          if isinstance(f.exception(timeout=120),
+                                        LoadShedError))
+        m2 = fleet2.metrics()
+        out["overload"] = {
+            "queue": 128,
+            "bronze_submitted": len(bronze_futs),
+            "gold_submitted": len(gold_futs),
+            "gold_completed": gold_ok,
+            "shed_by_tier": {
+                "gold": m2["tenants"]["og"].get("shed", 0),
+                "bronze": m2["tenants"]["ob"].get("shed", 0),
+            },
+            "shed_total": m2["batcher"]["shed"],
+            "rejected": m2["batcher"]["rejected"],
+        }
+        out["gate_shed_lowest_tier_first"] = bool(
+            shed_bronze == len(burst)
+            and m2["tenants"]["ob"].get("shed", 0) == len(burst)
+            and m2["tenants"]["og"].get("shed", 0) == 0
+            and gold_ok == len(burst))
+    return out
+
+
 def bench_irls_mfu(n_rows: int, device_kind: str):
     """Achieved TFLOP/s (+ fraction of bf16 peak) of the IRLS CV sweep kernel."""
     import jax
@@ -1022,6 +1113,7 @@ _SECTION_FLOORS = {
     "serve": 40.0,
     "obs": 40.0,
     "stream": 40.0,
+    "fleet": 40.0,
     "irls_mfu": 60.0,
     "tree_hist": 60.0,
     "tree_hist_batched": 90.0,
@@ -1200,6 +1292,15 @@ def main(argv=None):
         lambda: bench_stream(1_000 if smoke else 5_000))
     if st is not None:
         _OUT["stream"] = st
+
+    # multi-tenant fleet (ISSUE 12): aggregate rps across N tenants, the
+    # shared-prefix compile-dedup gate, and lowest-tier-first shedding
+    # under induced overload
+    fl = _run_section(
+        "fleet", budget,
+        lambda: bench_fleet(500 if smoke else 2_000))
+    if fl is not None:
+        _OUT["fleet"] = fl
 
     mfu = _run_section(
         "irls_mfu", budget,

@@ -390,6 +390,24 @@ class TestBenchSmoke:
         assert st["records_per_sec"] > 0
         assert st["shadow_mirrored"] == st["records"], st
         assert st["shadow_failures"] == 0, st
+        # multi-tenant fleet (ISSUE 12): N tenants behind one SLO-tiered
+        # batcher — registrations past the first share the content-addressed
+        # executables at zero compiles, per-tenant p99s are recorded, and
+        # induced overload sheds ONLY the bronze tier while the gold burst
+        # completes in full
+        assert secs["fleet"]["status"] == "ok", secs["fleet"]
+        fl = parsed["fleet"]
+        assert fl["gate_shared_prefix_dedup"] is True, fl
+        assert fl["dedup_backend_compiles"] == 0, fl
+        assert fl["fleet_shared_prefix_compiles"] == fl["tenants"] - 1, fl
+        assert fl["aggregate_rps"] > 0
+        assert fl["gate_per_tenant_p99"] is True, fl
+        assert len(fl["per_tenant_p99_ms"]) == fl["tenants"]
+        assert fl["gate_shed_lowest_tier_first"] is True, fl
+        assert fl["overload"]["shed_by_tier"]["bronze"] > 0
+        assert fl["overload"]["shed_by_tier"]["gold"] == 0
+        assert fl["overload"]["gold_completed"] == \
+            fl["overload"]["gold_submitted"]
         # static cost model (ISSUE 6): predicted FLOPs/bytes recorded beside
         # the measured transform/sweep numbers, calibration within the band
         assert tr["predicted_flops"] > 0, tr

@@ -265,6 +265,112 @@ class TestCliGen:
             generate_project(csv, "nope", str(tmp_path / "p"))
 
 
+class TestCliServeFleet:
+    """``cli serve --models DIR`` (ISSUE 12 satellite): multi-model replay
+    with a tenant column in the JSONL in/out contract."""
+
+    @pytest.fixture(scope="class")
+    def fleet_dir(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("fleet")
+        df = _df(seed=5)
+        wf, pred = _workflow()
+        model = (wf.set_reader(DataReaders.Simple.dataframe(df))).train()
+        models = tmp / "models"
+        for tenant in ("acme", "globex"):
+            model.save(str(models / tenant))
+        return tmp, models, model, pred
+
+    def test_models_dir_round_trip(self, fleet_dir, tmp_path):
+        """Records route by their tenant column; every output row echoes
+        the tenant back and per-tenant scores match the single-model
+        serving plan bitwise."""
+        tmp, models, model, pred = fleet_dir
+        recs = [{"x": float(i) / 7 - 1.0, "c": "a" if i % 2 else "b"}
+                for i in range(12)]
+        lines = [json.dumps({"tenant": ("acme" if i % 2 else "globex"),
+                             **r}) for i, r in enumerate(recs)]
+        rec_file = tmp_path / "records.jsonl"
+        rec_file.write_text("\n".join(lines) + "\n")
+        out_file = tmp_path / "scores.jsonl"
+        metrics_file = tmp_path / "metrics.json"
+
+        from transmogrifai_tpu.cli.gen import main
+
+        # warm stays ON: the second tenant's ladder must come from the
+        # shared executable cache (the dedup figure asserted below)
+        rc = main(["serve", "--models", str(models),
+                   "--records", str(rec_file),
+                   "--output", str(out_file),
+                   "--metrics-out", str(metrics_file),
+                   "--max-batch", "8", "--max-wait-ms", "1",
+                   "--min-bucket", "8"])
+        assert rc == 0
+        rows = [json.loads(line) for line in
+                out_file.read_text().splitlines()]
+        assert len(rows) == 12
+        # tenant column round-trips in input order
+        assert [r["tenant"] for r in rows] == \
+            [("acme" if i % 2 else "globex") for i in range(12)]
+        loaded = model.__class__.load(str(models / "acme"))
+        plan = loaded.serving_plan()
+        expected = plan.score(recs)
+        for row, exp in zip(rows, expected):
+            got = {k: v for k, v in row.items() if k != "tenant"}
+            assert got == json.loads(json.dumps(exp))
+        metrics = json.loads(metrics_file.read_text())
+        assert sorted(metrics["tenants"]) == ["acme", "globex"]
+        assert metrics["replay"]["tenants"] == ["acme", "globex"]
+        assert metrics["replay"]["record_errors"] == 0
+        assert metrics["tenants"]["acme"]["scored_records"] == 6
+        assert metrics["tenants"]["globex"]["scored_records"] == 6
+        # both subdirectories hold the same saved model: the second tenant
+        # registered against the shared fingerprint
+        assert metrics["fleet"]["shared_prefix_registrations"] == 1
+
+    def test_models_dir_unknown_tenant_is_error_row(self, fleet_dir,
+                                                    tmp_path):
+        tmp, models, model, pred = fleet_dir
+        lines = [json.dumps({"tenant": "acme", "x": 0.5, "c": "a"}),
+                 json.dumps({"x": 0.5, "c": "a"}),            # no tenant
+                 json.dumps({"tenant": "nope", "x": 0.5, "c": "a"})]
+        rec_file = tmp_path / "records.jsonl"
+        rec_file.write_text("\n".join(lines) + "\n")
+        out_file = tmp_path / "scores.jsonl"
+
+        from transmogrifai_tpu.cli.gen import main
+
+        rc = main(["serve", "--models", str(models),
+                   "--records", str(rec_file),
+                   "--output", str(out_file),
+                   "--max-batch", "4", "--max-wait-ms", "1", "--no-warm"])
+        assert rc != 0  # record errors surface in the exit code
+        rows = [json.loads(line) for line in
+                out_file.read_text().splitlines()]
+        assert len(rows) == 3
+        assert "error" not in rows[0] and rows[0]["tenant"] == "acme"
+        assert rows[1]["error_type"] == "UnknownTenantError"
+        assert rows[2]["error_type"] == "UnknownTenantError"
+        assert rows[2]["tenant"] == "nope"
+
+    def test_model_and_models_are_mutually_exclusive(self, fleet_dir,
+                                                     tmp_path):
+        tmp, models, *_ = fleet_dir
+        rec_file = tmp_path / "r.jsonl"
+        rec_file.write_text(json.dumps({"tenant": "acme", "x": 1.0,
+                                        "c": "a"}) + "\n")
+
+        from transmogrifai_tpu.cli.gen import main
+
+        with pytest.raises(SystemExit, match="mutually exclusive"):
+            main(["serve", "--model", str(models / "acme"),
+                  "--models", str(models), "--records", str(rec_file)])
+        with pytest.raises(SystemExit, match="one of --model or --models"):
+            main(["serve", "--records", str(rec_file)])
+        with pytest.raises(SystemExit, match="single-model only"):
+            main(["serve", "--models", str(models), "--follow",
+                  "--records", str(rec_file)])
+
+
 _HAZARD_SOURCE = '''\
 import jax.numpy as jnp
 

@@ -35,6 +35,7 @@ from transmogrifai_tpu.serve import (
     FaultHarness,
     MicroBatcher,
     PoisonRecordError,
+    QueueFullError,
     ResilientScorer,
     ScoringServer,
     TransientScoringError,
@@ -480,6 +481,99 @@ class TestBatcherAccounting:
             assert f.done()
         gate.set()                     # release the flusher; it exits
         mb.shutdown(drain=False, timeout=10)
+
+    def test_reclaim_counter_split_deadline_vs_cancelled_vs_shed(self):
+        """Regression (ISSUE 12 satellite): the backpressure reclaim is
+        deadline-then-tier aware and its accounting stays distinct — an
+        expired entry counts deadline_expired, a client-cancelled entry
+        discovered by the scan counts cancelled, a live lower-tier entry
+        evicted for a higher-tier request counts shed.  Pre-refactor the
+        scan only reclaimed expired deadlines and refused everything else
+        blindly."""
+        from transmogrifai_tpu.serve import LoadShedError
+
+        gate = threading.Event()
+
+        def scorer(rs):
+            gate.wait(10)
+            return list(rs)
+
+        mb = MicroBatcher(scorer, max_batch=1, max_wait_ms=1, max_queue=3)
+        try:
+            mb.submit({"i": 0})            # occupies the flusher
+            time.sleep(0.05)
+            f_exp = mb.submit({"i": 1}, deadline_ms=1, slo="bronze")
+            f_cancel = mb.submit({"i": 2}, slo="bronze")
+            f_low = mb.submit({"i": 3}, slo="bronze")   # queue now full
+            time.sleep(0.02)               # f_exp's deadline passes
+            # 1) deadline reclaim admits gold1 without shedding anyone
+            f_gold1 = mb.submit({"i": 4}, slo="gold")
+            with pytest.raises(DeadlineExceededError):
+                f_exp.result(timeout=10)
+            m = mb.metrics()
+            assert (m["deadline_expired"], m["cancelled"], m["shed"],
+                    m["rejected"]) == (1, 0, 0, 0), m
+            # 2) a client-abandoned entry found by the scan is CANCELLED,
+            #    not shed — removing it already makes room
+            assert f_cancel.cancel()
+            f_gold2 = mb.submit({"i": 5}, slo="gold")
+            m = mb.metrics()
+            assert (m["deadline_expired"], m["cancelled"], m["shed"],
+                    m["rejected"]) == (1, 1, 0, 0), m
+            # 3) queue full of live entries: the bronze one is shed for gold
+            f_gold3 = mb.submit({"i": 6}, slo="gold")
+            with pytest.raises(LoadShedError):
+                f_low.result(timeout=10)
+            m = mb.metrics()
+            assert (m["deadline_expired"], m["cancelled"], m["shed"],
+                    m["rejected"]) == (1, 1, 1, 0), m
+            # 4) equal/lower tier never sheds: a bronze arrival against a
+            #    gold-only queue is refused outright
+            with pytest.raises(QueueFullError):
+                mb.submit({"i": 7}, slo="bronze")
+            m = mb.metrics()
+            assert m["rejected"] == 1 and m["shed"] == 1, m
+            gate.set()
+            for f in (f_gold1, f_gold2, f_gold3):
+                assert f.result(timeout=10)
+        finally:
+            gate.set()
+            mb.shutdown(drain=True, timeout=10)
+
+    def test_degraded_tenant_absorbs_shedding_first(self):
+        """Breaker-driven escalation: a degraded tenant's queued requests
+        drop below every tier, so even its gold traffic is shed before a
+        healthy tenant's bronze."""
+        from transmogrifai_tpu.serve import LoadShedError
+
+        gate = threading.Event()
+
+        def scorer(rs):
+            gate.wait(10)
+            return list(rs)
+
+        mb = MicroBatcher(scorer, max_batch=1, max_wait_ms=1, max_queue=2)
+        try:
+            mb.submit({"i": 0})
+            time.sleep(0.05)
+            mb.set_degraded("sick", True)
+            f_sick = mb.submit({"i": 1}, tenant="sick", slo="gold")
+            f_healthy = mb.submit({"i": 2}, tenant="ok", slo="bronze")
+            f_in = mb.submit({"i": 3}, tenant="ok", slo="bronze")
+            with pytest.raises(LoadShedError) as ei:
+                f_sick.result(timeout=10)
+            assert ei.value.tenant == "sick"
+            assert not f_healthy.done()
+            m = mb.metrics()
+            assert m["shed"] == 1 and m["rejected"] == 0, m
+            assert mb.tenant_metrics()["sick"]["shed"] == 1
+            # recovery clears the demotion: the tenant sheds normally again
+            mb.set_degraded("sick", False)
+            gate.set()
+            assert f_in.result(timeout=10) == {"i": 3}
+        finally:
+            gate.set()
+            mb.shutdown(drain=True, timeout=10)
 
     def test_client_cancel_counts_cancelled(self):
         gate = threading.Event()

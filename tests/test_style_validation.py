@@ -101,13 +101,19 @@ class TestStyleValidation:
         named; obs/ joined with the unified telemetry backbone (ISSUE 11) —
         the process-global tracer/recorder installs and the metrics
         registry are exactly the module-level-mutable-state pattern TM306
-        exists for, and every span site is hot-path code."""
+        exists for, and every span site is hot-path code; the multi-tenant
+        fleet registry (serve/registry.py, ISSUE 12) rides the serve/ walk —
+        its tenant table, admission/eviction controller, and the batcher's
+        shed scan are concurrent control-plane state, so the gate asserts
+        the module is actually in the linted set (a rename/move must not
+        silently drop it)."""
         from transmogrifai_tpu.checkers.opcheck import (
             lint_file,
             lint_file_concurrency,
         )
 
         findings = []
+        linted = []
         for sub in ("serve", "perf", "perf/kernels", "checkers", "cli",
                     "workflow", "readers", "obs"):
             d = os.path.join(PKG_ROOT, sub)
@@ -115,12 +121,15 @@ class TestStyleValidation:
                 if not f.endswith(".py"):
                     continue
                 path = os.path.join(d, f)
+                rel = os.path.relpath(path, PKG_ROOT)
+                linted.append(rel)
                 for fi in list(lint_file(path, only_names=None)) \
                         + list(lint_file_concurrency(path)):
-                    rel = os.path.relpath(path, PKG_ROOT)
                     findings.append(
                         f"{rel}:{fi.lineno} {fi.code} {fi.qualname}: "
                         f"{fi.message}")
+        assert os.path.join("serve", "registry.py") in linted, \
+            "the fleet registry module left the lint gate"
         assert not findings, (
             "unallowlisted hazards in serve//perf/ (fix them, or mark "
             "intentional ones inline with '# opcheck: allow(TMxxx) reason'):\n"

@@ -158,6 +158,13 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "executables (a warm refit that froze the prep stages would); "
               "the swap is still atomic, but the candidate pays XLA "
               "compilation at stage time instead of sharing the cache"),
+    "TM509": (Severity.ERROR, "fleet HBM admission refused",
+              "the multi-tenant registry cannot admit this model: the sum "
+              "of static peak-HBM estimates across resident warm "
+              "executables plus the candidate exceeds the fleet hbm_budget "
+              "even after evicting every cold tenant's buckets (LRU by "
+              "last-scored); raise hbm_budget, shrink the bucket ladder "
+              "(max_bucket), or unregister tenants"),
     # -- plan cost (jaxpr-level static analysis, checkers/plancheck.py) -----
     "TM601": (Severity.ERROR, "plan exceeds the HBM budget",
               "the fused program's peak live-buffer estimate at its largest "

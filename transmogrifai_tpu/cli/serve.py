@@ -34,8 +34,20 @@ def add_serve_parser(sub) -> None:
     p = sub.add_parser(
         "serve", help="score a JSONL record stream through the micro-batched "
                       "in-process serving engine")
-    p.add_argument("--model", required=True,
+    p.add_argument("--model", default=None,
                    help="saved WorkflowModel directory (model.save(path))")
+    p.add_argument("--models", default=None, metavar="DIR",
+                   help="multi-tenant fleet replay (serve/registry.py): DIR "
+                        "holds one saved model per subdirectory, the "
+                        "subdirectory name is the tenant id; every input "
+                        "record must carry a 'tenant' field (an optional "
+                        "'slo' field overrides the tenant's class), both "
+                        "stripped before scoring, and every output row "
+                        "echoes the tenant column")
+    p.add_argument("--hbm-budget", type=float, default=None,
+                   help="fleet HBM admission budget in bytes (--models "
+                        "mode): cold tenants' executables are evicted LRU "
+                        "before a registration is refused with TM509")
     p.add_argument("--records", required=True,
                    help="JSONL file of records to score ('-' for stdin)")
     p.add_argument("--output", default="-",
@@ -156,6 +168,25 @@ def _resolve(future) -> Tuple[Dict[str, Any], bool]:
         return future.result(), True
     except Exception as e:  # noqa: BLE001 — every failure becomes a row
         return {"error": str(e), "error_type": type(e).__name__}, False
+
+
+def _write_replay_outputs(ns, results, metrics) -> None:
+    """One-shot replay epilogue shared by the single-model and fleet
+    paths: the JSONL result rows, then the metrics blob to --metrics-out
+    (or stderr)."""
+    out = sys.stdout if ns.output == "-" else open(ns.output, "w")
+    try:
+        for r in results:
+            out.write(json.dumps(r) + "\n")
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    blob = json.dumps(metrics, indent=2, default=str)
+    if ns.metrics_out:
+        with open(ns.metrics_out, "w") as fh:
+            fh.write(blob + "\n")
+    else:
+        print(blob, file=sys.stderr)
 
 
 def _resolve_cli_telemetry(ns):
@@ -315,10 +346,110 @@ def _run_follow(ns, model) -> int:
     return 0 if errors == 0 else 1
 
 
+def _run_fleet(ns) -> int:
+    """Multi-tenant replay (``--models DIR``): every subdirectory of DIR is
+    one tenant's saved model; records route by their ``tenant`` column
+    through the shared SLO-tiered micro-batcher and each output row echoes
+    the tenant back — the JSONL in/out contract stays line-per-record.
+
+    A record without a (known) tenant becomes an error row in its position;
+    the replay finishes and exits nonzero, mirroring the single-model
+    hardening contract."""
+    import os
+
+    from ..serve import FleetServer, QueueFullError, UnknownTenantError
+    from ..workflow.workflow import WorkflowModel
+
+    tenant_dirs = sorted(
+        d for d in os.listdir(ns.models)
+        if os.path.isdir(os.path.join(ns.models, d)))
+    if not tenant_dirs:
+        raise SystemExit(f"serve: no model subdirectories in {ns.models!r}")
+    records, skipped = _read_records(ns.records)
+
+    from collections import deque
+
+    errors = 0
+    tel = _resolve_cli_telemetry(ns)
+    metrics: Dict[str, Any] = {}
+    prom = None
+    results: List[Dict[str, Any]] = []
+    try:
+        if tel is not None:
+            tel.start()
+        with FleetServer(max_batch=ns.max_batch, max_wait_ms=ns.max_wait_ms,
+                         max_queue=ns.max_queue, min_bucket=ns.min_bucket,
+                         resilience=not ns.no_resilience,
+                         deadline_ms=ns.deadline_ms,
+                         hbm_budget=ns.hbm_budget) as fleet:
+            for tenant in tenant_dirs:
+                fleet.register(
+                    tenant,
+                    WorkflowModel.load(os.path.join(ns.models, tenant)),
+                    warm=not ns.no_warm)
+
+            def resolve(tenant, future):
+                # a submit-time refusal is already row-shaped; output rows
+                # stay in input order either way
+                if isinstance(future, dict):
+                    return future, False
+                row, ok = _resolve(future)
+                return {"tenant": tenant, **row}, ok
+
+            futures: deque = deque()
+            for r in records:
+                r = dict(r)
+                tenant = r.pop("tenant", None)
+                slo = r.pop("slo", None)
+                try:
+                    while True:
+                        try:
+                            futures.append(
+                                (tenant, fleet.submit(tenant, r, slo=slo)))
+                            break
+                        except QueueFullError:
+                            # backpressure: wait out the oldest in-flight
+                            # request (shed futures resolve here too)
+                            row, ok = resolve(*futures.popleft())
+                            errors += not ok
+                            results.append(row)
+                except (UnknownTenantError, ValueError) as e:
+                    futures.append((tenant,
+                                    {"tenant": tenant, "error": str(e),
+                                     "error_type": type(e).__name__}))
+            for tenant, f in futures:
+                row, ok = resolve(tenant, f)
+                errors += not ok
+                results.append(row)
+            metrics = fleet.metrics()
+            prom = fleet.prometheus()
+    finally:
+        if tel is not None:
+            tel.stop()
+            tel.dump(metrics_payload={"source": "cli serve --models",
+                                      "metrics": metrics},
+                     prometheus=prom)
+    metrics["replay"] = {"records": len(records),
+                         "tenants": tenant_dirs,
+                         "skipped_malformed": skipped,
+                         "record_errors": errors}
+    _write_replay_outputs(ns, results, metrics)
+    return 0 if errors == 0 else 1
+
+
 def run_serve(ns) -> int:
     from ..serve import ScoringServer
     from ..workflow.workflow import WorkflowModel
 
+    if ns.model and ns.models:
+        raise SystemExit("serve: --model and --models are mutually exclusive")
+    if not ns.model and not ns.models:
+        raise SystemExit("serve: one of --model or --models is required")
+    if ns.models:
+        if ns.follow:
+            raise SystemExit("serve: --follow is single-model only "
+                             "(use --model)")
+        return _run_fleet(ns)
     model = WorkflowModel.load(ns.model)
     if ns.follow:
         return _run_follow(ns, model)
@@ -370,19 +501,5 @@ def run_serve(ns) -> int:
     metrics["replay"] = {"records": len(records),
                          "skipped_malformed": skipped,
                          "record_errors": errors}
-
-    out = sys.stdout if ns.output == "-" else open(ns.output, "w")
-    try:
-        for r in results:
-            out.write(json.dumps(r) + "\n")
-    finally:
-        if out is not sys.stdout:
-            out.close()
-
-    blob = json.dumps(metrics, indent=2, default=str)
-    if ns.metrics_out:
-        with open(ns.metrics_out, "w") as fh:
-            fh.write(blob + "\n")
-    else:
-        print(blob, file=sys.stderr)
+    _write_replay_outputs(ns, results, metrics)
     return 0 if errors == 0 else 1

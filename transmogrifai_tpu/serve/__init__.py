@@ -13,20 +13,29 @@ host-path circuit breaker) with deterministic fault injection in
 servability diagnostics.  See docs/serving.md.
 """
 
-from .batcher import BatcherClosedError, MicroBatcher, QueueFullError
+from .batcher import (
+    DEFAULT_SLO_CLASSES,
+    BatcherClosedError,
+    MicroBatcher,
+    QueueFullError,
+    SloClass,
+)
 from .faults import (
     CircuitOpenError,
     DeadlineExceededError,
     FaultHarness,
+    LoadShedError,
     PoisonRecordError,
     TransientScoringError,
     is_retryable,
 )
 from .plan import CompiledScoringPlan, compile_plan
+from .registry import FleetServer, ModelRegistry, TenantState, UnknownTenantError
 from .resilience import CircuitBreaker, ResilientScorer
 from .server import ScoringServer
 from .swap import ModelEntry, SwappableScorer, prediction_delta
 from .validator import (
+    check_fleet_admission,
     check_plan_admission,
     check_resilience_config,
     check_servability,
@@ -38,16 +47,24 @@ __all__ = [
     "CircuitBreaker",
     "CircuitOpenError",
     "CompiledScoringPlan",
+    "DEFAULT_SLO_CLASSES",
     "DeadlineExceededError",
     "FaultHarness",
+    "FleetServer",
+    "LoadShedError",
     "MicroBatcher",
     "ModelEntry",
+    "ModelRegistry",
     "PoisonRecordError",
     "QueueFullError",
     "ResilientScorer",
     "ScoringServer",
+    "SloClass",
     "SwappableScorer",
+    "TenantState",
     "TransientScoringError",
+    "UnknownTenantError",
+    "check_fleet_admission",
     "check_plan_admission",
     "check_resilience_config",
     "check_servability",

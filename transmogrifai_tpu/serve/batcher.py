@@ -15,6 +15,22 @@ Backpressure is admission control: a full queue rejects ``submit`` with
 or retry with jitter).  ``shutdown(drain=True)`` stops admission, drains the
 queue in full batches with no deadline waits, and joins the flusher.
 
+Per-tenant SLO classes (the multi-tenant fleet, serve/registry.py):
+``submit(record, tenant=..., slo=...)`` tags the request with an
+:class:`SloClass` — a shedding tier (higher survives longer) plus an
+optional tiered default deadline.  Under backpressure the eviction scan is
+**deadline-then-tier**: expired-deadline entries are reclaimed first, then
+queued entries whose *effective* tier sits strictly below the incoming
+request's are shed lowest-tier-first (oldest within a tier) with
+:class:`~.faults.LoadShedError` — so under overload the lowest class
+degrades first instead of admission refusing blindly.  A tenant marked
+degraded (``set_degraded`` — the fleet flips it when the tenant's circuit
+breaker opens) has every queued and incoming request demoted below every
+configured tier: degraded tenants absorb the cuts, healthy ones keep their
+p99.  ``shed``/``cancelled``/``deadline_expired`` accounting stays
+distinct: a shed entry was live and evicted for tier, a cancelled one was
+already abandoned client-side, an expired one outlived its deadline.
+
 Request deadlines: ``submit(record, deadline_ms=...)`` bounds the request's
 TOTAL queue life, enforced server-side — an expired request is evicted with
 :class:`~.faults.DeadlineExceededError` inside the queue (making room under
@@ -43,11 +59,12 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+from typing import (Any, Callable, Dict, List, Mapping, NamedTuple, Optional,
+                    Sequence, Tuple, Union)
 
 from ..obs import trace as obs_trace
 from ..obs.metrics import MetricsRegistry
-from .faults import DeadlineExceededError
+from .faults import DeadlineExceededError, LoadShedError, fault_point
 
 
 class QueueFullError(RuntimeError):
@@ -58,16 +75,46 @@ class BatcherClosedError(RuntimeError):
     """submit() after shutdown began."""
 
 
+class SloClass(NamedTuple):
+    """One service class: shedding tier (higher = survives backpressure
+    longer) and an optional tiered default request deadline."""
+
+    name: str
+    tier: int
+    deadline_ms: Optional[float] = None
+
+
+#: the default three-class ladder (docs/serving.md "Multi-tenant fleet");
+#: deadlines default to None so a class only bounds queue life when the
+#: deployment configures it
+DEFAULT_SLO_CLASSES: Dict[str, SloClass] = {
+    "gold": SloClass("gold", 2),
+    "silver": SloClass("silver", 1),
+    "bronze": SloClass("bronze", 0),
+}
+
+#: tier demotion applied to every request of a degraded (breaker-open)
+#: tenant: large enough to sink below any configured tier, so degraded
+#: tenants absorb the shedding cuts first
+_DEGRADED_TIER_PENALTY = 1_000_000
+
+
 class _Request:
-    __slots__ = ("record", "future", "t_enqueue", "deadline")
+    __slots__ = ("record", "future", "t_enqueue", "deadline", "tenant",
+                 "tier", "slo")
 
     def __init__(self, record: Mapping[str, Any],
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 tenant: Optional[str] = None, tier: int = 0,
+                 slo: Optional[str] = None):
         self.record = record
         self.future: Future = Future()
         self.t_enqueue = time.monotonic()
         self.deadline = None if deadline_ms is None \
             else self.t_enqueue + float(deadline_ms) / 1e3
+        self.tenant = tenant
+        self.tier = tier
+        self.slo = slo
 
 
 class MicroBatcher:
@@ -81,21 +128,36 @@ class MicroBatcher:
     def __init__(self, score_batch: Callable[[List[Any]], Sequence[Any]],
                  max_batch: int = 256, max_wait_ms: float = 2.0,
                  max_queue: int = 4096,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 slo_classes: Optional[Mapping[str, SloClass]] = None):
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
         self._score = score_batch
+        # multi-tenant routing protocol (serve/registry.py): the fleet
+        # dispatcher receives the per-request tenant ids alongside the
+        # records and fans each sub-batch to its tenant's scoring stack
+        self._fleet = callable(getattr(score_batch,
+                                       "score_isolated_tenants", None))
         # per-record isolation protocol (serve/resilience.py): outcomes are
         # routed future-by-future instead of all-or-nothing
         self._isolated = callable(getattr(score_batch, "score_isolated", None))
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.max_queue = int(max_queue)
+        self.slo_classes: Dict[str, SloClass] = dict(
+            DEFAULT_SLO_CLASSES if slo_classes is None else slo_classes)
 
         self._pending: "deque[_Request]" = deque()
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._open = True
+        #: tenants whose requests are demoted below every tier (the fleet
+        #: flips membership when a tenant's breaker opens/recloses)
+        self._degraded: set = set()
+        # per-tenant labeled metric cache; its own lock because the shed
+        # path reaches it while holding the non-reentrant batcher lock
+        self._tenant_metrics: Dict[Tuple[str, str], Any] = {}
+        self._tenant_metrics_lock = threading.Lock()
         # canonical counters (obs/metrics.py) — metrics() is the legacy view
         self.registry = registry if registry is not None else MetricsRegistry()
         from ..obs.metrics import canonical_help as _h
@@ -109,6 +171,7 @@ class MicroBatcher:
         self._c_failed = _c("tmog_serve_batcher_failed_total")
         self._c_cancelled = _c("tmog_serve_batcher_cancelled_total")
         self._c_deadline = _c("tmog_serve_batcher_deadline_expired_total")
+        self._c_shed = _c("tmog_serve_batcher_shed_total")
         self._c_batches = _c("tmog_serve_batcher_batches_total")
         self._g_depth = self.registry.gauge(
             "tmog_serve_batcher_queue_depth",
@@ -124,26 +187,54 @@ class MicroBatcher:
         self._thread.start()
 
     # -- client API ----------------------------------------------------------
+    def _resolve_slo(self, slo: Union[None, str, SloClass]
+                     ) -> Optional[SloClass]:
+        if slo is None or isinstance(slo, SloClass):
+            return slo
+        cls = self.slo_classes.get(slo)
+        if cls is None:
+            raise ValueError(f"unknown SLO class {slo!r}; configured: "
+                             f"{sorted(self.slo_classes)}")
+        return cls
+
     def submit(self, record: Mapping[str, Any],
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None,
+               slo: Union[None, str, SloClass] = None) -> Future:
         """Enqueue one record; resolves to its result dict.
 
         ``deadline_ms`` bounds the request's queue life: once it expires the
         request is evicted with :class:`DeadlineExceededError` instead of
-        spending a device call on it.  Raises :class:`QueueFullError` when
-        the queue is at capacity and :class:`BatcherClosedError` after
+        spending a device call on it.  ``slo`` (a configured class name or
+        an :class:`SloClass`) sets the shedding tier and, when
+        ``deadline_ms`` is not given, the class's tiered default deadline.
+        Raises :class:`QueueFullError` when the queue is at capacity and no
+        lower-tier entry can be shed, and :class:`BatcherClosedError` after
         shutdown began.
         """
-        req = _Request(record, deadline_ms)
+        slo_cls = self._resolve_slo(slo)
+        if deadline_ms is None and slo_cls is not None:
+            deadline_ms = slo_cls.deadline_ms
+        req = _Request(record, deadline_ms, tenant=tenant,
+                       tier=slo_cls.tier if slo_cls is not None else 0,
+                       slo=slo_cls.name if slo_cls is not None else None)
         expired: List[_Request] = []
+        shed: List[_Request] = []
         try:
             with self._wake:
                 if not self._open:
                     raise BatcherClosedError("MicroBatcher is shut down")
                 if len(self._pending) >= self.max_queue:
-                    # expired requests are dead weight: evict them before
-                    # rejecting a live one (deadline enforcement IN the queue)
-                    expired = self._pop_expired_locked()
+                    # deadline-then-tier reclaim: expired requests are dead
+                    # weight and go first; live lower-tier entries are shed
+                    # only for a strictly higher-tier incoming request.
+                    # The fault point fires BEFORE any entry is claimed, so
+                    # an injected shed fault leaves the queue untouched.
+                    fault_point("shed", tenant=tenant,
+                                tier=self._eff_tier_locked(req),
+                                queue_depth=len(self._pending))
+                    expired, shed = self._reclaim_locked(
+                        self._eff_tier_locked(req))
                 if len(self._pending) >= self.max_queue:
                     self._c_rejected.inc()
                     raise QueueFullError(
@@ -168,7 +259,19 @@ class MicroBatcher:
             for r in expired:
                 r.future.set_exception(DeadlineExceededError(
                     "request deadline expired while queued"))
+            for r in shed:
+                r.future.set_exception(LoadShedError(
+                    f"request shed at tier {r.tier} to admit higher-tier "
+                    "traffic under backpressure",
+                    tenant=r.tenant, tier=r.tier))
         return req.future
+
+    def _eff_tier_locked(self, req: _Request) -> int:
+        """Effective shedding tier (lock held): the SLO tier, demoted below
+        every configured class while the request's tenant is degraded."""
+        if req.tenant is not None and req.tenant in self._degraded:
+            return req.tier - _DEGRADED_TIER_PENALTY
+        return req.tier
 
     def _pop_expired_locked(self) -> List[_Request]:
         """Remove queued requests whose deadline passed (lock held) and
@@ -190,6 +293,97 @@ class MicroBatcher:
                 keep.append(r)
         self._pending = keep
         return expired
+
+    def _reclaim_locked(self, incoming_tier: int
+                        ) -> Tuple[List[_Request], List[_Request]]:
+        """Deadline-then-tier eviction scan under backpressure (lock held).
+
+        Returns ``(expired, shed)`` — the CLAIMED requests for the caller
+        to fail outside the lock.  The counter split stays exact: expired
+        deadlines count ``deadline_expired``, tier evictions count ``shed``
+        (globally and per tenant), and entries found already cancelled
+        client-side count ``cancelled`` — a shed is a live request the
+        server chose to drop, never a client abandonment.
+        """
+        expired = self._pop_expired_locked()
+        shed: List[_Request] = []
+        while len(self._pending) >= self.max_queue:
+            victim_i, victim_tier = -1, incoming_tier
+            for i, r in enumerate(self._pending):
+                t = self._eff_tier_locked(r)
+                if t < victim_tier:  # strict: equal tiers are never shed
+                    victim_i, victim_tier = i, t
+            if victim_i < 0:
+                break
+            victim = self._pending[victim_i]
+            del self._pending[victim_i]
+            if victim.future.set_running_or_notify_cancel():
+                self._c_shed.inc()
+                if victim.tenant is not None:
+                    self._tenant_counter("tmog_serve_batcher_shed_total",
+                                         victim.tenant).inc()
+                shed.append(victim)
+            else:
+                self._c_cancelled.inc()
+        return expired, shed
+
+    # -- per-tenant state (the fleet registry drives these) ------------------
+    def set_degraded(self, tenant: str, degraded: bool) -> None:
+        """Mark/unmark ``tenant`` as degraded: its queued and incoming
+        requests drop below every configured tier, so shedding consumes the
+        degraded tenant's traffic first."""
+        with self._lock:
+            if degraded:
+                self._degraded.add(tenant)
+            else:
+                self._degraded.discard(tenant)
+
+    def drop_tenant(self, tenant: str) -> None:
+        """Forget a tenant's cached labeled metrics + degraded flag (the
+        registry eviction hook; the registry itself drops the exported
+        series via ``drop_labeled``)."""
+        with self._lock:
+            self._degraded.discard(tenant)
+        with self._tenant_metrics_lock:
+            for key in [k for k in self._tenant_metrics if k[1] == tenant]:
+                del self._tenant_metrics[key]
+
+    def _tenant_metric(self, ctor, name: str, tenant: str, **kw):
+        key = (name, tenant)
+        with self._tenant_metrics_lock:
+            m = self._tenant_metrics.get(key)
+            if m is None:
+                from ..obs.metrics import canonical_help as _h
+
+                m = ctor(name, _h(name), labels={"tenant": tenant}, **kw)
+                self._tenant_metrics[key] = m
+            return m
+
+    def _tenant_counter(self, name: str, tenant: str):
+        return self._tenant_metric(self.registry.counter, name, tenant)
+
+    def _tenant_latency(self, tenant: str):
+        return self._tenant_metric(self.registry.histogram,
+                                   "tmog_serve_batcher_latency_seconds",
+                                   tenant)
+
+    def tenant_metrics(self) -> Dict[str, Dict[str, Any]]:
+        """{tenant: {shed, latency_p50_ms/p95/p99}} over the per-tenant
+        labeled series this batcher has created."""
+        with self._tenant_metrics_lock:
+            items = dict(self._tenant_metrics)
+        out: Dict[str, Dict[str, Any]] = {}
+        for (name, tenant), m in sorted(items.items()):
+            row = out.setdefault(tenant, {})
+            if name == "tmog_serve_batcher_shed_total":
+                row["shed"] = m.value
+            elif name == "tmog_serve_batcher_latency_seconds":
+                for q, key in ((0.50, "latency_p50_ms"),
+                               (0.95, "latency_p95_ms"),
+                               (0.99, "latency_p99_ms")):
+                    v = m.quantile(q)
+                    row[key] = round(v * 1e3, 4) if v is not None else None
+        return out
 
     def score(self, record: Mapping[str, Any],
               timeout: Optional[float] = None,
@@ -242,6 +436,7 @@ class MicroBatcher:
             "failed": self._c_failed.value,
             "cancelled": self._c_cancelled.value,
             "deadline_expired": self._c_deadline.value,
+            "shed": self._c_shed.value,
             "batches": self._c_batches.value,
         }
         with self._lock:
@@ -320,7 +515,11 @@ class MicroBatcher:
             with obs_trace.span("serve.flush", cat="serve",
                                 batch=len(batch)):
                 try:
-                    if self._isolated:
+                    if self._fleet:
+                        results = self._score.score_isolated_tenants(
+                            [r.record for r in batch],
+                            [r.tenant for r in batch])
+                    elif self._isolated:
                         results = self._score.score_isolated(
                             [r.record for r in batch])
                     else:
@@ -344,7 +543,10 @@ class MicroBatcher:
                 self._h_batch_size.observe(len(batch))
                 for r, good in zip(batch, ok):
                     if good:
-                        self._h_latency.observe(now - r.t_enqueue)
+                        lat = now - r.t_enqueue
+                        self._h_latency.observe(lat)
+                        if r.tenant is not None:
+                            self._tenant_latency(r.tenant).observe(lat)
                 for r, res, good in zip(batch, results, ok):
                     if good:
                         r.future.set_result(res)

@@ -30,6 +30,19 @@ untouched):
 - ``swap``       — the blue/green promotion (before the atomic flip);
 - ``rollback``   — restoring the retained last-known-good model.
 
+Multi-tenant fleet fault points (serve/registry.py + serve/batcher.py —
+each fires BEFORE its phase mutates state and carries the tenant id in its
+context, so one tenant's injected fault is provably invisible to every
+other tenant):
+
+- ``register`` — admitting a tenant's model into the fleet registry;
+- ``evict``    — evicting a cold tenant's warm bucket executables (the HBM
+  admission controller's LRU reclaim);
+- ``route``    — dispatching one tenant's sub-batch out of a mixed flush
+  (an injected route fault fails only that tenant's records);
+- ``shed``     — the batcher's deadline-then-tier backpressure reclaim
+  (fired before any queued entry is evicted).
+
 Usage in tests::
 
     harness = FaultHarness(seed=0)
@@ -55,6 +68,7 @@ __all__ = [
     "CircuitOpenError",
     "DeadlineExceededError",
     "FaultHarness",
+    "LoadShedError",
     "PoisonRecordError",
     "TransientScoringError",
     "fault_point",
@@ -79,6 +93,18 @@ class PoisonRecordError(RuntimeError):
 class DeadlineExceededError(TimeoutError):
     """The request's deadline expired while it waited in the batch queue; it
     was evicted before any device call was spent on it."""
+
+
+class LoadShedError(RuntimeError):
+    """The request was evicted from the queue to make room for higher-tier
+    traffic (lowest-effective-tier-first shedding under backpressure —
+    serve/batcher.py).  Carries the tenant and SLO tier it was shed at so
+    callers can retry against a higher class or back off."""
+
+    def __init__(self, message: str, tenant=None, tier=None):
+        super().__init__(message)
+        self.tenant = tenant
+        self.tier = tier
 
 
 class TransientScoringError(RuntimeError):

@@ -399,6 +399,32 @@ class CompiledScoringPlan:
             self._executables[bucket] = compiled
         return compiled
 
+    def warm_buckets(self) -> List[int]:
+        """Buckets this plan currently holds compiled executables for."""
+        with self._compile_lock:
+            return sorted(self._executables)
+
+    def release_executables(self, drop_shared: bool = True) -> int:
+        """Drop every compiled bucket executable this plan holds — the HBM
+        eviction hook of the fleet admission controller (serve/registry.py).
+
+        ``drop_shared`` also removes this plan's ``(fingerprint, bucket)``
+        entries from the process-wide cache; a caller that knows another
+        live plan shares the fingerprint passes ``drop_shared=False`` so
+        the shared tenant keeps its zero-compile serving.  Resets the warm
+        flag (a later on-demand recompile of a cold-evicted tenant is
+        legitimate, not a TM901 incident).  Returns the number of buckets
+        released."""
+        with self._compile_lock:
+            buckets = list(self._executables)
+            self._executables.clear()
+            self._warmed = False
+            if drop_shared:
+                with _EXEC_CACHE_LOCK:
+                    for b in buckets:
+                        _EXEC_CACHE.pop((self._fingerprint, b), None)
+        return len(buckets)
+
     def warm(self, buckets: Optional[Sequence[int]] = None) -> "CompiledScoringPlan":
         """Pre-compile executables for ``buckets`` (default: every power of
         two in [min_bucket, max_bucket]) so first requests never pay XLA."""

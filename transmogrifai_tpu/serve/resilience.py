@@ -191,8 +191,12 @@ class ResilientScorer:
                  seed: Optional[int] = None,
                  sleep: Callable[[float], None] = time.sleep,
                  registry: Optional[MetricsRegistry] = None,
-                 labels: Optional[Mapping[str, str]] = None):
+                 labels: Optional[Mapping[str, str]] = None,
+                 tenant: Optional[str] = None):
         self._plan = plan
+        #: fleet attribution: quarantine/dead-letter flight events carry the
+        #: owning tenant, so a poisoned record is attributable postmortem
+        self.tenant = tenant
         self._host = host_score if host_score is not None \
             else getattr(plan, "score_host", None)
         self.max_retries = int(max_retries)
@@ -314,8 +318,12 @@ class ResilientScorer:
     def _quarantine(self, record, exc: BaseException) -> PoisonRecordError:
         self._c["quarantined"].inc()
         # flight-recorder postmortem trail (cause TYPE only — a record
-        # payload must never leak into a telemetry dump)
-        obs_flight.record_event("quarantine", cause=type(exc).__name__)
+        # payload must never leak into a telemetry dump); tenant/entry
+        # attribution threads through from the fleet registry so a poisoned
+        # record is attributable to its owner
+        attribution = {} if self.tenant is None else {"tenant": self.tenant}
+        obs_flight.record_event("quarantine", cause=type(exc).__name__,
+                                **attribution)
         err = PoisonRecordError(
             f"record quarantined: scoring failed with "
             f"{type(exc).__name__}: {exc}", cause=exc)
@@ -323,7 +331,8 @@ class ResilientScorer:
             try:
                 self._dead_letter(record, exc)
                 obs_flight.record_event("dead_letter",
-                                        cause=type(exc).__name__)
+                                        cause=type(exc).__name__,
+                                        **attribution)
             except Exception as dl:  # noqa: BLE001 — DLQ must not break serving
                 log.warning("dead-letter callback failed: %s", dl)
         return err

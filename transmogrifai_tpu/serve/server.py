@@ -43,6 +43,55 @@ _RESILIENCE_DEFAULTS = {
 }
 
 
+def default_max_bucket(max_batch: int, min_bucket: int) -> int:
+    """Smallest power-of-two padding bucket that holds a full flush: every
+    flushed batch must fit ONE bucket, so a single fused call serves the
+    largest flush the batcher can produce.  Shared by ScoringServer and
+    the multi-tenant FleetServer — the single-fused-call-per-flush
+    invariant must not fork."""
+    return max(1 << (max(max_batch, 1) - 1).bit_length(), min_bucket)
+
+
+def resolve_resilience_params(resilience: Union[bool, Mapping[str, Any]],
+                              deadline_ms: Optional[float],
+                              max_wait_ms: float
+                              ) -> Optional[Dict[str, Any]]:
+    """Merge + statically validate the fault-tolerance configuration.
+
+    Shared by :class:`ScoringServer` and the multi-tenant
+    :class:`~.registry.FleetServer`: returns the resolved ResilientScorer
+    kwargs (None when the layer is disabled), raising
+    :class:`~..checkers.diagnostics.OpCheckError` on TM505 findings and
+    logging TM506 warnings — before any request is accepted.
+    """
+    if not resilience:
+        return None
+    from ..checkers.diagnostics import OpCheckError
+    from .validator import check_resilience_config
+
+    params = dict(_RESILIENCE_DEFAULTS)
+    if isinstance(resilience, Mapping):
+        unknown = set(resilience) - set(params)
+        if unknown:
+            raise TypeError(
+                f"unknown resilience parameter(s): {sorted(unknown)}")
+        params.update(resilience)
+    report = check_resilience_config(
+        max_retries=params["max_retries"],
+        backoff_base_s=params["backoff_base_s"],
+        backoff_cap_s=params["backoff_cap_s"],
+        failure_threshold=params["failure_threshold"],
+        recovery_batches=params["recovery_batches"],
+        dead_letter=params["dead_letter"],
+        default_deadline_ms=deadline_ms,
+        max_wait_ms=max_wait_ms)
+    if report.errors():
+        raise OpCheckError(report)
+    for d in report.warnings():
+        log.warning("%s", d.pretty())
+    return params
+
+
 class ScoringServer:
     """Compiled plan + fault-tolerance layer + micro-batcher, one metrics dict.
 
@@ -77,26 +126,14 @@ class ScoringServer:
         # concatenating their prometheus() outputs instead
         self.registry = registry if registry is not None else MetricsRegistry()
         if max_bucket is None:
-            # every flushed batch must fit one bucket, so a single fused call
-            # serves the largest flush the batcher can produce
-            max_bucket = max(1 << (max(max_batch, 1) - 1).bit_length(),
-                             min_bucket)
+            max_bucket = default_max_bucket(max_batch, min_bucket)
         self.min_bucket = min_bucket
         self.max_bucket = max_bucket
         self.hbm_budget = hbm_budget
         self.default_deadline_ms = deadline_ms
 
-        self._resilience_params: Optional[Dict[str, Any]] = None
-        if resilience:
-            params = dict(_RESILIENCE_DEFAULTS)
-            if isinstance(resilience, Mapping):
-                unknown = set(resilience) - set(params)
-                if unknown:
-                    raise TypeError(
-                        f"unknown resilience parameter(s): {sorted(unknown)}")
-                params.update(resilience)
-            self._validate_resilience(params, deadline_ms, max_wait_ms)
-            self._resilience_params = params
+        self._resilience_params = resolve_resilience_params(
+            resilience, deadline_ms, max_wait_ms)
         self._versions = itertools.count(1)
         # every model (initial and staged candidates) builds through one
         # path; the swapper is the batcher-facing atomic reference so a
@@ -132,27 +169,6 @@ class ScoringServer:
     @property
     def resilience(self) -> Optional[ResilientScorer]:
         return self._swapper.active.resilience
-
-    @staticmethod
-    def _validate_resilience(params: Dict[str, Any],
-                             deadline_ms: Optional[float],
-                             max_wait_ms: float) -> None:
-        from ..checkers.diagnostics import OpCheckError
-        from .validator import check_resilience_config
-
-        report = check_resilience_config(
-            max_retries=params["max_retries"],
-            backoff_base_s=params["backoff_base_s"],
-            backoff_cap_s=params["backoff_cap_s"],
-            failure_threshold=params["failure_threshold"],
-            recovery_batches=params["recovery_batches"],
-            dead_letter=params["dead_letter"],
-            default_deadline_ms=deadline_ms,
-            max_wait_ms=max_wait_ms)
-        if report.errors():
-            raise OpCheckError(report)
-        for d in report.warnings():
-            log.warning("%s", d.pretty())
 
     # -- request paths -------------------------------------------------------
     def submit(self, record: Mapping[str, Any],

@@ -115,19 +115,27 @@ class SwappableScorer:
     """
 
     def __init__(self, entry: ModelEntry,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 labels: Optional[Mapping[str, str]] = None,
+                 tenant: Optional[str] = None):
         self._lock = threading.Lock()
         self._active = entry
         self._previous: Optional[ModelEntry] = None
         self._candidate: Optional[ModelEntry] = None
         self._probation_left = 0
         self._opened_at_swap = 0
+        #: fleet attribution: swap/rollback flight events carry the owning
+        #: tenant; ``labels`` (e.g. {"tenant": ...}) namespaces the swap
+        #: counters so fleet tenants sharing one registry never merge (and
+        #: one tenant's per-candidate shadow reset cannot zero another's)
+        self.tenant = tenant
         # canonical counters (obs/metrics.py); metrics() is the legacy view
         reg = registry if registry is not None else MetricsRegistry()
         self.registry = reg
         self._c = {key: reg.counter(f"tmog_serve_swap_{key}_total",
                                     canonical_help(
-                                        f"tmog_serve_swap_{key}_total"))
+                                        f"tmog_serve_swap_{key}_total"),
+                                    labels=labels)
                    for key in ("swaps", "rollbacks", "rollback_failures",
                                "shadow_mirrored", "shadow_failures",
                                "shadow_batches", "shadow_dropped")}
@@ -157,6 +165,14 @@ class SwappableScorer:
     def has_candidate(self) -> bool:
         with self._lock:
             return self._candidate is not None
+
+    def live_entries(self) -> List[ModelEntry]:
+        """The entries currently holding compiled state (active, retained
+        previous, staged candidate) — the fleet HBM admission controller's
+        residency view."""
+        with self._lock:
+            return [e for e in (self._active, self._previous,
+                                self._candidate) if e is not None]
 
     def in_probation(self) -> bool:
         with self._lock:
@@ -355,6 +371,8 @@ class SwappableScorer:
                       "to_version": candidate.version,
                       "shared_prefix": (self._previous.fingerprint
                                         == candidate.fingerprint)}
+            if self.tenant is not None:
+                record["tenant"] = self.tenant
             self._c["swaps"].inc()
             self._append_history_locked(record)
         obs_flight.record_event("swap", **record)
@@ -375,6 +393,8 @@ class SwappableScorer:
                       "from": bad.fingerprint, "to": good.fingerprint,
                       "from_version": bad.version,
                       "to_version": good.version}
+            if self.tenant is not None:
+                record["tenant"] = self.tenant
             self._c["rollbacks"].inc()
             self._append_history_locked(record)
         obs_flight.record_event("rollback", **record)

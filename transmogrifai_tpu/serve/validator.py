@@ -24,6 +24,11 @@ hazards surface from ``workflow.validate(serving=True)``, ``cli lint
   (:func:`check_swap_compatibility`) — a staged candidate must serve the
   same result feature names as the active model, and a fingerprint-changing
   swap (candidate cannot share the cached prefix executables) is called out.
+- **TM509** (error): fleet HBM admission (:func:`check_fleet_admission`) —
+  the multi-tenant registry (serve/registry.py) sums TM601-style static
+  peak-HBM estimates across every resident warm executable; a candidate
+  that still does not fit after the LRU eviction of cold tenants' buckets
+  is refused with this code instead of OOMing the device.
 - **TM601** (error): HBM admission (:func:`check_plan_admission`) — the
   plan's static peak live-buffer estimate at its largest padding bucket
   (checkers/plancheck.py, abstract jaxpr trace) exceeds the configured
@@ -109,6 +114,33 @@ def check_plan_admission(plan, hbm_budget: float) -> DiagnosticReport:
     report.plan_cost = cost
     report.extend(d for d in cost_diagnostics(cost, hbm_budget=hbm_budget)
                   if d.code == "TM601")
+    return report
+
+
+def check_fleet_admission(tenant: str, need_bytes: float,
+                          resident_bytes: float, hbm_budget: float,
+                          evicted: Sequence[str] = ()) -> DiagnosticReport:
+    """Fleet-wide HBM admission (TM509) for the multi-tenant registry.
+
+    ``need_bytes`` is the candidate plan's static peak-HBM estimate
+    (TM601's per-plan number, :func:`check_plan_admission`);
+    ``resident_bytes`` sums the estimates of every DISTINCT warm fingerprint
+    still resident after the registry's LRU eviction pass (a candidate
+    sharing a resident fingerprint costs nothing extra).  Reports TM509
+    when the fleet still does not fit — the registry raises it as a typed
+    refusal instead of trial-and-error OOMing the device.
+    """
+    report = DiagnosticReport()
+    if need_bytes + resident_bytes > hbm_budget:
+        evicted_note = (
+            f" (after evicting {len(evicted)} cold tenant(s): "
+            f"{sorted(evicted)})" if evicted else "")
+        report.extend([make_diagnostic(
+            "TM509",
+            f"cannot admit tenant {tenant!r}: candidate peak-HBM estimate "
+            f"{need_bytes:.0f} B + resident warm executables "
+            f"{resident_bytes:.0f} B exceed the fleet hbm_budget "
+            f"{hbm_budget:.0f} B{evicted_note}")])
     return report
 
 
