@@ -354,6 +354,22 @@ class TestBenchSmoke:
         assert tr["speedup"] >= 3.0, tr
         assert tr["gate_3x"] is True
         assert tr["warm_transform_backend_compiles"] == 0
+        # out-of-core chunked ingestion (ISSUE 13): the ingest section
+        # streams a table bigger than the armed host budget into the chunk
+        # store and runs a chunked fused epoch — prefetch overlap > 0.5,
+        # zero backend compiles across chunk boundaries, and peak RSS under
+        # the budget while the table itself exceeds it
+        assert secs["ingest"]["status"] == "ok", secs["ingest"]
+        ing = parsed["ingest"]
+        assert ing["table_exceeds_budget"] is True, ing
+        assert ing["gate_overlap"] is True, ing
+        assert ing["overlap_fraction"] > 0.5, ing
+        assert ing["warm_chunk_backend_compiles"] == 0, ing
+        assert ing["gate_zero_chunk_compiles"] is True, ing
+        if ing["rss_peak_delta_bytes"] is not None:
+            assert ing["gate_rss_under_budget"] is True, ing
+        assert ing["ingest_gbs"] > 0 and ing["epoch_rows_per_sec"] > 0
+        assert ing["chunks"] >= 2, ing
         # serving fault-tolerance section: zero quarantines/breaker trips/
         # deadline evictions on the clean fixture, and the degraded-mode
         # (breaker-open, host-path) replay compiles nothing (ISSUE 5)

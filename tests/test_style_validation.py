@@ -106,7 +106,12 @@ class TestStyleValidation:
         its tenant table, admission/eviction controller, and the batcher's
         shed scan are concurrent control-plane state, so the gate asserts
         the module is actually in the linted set (a rename/move must not
-        silently drop it)."""
+        silently drop it); data/ joined with the out-of-core chunked store
+        (ISSUE 13) — the spill store, the chunk-local gather, and the
+        prefetch pipeline (readers/prefetch.py) are hot ingest paths with
+        exactly the thread-shared state (the prefetch queue/worker, the
+        chunk writers) TM306 polices, so the gate also asserts both ingest
+        modules are in the linted set."""
         from transmogrifai_tpu.checkers.opcheck import (
             lint_file,
             lint_file_concurrency,
@@ -115,7 +120,7 @@ class TestStyleValidation:
         findings = []
         linted = []
         for sub in ("serve", "perf", "perf/kernels", "checkers", "cli",
-                    "workflow", "readers", "obs"):
+                    "workflow", "readers", "obs", "data"):
             d = os.path.join(PKG_ROOT, sub)
             for f in sorted(os.listdir(d)):
                 if not f.endswith(".py"):
@@ -130,6 +135,11 @@ class TestStyleValidation:
                         f"{fi.message}")
         assert os.path.join("serve", "registry.py") in linted, \
             "the fleet registry module left the lint gate"
+        for ingest_mod in (os.path.join("data", "chunked.py"),
+                           os.path.join("readers", "prefetch.py"),
+                           os.path.join("workflow", "ooc.py")):
+            assert ingest_mod in linted, \
+                f"the ingest module {ingest_mod} left the lint gate"
         assert not findings, (
             "unallowlisted hazards in serve//perf/ (fix them, or mark "
             "intentional ones inline with '# opcheck: allow(TMxxx) reason'):\n"

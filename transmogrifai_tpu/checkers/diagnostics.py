@@ -193,6 +193,13 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "the DAG); a gate that silently passed here would admit "
               "anything — train the workflow (or validate the fitted "
               "WorkflowModel) so the admission check can actually run"),
+    "TM607": (Severity.ERROR, "host-DRAM residency exceeds the budget",
+              "the plan's materialized host working set (estimator-input "
+              "columns at the stated row count, plus chunk ingest buffers) "
+              "exceeds the armed host_budget even in chunked out-of-core "
+              "mode; raise host_budget, narrow the feature vector, or "
+              "reduce rows — spilling cannot shrink a working set the fit "
+              "itself must assemble"),
     "TM605": (Severity.WARNING, "layout/order-dependent numerics",
               "the plan contains ops whose floating-point result depends on "
               "reduction order or data layout (float sort keys, "
@@ -364,6 +371,9 @@ class DiagnosticReport:
     #: PlanCostReport attached by the TM6xx cost analyzers (validate(cost=True)
     #: / ``cli lint --cost``); None when the cost pass did not run
     plan_cost: Optional[object] = None
+    #: HostResidencyReport attached by the TM607 residency analyzer
+    #: (validate(host_budget=...) / ``cli lint --cost --host-budget``)
+    host_residency: Optional[object] = None
 
     def __iter__(self) -> Iterator[Diagnostic]:
         return iter(self.diagnostics)

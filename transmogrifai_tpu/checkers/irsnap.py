@@ -846,6 +846,21 @@ def _plan_entries() -> List[CorpusEntry]:
                                      frozenset({"x1", "x2", "b1"}))
         return snapshot_transform_plan(plan, bucket=64)
 
+    def transform_prefix_chunk():
+        # the chunked-epoch program (ISSUE 13): workflow/ooc.py derives its
+        # plan through plan_for over the chunk's column names and dispatches
+        # it at the fixed chunk tile — build it the same way here and
+        # snapshot at a 64-row tile.  The corpus pins that this entry dedups
+        # BIT-IDENTICALLY (same irFingerprint) with the in-memory
+        # transform_prefix family above: chunking must not fork the program
+        # surface (asserted in tests/test_chunked_ingest.py).
+        from ..workflow.plan import plan_for
+
+        _features, runners = _plan_fixture_runners()
+        plan, _remainder = plan_for(runners, frozenset({"x1", "x2", "b1"}))
+        return snapshot_transform_plan(
+            plan, bucket=64, key="workflow.plan.transform_prefix@chunk")
+
     def scoring_prefix():
         from ..serve.plan import CompiledScoringPlan
 
@@ -856,6 +871,8 @@ def _plan_entries() -> List[CorpusEntry]:
 
     return [
         CorpusEntry("workflow.plan.transform_prefix", transform_prefix),
+        CorpusEntry("workflow.plan.transform_prefix@chunk",
+                    transform_prefix_chunk),
         CorpusEntry("serve.plan.scoring_prefix", scoring_prefix),
     ]
 

@@ -72,7 +72,11 @@ def validate_result_features(result_features: Sequence[Feature],
                              fitted=None,
                              cost: bool = False,
                              hbm_budget: Optional[float] = None,
-                             single_host: bool = False) -> DiagnosticReport:
+                             single_host: bool = False,
+                             host_budget: Optional[float] = None,
+                             rows: Optional[int] = None,
+                             chunk_rows: Optional[int] = None
+                             ) -> DiagnosticReport:
     """Run every analyzer over the DAG reached from ``result_features``.
 
     Touches no data: type propagation walks declared FeatureTypes and the
@@ -118,6 +122,16 @@ def validate_result_features(result_features: Sequence[Feature],
             single_host=single_host)
         report.plan_cost = cost_report
         report.extend(diags)
+    if host_budget is not None:
+        # TM607 (ISSUE 13): static host-DRAM residency vs the armed budget —
+        # fails closed (TM606) on unfitted estimators or a missing row count
+        from .plancheck import check_host_residency
+
+        res_report, res_diags = check_host_residency(
+            result_features, fitted=fitted, host_budget=host_budget,
+            n_rows=rows, chunk_rows=chunk_rows)
+        report.host_residency = res_report
+        report.extend(res_diags)
     return report
 
 

@@ -878,3 +878,226 @@ def check_plan_cost(result_features, fitted=None,
     return report, cost_diagnostics(report, hbm_budget=hbm_budget,
                                     single_host=single_host,
                                     intensity_threshold=intensity_threshold)
+
+
+# ---------------------------------------------------------------------------
+# TM607: static host-DRAM residency estimate (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class HostResidencyReport:
+    """Static host-DRAM residency estimate of one fitted plan at a row count.
+
+    Two modes are modeled: the IN-MEMORY path materializes the whole table
+    (raw + every produced column) at once; the CHUNKED out-of-core path
+    (data/chunked.py + workflow/ooc.py) holds only the prefetch-depth chunk
+    tiles, the resident (non-spillable) output columns, and — transiently,
+    one estimator at a time — that estimator's input columns.  The TM607
+    gate compares the CHUNKED peak against the budget: it is the smallest
+    working set any ingestion mode can achieve, so exceeding it cannot be
+    fixed by spilling harder.
+    """
+
+    n_rows: int
+    chunk_rows: int
+    table_bytes: int = 0              #: full materialized table (in-memory mode)
+    chunk_buffer_bytes: int = 0       #: prefetch-depth chunk tiles
+    resident_bytes: int = 0           #: non-spillable outputs (predictions)
+    fit_sets: List[Dict[str, Any]] = field(default_factory=list)
+    notes: List[str] = field(default_factory=list)
+
+    @property
+    def max_fit_set_bytes(self) -> int:
+        return max((int(f["bytes"]) for f in self.fit_sets), default=0)
+
+    @property
+    def peak_in_memory_bytes(self) -> int:
+        return self.table_bytes
+
+    @property
+    def peak_chunked_bytes(self) -> int:
+        return (self.chunk_buffer_bytes + self.resident_bytes
+                + self.max_fit_set_bytes)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "nRows": self.n_rows, "chunkRows": self.chunk_rows,
+            "tableBytes": self.table_bytes,
+            "chunkBufferBytes": self.chunk_buffer_bytes,
+            "residentBytes": self.resident_bytes,
+            "peakInMemoryBytes": self.peak_in_memory_bytes,
+            "peakChunkedBytes": self.peak_chunked_bytes,
+            "fitSets": list(self.fit_sets),
+            "notes": list(self.notes),
+        }
+
+    def pretty(self) -> str:
+        lines = [f"HostResidencyReport @ {self.n_rows} rows "
+                 f"(chunks of {self.chunk_rows})",
+                 f"  in-memory table: {_fmt_bytes(self.table_bytes)}",
+                 f"  chunked peak:    {_fmt_bytes(self.peak_chunked_bytes)} "
+                 f"(buffers {_fmt_bytes(self.chunk_buffer_bytes)} + "
+                 f"resident {_fmt_bytes(self.resident_bytes)} + "
+                 f"largest fit set {_fmt_bytes(self.max_fit_set_bytes)})"]
+        for f in self.fit_sets:
+            lines.append(f"    fit {f['stageUid']}: "
+                         f"{_fmt_bytes(int(f['bytes']))} "
+                         f"({', '.join(f['columns'])})")
+        for n in self.notes:
+            lines.append(f"  note: {n}")
+        return "\n".join(lines)
+
+
+def _col_row_bytes(col) -> int:
+    """Per-row host bytes of a (zero-row template) column."""
+    data = col.data
+    if data.dtype == object:
+        per = 64  # object refs + smallish payloads: a rough floor
+    else:
+        per = data.dtype.itemsize * int(np.prod(data.shape[1:])) \
+            if data.ndim > 1 else data.dtype.itemsize
+    return per + (1 if col.mask is not None or col.is_numeric else 0)
+
+
+def estimate_host_residency(result_features, fitted,
+                            n_rows: int,
+                            chunk_rows: Optional[int] = None,
+                            schema_dataset=None) -> HostResidencyReport:
+    """Zero-row replay of the fitted DAG → per-column row bytes → the
+    :class:`HostResidencyReport` at ``n_rows``.  Touches no data and
+    compiles nothing: every fitted runner transforms a ZERO-ROW dataset
+    (metadata/width are functions of fitted state only, the same principle
+    the fused planner's metadata replay rests on).
+
+    ``schema_dataset`` supplies raw-column widths/dtypes when available (a
+    real or chunked dataset); without one the raw schema derives from the
+    feature generators' declared types (raw OPVector widths then unknown —
+    noted, counted at zero).
+    """
+    from ..data.chunked import DEFAULT_CHUNK_ROWS
+    from ..data.dataset import Column, Dataset
+    from ..readers.prefetch import prefetch_depth
+    from ..workflow.dag import compute_dag
+    from ..workflow.fit import _resolve
+    from ..workflow.workflow import dedup_raw_features
+
+    chunk_rows = int(chunk_rows or DEFAULT_CHUNK_ROWS)
+    report = HostResidencyReport(n_rows=int(n_rows), chunk_rows=chunk_rows)
+
+    empty = np.zeros(0, dtype=np.intp)
+    cols: Dict[str, Any] = {}
+    if schema_dataset is not None:
+        for name in schema_dataset.names:
+            cols[name] = schema_dataset[name].take(empty)
+    else:
+        for f in dedup_raw_features(result_features):
+            gen = f.origin_stage
+            cols[f.name] = Column.from_values(gen.ftype, [])
+            if gen.ftype.kind is ColumnKind.VECTOR:
+                report.notes.append(
+                    f"raw vector column {f.name!r}: width unknown without a "
+                    f"schema dataset — counted at zero bytes")
+    ds0 = Dataset(cols)
+
+    from ..stages.base import Estimator
+
+    per_row: Dict[str, int] = {n: _col_row_bytes(c) for n, c in cols.items()}
+    resident_per_row = 0
+    stages = [s for layer in compute_dag(result_features) for s in layer]
+    for stage in stages:
+        runner = _resolve(stage, dict(fitted))
+        if runner is None:
+            raise ValueError(
+                f"stage {stage.uid} is unfitted: the residency estimate "
+                "needs the fitted widths")
+        # the estimator's fit-time working set: its input columns (plus the
+        # sample-weight column when the schema carries one) at n_rows
+        if isinstance(stage, Estimator):
+            names = [f.name for f in stage.inputs if f.name in per_row]
+            if "__sample_weight__" in per_row:
+                names.append("__sample_weight__")
+            report.fit_sets.append({
+                "stageUid": stage.uid,
+                "columns": names,
+                "bytes": int(n_rows) * sum(per_row[n] for n in names)})
+        ds0 = runner.transform(ds0)
+        out = ds0[runner.output_name]
+        per_row[runner.output_name] = _col_row_bytes(out)
+        if type(out) is not Column:
+            # non-spillable output (PredictionColumn): resident in chunked
+            # mode too
+            resident_per_row += _col_row_bytes(out)
+
+    row_total = sum(per_row.values())
+    report.table_bytes = int(n_rows) * row_total
+    # ingest buffers: the prefetch queue's staged chunks + the one being
+    # consumed + the output tile being spilled — all at full-table row width
+    report.chunk_buffer_bytes = (prefetch_depth() + 2) * chunk_rows * row_total
+    report.resident_bytes = int(n_rows) * resident_per_row
+    return report
+
+
+def host_residency_diagnostics(report: HostResidencyReport,
+                               host_budget: Optional[float]
+                               ) -> List[Diagnostic]:
+    """TM607 when even the chunked out-of-core working set exceeds the
+    armed budget (the in-memory overage alone is only a note: spilling —
+    ``train(host_budget=)`` / ``maybe_chunk`` — resolves it)."""
+    diags: List[Diagnostic] = []
+    if host_budget is None:
+        return diags
+    if report.peak_chunked_bytes > host_budget:
+        worst = max(report.fit_sets, key=lambda f: f["bytes"], default=None)
+        detail = ""
+        if worst is not None and worst["bytes"] == report.max_fit_set_bytes \
+                and worst["bytes"] > 0:
+            detail = (f"; largest fit set: stage {worst['stageUid']} "
+                      f"({', '.join(worst['columns'])} = "
+                      f"{_fmt_bytes(int(worst['bytes']))})")
+        diags.append(make_diagnostic(
+            "TM607",
+            f"host-DRAM residency estimate "
+            f"{_fmt_bytes(report.peak_chunked_bytes)} at {report.n_rows} "
+            f"rows exceeds the armed host budget "
+            f"{_fmt_bytes(int(host_budget))} even in chunked out-of-core "
+            f"mode{detail}"))
+    elif report.peak_in_memory_bytes > host_budget:
+        report.notes.append(
+            f"in-memory table ({_fmt_bytes(report.peak_in_memory_bytes)}) "
+            f"exceeds the budget but the chunked out-of-core path fits "
+            f"({_fmt_bytes(report.peak_chunked_bytes)}) — "
+            f"train(host_budget=)/TMOG_HOST_BUDGET spills automatically")
+    return diags
+
+
+def check_host_residency(result_features, fitted=None,
+                         host_budget: Optional[float] = None,
+                         n_rows: Optional[int] = None,
+                         chunk_rows: Optional[int] = None,
+                         schema_dataset=None
+                         ) -> Tuple[Optional[HostResidencyReport],
+                                    List[Diagnostic]]:
+    """TM607 entry point for ``validate(host_budget=...)`` and
+    ``cli lint --cost --host-budget``.  Fails CLOSED (TM606) when the armed
+    contract cannot be evaluated: unfitted estimators (no widths) or a
+    missing row count (residency is linear in rows — without one there is
+    nothing to compare)."""
+    if host_budget is None:
+        return None, []
+    if not n_rows:
+        return None, [make_diagnostic(
+            "TM606",
+            "host_budget contract requested but no row count provided "
+            "(pass rows=/--rows: the residency estimate is linear in rows "
+            "and a gate evaluated at zero rows would admit anything)")]
+    try:
+        report = estimate_host_residency(result_features, fitted or {},
+                                         n_rows=n_rows,
+                                         chunk_rows=chunk_rows,
+                                         schema_dataset=schema_dataset)
+    except Exception as e:  # noqa: BLE001 — fail closed, never silently green
+        return None, [make_diagnostic(
+            "TM606",
+            f"host_budget contract requested but the residency estimate "
+            f"could not be computed ({type(e).__name__}: {e})")]
+    return report, host_residency_diagnostics(report, host_budget)

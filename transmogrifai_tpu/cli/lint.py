@@ -77,6 +77,15 @@ def add_lint_parser(sub) -> None:
     p.add_argument("--single-host", action="store_true", dest="single_host",
                    help="assert the plan runs single-host: any "
                         "collective/resharding op inside it is a TM603 error")
+    p.add_argument("--host-budget", type=float, default=None,
+                   dest="host_budget", metavar="BYTES",
+                   help="host DRAM budget in bytes; a plan whose static "
+                        "residency estimate (checkers/plancheck.py TM607) "
+                        "exceeds it even in chunked out-of-core mode is an "
+                        "error — requires --rows")
+    p.add_argument("--rows", type=int, default=None,
+                   help="row count the --host-budget residency estimate is "
+                        "evaluated at (the estimate is linear in rows)")
     p.add_argument("--ir", action="store_true",
                    help="snapshot every builtin program family to canonical "
                         "StableHLO (abstract lowering, zero backend "
@@ -157,9 +166,13 @@ def run_lint(ns) -> int:
             "lint: nothing to lint — pass --path, --workflow, --model "
             "and/or --ir")
     cost = ns.cost or ns.hbm_budget is not None or ns.single_host
-    if cost and not (ns.workflow or ns.model):
-        raise SystemExit("lint: --cost/--hbm-budget/--single-host need a "
-                         "--workflow or --model target")
+    if (cost or ns.host_budget is not None) \
+            and not (ns.workflow or ns.model):
+        raise SystemExit("lint: --cost/--hbm-budget/--host-budget/"
+                         "--single-host need a --workflow or --model target")
+    if ns.host_budget is not None and ns.rows is None:
+        raise SystemExit("lint: --host-budget needs --rows N (the TM607 "
+                         "residency estimate is linear in rows)")
     report = DiagnosticReport()
     ir_diff = None
     if ir:
@@ -170,6 +183,7 @@ def run_lint(ns) -> int:
         # corpus was rewritten (nothing left to diff), but the requested
         # --path/--workflow/--model lint must still run and set the rc
     cost_reports = []  # one PlanCostReport per --workflow/--model target
+    residency_reports = []  # one HostResidencyReport per target (TM607)
     targets = []
     if ns.workflow:
         targets.append(_resolve_workflow(ns.workflow))
@@ -184,10 +198,13 @@ def run_lint(ns) -> int:
             features, workflow_cv=workflow_cv,
             serving=getattr(ns, "serving", False) or fitted is not None,
             fitted=fitted, cost=cost, hbm_budget=ns.hbm_budget,
-            single_host=ns.single_host)
+            single_host=ns.single_host, host_budget=ns.host_budget,
+            rows=ns.rows)
         report.extend(sub)
         if sub.plan_cost is not None:
             cost_reports.append(sub.plan_cost)
+        if sub.host_residency is not None:
+            residency_reports.append(sub.host_residency)
     if cost_reports:
         report.plan_cost = cost_reports[-1]
     only = None if ns.all_functions else HAZARD_FUNCTION_NAMES
@@ -221,6 +238,8 @@ def run_lint(ns) -> int:
         # --cost/--ir ran) one {"planCostReport"/"irDiff"} element per target
         blob = report.to_dicts()
         blob += [{"planCostReport": rep.to_dict()} for rep in cost_reports]
+        blob += [{"hostResidencyReport": rep.to_dict()}
+                 for rep in residency_reports]
         if ir_diff is not None:
             blob.append({"irDiff": ir_diff.to_dict()})
         print(json.dumps(blob, indent=2))
@@ -231,12 +250,16 @@ def run_lint(ns) -> int:
         # then one line per diagnostic — the tools/*_gate.py contract
         for rep in cost_reports:
             print(json.dumps({"planCostReport": rep.to_dict()}))
+        for rep in residency_reports:
+            print(json.dumps({"hostResidencyReport": rep.to_dict()}))
         if ir_diff is not None:
             print(json.dumps({"irDiff": ir_diff.to_dict()}))
         for d in report:
             print(json.dumps(d.to_dict()))
     else:
         for rep in cost_reports:
+            print(rep.pretty())
+        for rep in residency_reports:
             print(rep.pretty())
         if ir_diff is not None:
             print(_ir_pretty(ir_diff))

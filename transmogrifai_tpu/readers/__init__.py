@@ -1,6 +1,8 @@
+from .prefetch import ChunkPrefetcher, PrefetchStats, prefetch_chunks
 from .streaming import (JsonlTailSource, ListSource,
                         MicroBatchStreamingReader, OffsetCheckpoint,
                         RecordSource)
 
 __all__ = ["RecordSource", "ListSource", "JsonlTailSource",
-           "OffsetCheckpoint", "MicroBatchStreamingReader"]
+           "OffsetCheckpoint", "MicroBatchStreamingReader",
+           "ChunkPrefetcher", "PrefetchStats", "prefetch_chunks"]

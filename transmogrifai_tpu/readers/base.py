@@ -45,6 +45,32 @@ class Reader:
         records = list(self.read_records())
         return rows_to_dataset(records, raw_features)
 
+    def generate_chunked(self, raw_features: Sequence[Feature],
+                         chunk_rows: Optional[int] = None,
+                         spill_dir: Optional[str] = None):
+        """Out-of-core ingestion (ISSUE 13): stream ``read_records()``
+        straight into a chunked spill store — the table is never host-
+        resident as a whole.  Record batches re-bucket to ``chunk_rows``
+        (the fused planner's tile size) so every downstream consumer
+        dispatches fixed-shape programs; returns a
+        :class:`~..data.chunked.ChunkedDataset`."""
+        from ..data.chunked import DEFAULT_CHUNK_ROWS, ChunkedDatasetWriter
+
+        chunk_rows = int(chunk_rows or DEFAULT_CHUNK_ROWS)
+        gens = _generators(raw_features)
+        named = [(f.name, g) for f, g in zip(raw_features, gens)]
+        writer = ChunkedDatasetWriter(chunk_rows=chunk_rows,
+                                      spill_dir=spill_dir)
+        buf: List[Any] = []
+        for r in self.read_records():
+            buf.append(r)
+            if len(buf) == chunk_rows:
+                writer.append(Dataset(extract_columns(buf, named)))
+                buf = []
+        if buf:
+            writer.append(Dataset(extract_columns(buf, named)))
+        return writer.finish()
+
 
 def extract_columns(records: Sequence[Any], named_gens,
                     allow_missing_response: bool = False) -> Dict[str, Column]:
@@ -158,6 +184,18 @@ class AggregateReader(Reader):
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
         return self.generate_dataset_with_keys(raw_features)[0]
+
+    def generate_chunked(self, raw_features, chunk_rows=None, spill_dir=None):
+        """Refused: the base streaming path would emit one row per EVENT,
+        silently skipping the key grouping, monoid aggregation, and cutoff
+        label-leakage protection this reader exists for.  Aggregate the
+        table first, then spill it."""
+        raise NotImplementedError(
+            f"{type(self).__name__}.generate_chunked: aggregate/conditional "
+            f"readers group and fold events per key — streaming chunks of "
+            f"raw events would yield a different (leaky, per-event) table. "
+            f"Use generate_dataset(...) then "
+            f"ChunkedDataset.from_dataset(ds) to spill the aggregated rows.")
 
     def generate_dataset_with_keys(self, raw_features: Sequence[Feature]):
         """(dataset, row keys) — aggregate readers emit one row per kept key."""

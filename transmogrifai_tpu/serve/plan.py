@@ -219,6 +219,8 @@ class CompiledScoringPlan:
         self.compile_count = 0
         self._counters = {"scored_records": 0, "scored_batches": 0,
                           "bucket_batches": {}}
+        #: prefetch-overlap stats of the last ``score_dataset`` chunked run
+        self.last_prefetch: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
         # serializes bucket compilation: concurrent score paths (batcher
         # flusher + direct score_batch callers) must not compile the same
@@ -514,6 +516,92 @@ class CompiledScoringPlan:
                 bb = self._counters["bucket_batches"]
                 bb[bucket] = bb.get(bucket, 0) + 1
         return out
+
+    def score_dataset(self, dataset, sink=None):
+        """Columnar batch scoring of a (possibly chunked) dataset.
+
+        An in-memory ``Dataset`` decodes to records and runs through
+        :meth:`score` directly; a
+        :class:`~..data.chunked.ChunkedDataset` (ISSUE 13) iterates chunk
+        by chunk with the NEXT chunk's disk read + record decode prefetched
+        behind the current chunk's device dispatch
+        (readers/prefetch.py).  ``last_prefetch`` records the pipeline's
+        overlap stats.
+
+        Without a ``sink`` the result-row dicts for the WHOLE table return
+        as one list — fine when the output fits in host DRAM.  For
+        genuinely out-of-core tables pass ``sink(rows)`` (called once per
+        chunk, in order; e.g. a JSONL writer): results stream through it,
+        the method returns the scored row count, and host residency stays
+        bounded by one chunk.
+
+        Only features with NAMED-FIELD extracts can be scored from a
+        dataset (the columnar store holds extracted values, so a custom
+        extract fn's original record shape cannot be reconstructed —
+        ``score(records)`` is the path for those).
+        """
+        from ..data.chunked import ChunkedDataset
+
+        if not isinstance(dataset, ChunkedDataset):
+            rows = self.score(self._records_of(dataset))
+            if sink is None:
+                return rows
+            sink(rows)
+            return len(rows)
+        from ..readers.prefetch import ChunkPrefetcher, PrefetchStats
+
+        raw_names = [g.raw_name for g in self._generators
+                     if g.raw_name in dataset]
+        self._check_named_extracts(dataset)
+
+        def loader(ci):
+            # the whole ingest half runs on the prefetch worker: chunk
+            # decode off the spill store (raw columns only — labels and
+            # intermediates stay on disk) AND the columnar->record decode
+            return self._records_of(dataset.chunk(ci, names=raw_names))
+
+        out: List[Dict[str, Any]] = []
+        count = 0
+        stats = PrefetchStats()
+        with ChunkPrefetcher(loader, dataset.n_chunks,
+                             stats=stats) as chunks:
+            for _ci, records in chunks:
+                rows = self.score(records)
+                count += len(rows)
+                if sink is None:
+                    out.extend(rows)
+                else:
+                    sink(rows)
+        self.last_prefetch = stats.to_dict()
+        return count if sink is not None else out
+
+    def _check_named_extracts(self, ds) -> None:
+        """Refuse dataset scoring when a generator has a custom extract fn:
+        re-running it over the rebuilt {field: value} record would read the
+        wrong shape (KeyError at best, silently-wrong inputs at worst)."""
+        custom = [g.raw_name for g in self._generators
+                  if g.raw_name in ds
+                  and not isinstance(getattr(g, "extract_fn", None),
+                                     _NamedExtract)]
+        if custom:
+            raise ValueError(
+                f"score_dataset needs named-field extracts, but feature(s) "
+                f"{sorted(custom)} use custom extract fns whose original "
+                f"record shape cannot be rebuilt from columns — score the "
+                f"raw records via plan.score(records) instead")
+
+    def _records_of(self, ds: Dataset) -> List[Dict[str, Any]]:
+        """Raw-record dicts (keyed by each generator's extract key) from a
+        dataset of raw columns — the columnar->record decode the chunked
+        scoring path feeds through ``score``."""
+        self._check_named_extracts(ds)
+        keys = []
+        for g in self._generators:
+            if g.raw_name in ds:
+                keys.append((g.extract_fn.key, g.raw_name))
+        cols = {raw: ds[raw].to_values() for _k, raw in keys}
+        n = ds.n_rows
+        return [{k: cols[raw][i] for k, raw in keys} for i in range(n)]
 
     def score_host(self, records: Sequence[Mapping[str, Any]]
                    ) -> List[Dict[str, Any]]:

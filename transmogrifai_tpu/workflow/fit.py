@@ -25,6 +25,7 @@ def fit_dag(
     fitted: Dict[str, Transformer] | None = None,
     on_fit=None,
     hbm_budget: float | None = None,
+    host_budget: float | None = None,
 ) -> Tuple[Dataset, Dict[str, Transformer]]:
     """Fit every estimator and apply every transformer, layer by layer.
 
@@ -40,8 +41,14 @@ def fit_dag(
     # fits, and those runs may span DAG layers
     stages = [s for layer in compute_dag(result_features) for s in layer]
     dataset = fit_stage_list(dataset, stages, fitted, on_fit=on_fit,
-                             hbm_budget=hbm_budget)
+                             hbm_budget=hbm_budget, host_budget=host_budget)
     return dataset, fitted
+
+
+def _is_chunked(dataset) -> bool:
+    from ..data.chunked import ChunkedDataset
+
+    return isinstance(dataset, ChunkedDataset)
 
 
 def transform_dag(
@@ -58,6 +65,11 @@ def transform_dag(
     ``TMOG_FUSED_TRANSFORM=0``, or an active stage-metrics listener) forces
     the per-stage interpreted path; a planner failure falls back to it too.
     """
+    if _is_chunked(dataset):
+        from .ooc import transform_dag_chunked
+
+        return transform_dag_chunked(dataset, result_features, fitted,
+                                     fused=fused)
     runners = []
     for layer in compute_dag(result_features):
         for stage in layer:
@@ -92,7 +104,8 @@ def _resolve(stage: PipelineStage, fitted: Dict[str, Transformer]) -> Transforme
 
 def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer],
                    on_fit=None, fused: bool | None = None,
-                   hbm_budget: float | None = None) -> Dataset:
+                   hbm_budget: float | None = None,
+                   host_budget: float | None = None) -> Dataset:
     """Fit/transform an explicit stage list (topological order) — the single
     fit/transform loop shared by fit_dag and the workflow-CV passes.
 
@@ -104,8 +117,19 @@ def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer],
 
     Each stage's fit/transform also lands as a perf phase span (no-op unless
     a ``perf.timers.record_phases`` recorder is active — bench and callers
-    profiling a train get per-stage wall time from the one real fit)."""
+    profiling a train get per-stage wall time from the one real fit).
+
+    A :class:`~..data.chunked.ChunkedDataset` routes to the out-of-core
+    twin (workflow/ooc.py): fused epochs per chunk with spilled outputs,
+    estimator fits over materialized input columns only."""
     from ..perf.timers import phase
+
+    if _is_chunked(dataset):
+        from .ooc import fit_stage_list_chunked
+
+        return fit_stage_list_chunked(dataset, stages, fitted, on_fit=on_fit,
+                                      fused=fused, hbm_budget=hbm_budget,
+                                      host_budget=host_budget)
 
     def _name(s) -> str:
         return getattr(s, "operation_name", None) or type(s).__name__
@@ -145,7 +169,8 @@ def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer],
 
 
 def workflow_cv_validate(ds_before: Dataset, during, selector,
-                         hbm_budget: float | None = None) -> "object":
+                         hbm_budget: float | None = None,
+                         host_budget: float | None = None) -> "object":
     """In-fold feature engineering CV (reference OpWorkflow.fitStages withWorkflowCV,
     FitStagesUtil.scala:305-358 + OpWorkflow.scala:403-438).
 
@@ -160,6 +185,21 @@ def workflow_cv_validate(ds_before: Dataset, during, selector,
     from ..models.tuning import ModelEvaluation, ValidationResult
 
     label_f, vec_f = selector.inputs[0], selector.inputs[1]
+    if _is_chunked(ds_before):
+        # the fold loop's whole-table working set is the during-stage inputs
+        # plus the selector's label/vector — materialize exactly that slice
+        # (chunk-local assembly) and run the in-memory fold path over it;
+        # the big raw/intermediate columns stay spilled.  An armed
+        # host_budget gates the materialization (TM607) BEFORE it assembles,
+        # same contract as the estimator-fit gate in workflow/ooc.py.
+        from .ooc import _gate_fit_residency
+
+        need = {label_f.name, vec_f.name, "__sample_weight__"}
+        for s in during:
+            need.update(fi.name for fi in s.inputs)
+        names = [n for n in ds_before.names if n in need]
+        _gate_fit_residency(ds_before, selector, names, host_budget)
+        ds_before = ds_before.materialize(names)
     y = ds_before[label_f.name].data.astype(np.float32) \
         if label_f.name in ds_before else None
     if y is None:

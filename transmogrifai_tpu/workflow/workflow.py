@@ -98,7 +98,9 @@ class Workflow:
 
     def validate(self, serving: bool = False, cost: bool = False,
                  hbm_budget: Optional[float] = None,
-                 single_host: bool = False) -> "DiagnosticReport":
+                 single_host: bool = False,
+                 host_budget: Optional[float] = None,
+                 rows: Optional[int] = None) -> "DiagnosticReport":
         """Static pre-execution validation — runs WITHOUT touching data.
 
         Walks the DAG reached from the result features through every opcheck
@@ -125,7 +127,8 @@ class Workflow:
                                         workflow_cv=self._workflow_cv,
                                         serving=serving, cost=cost,
                                         hbm_budget=hbm_budget,
-                                        single_host=single_host)
+                                        single_host=single_host,
+                                        host_budget=host_budget, rows=rows)
 
     # -- data ----------------------------------------------------------------
     def raw_features(self) -> List[Feature]:
@@ -146,6 +149,7 @@ class Workflow:
     def train(self, test_fraction: float = 0.0, seed: int = 42,
               checkpointer=None, strict: bool = False,
               hbm_budget: Optional[float] = None,
+              host_budget: Optional[float] = None,
               telemetry=None) -> "WorkflowModel":
         """Fit the DAG.  ``checkpointer`` (a StageCheckpointer) persists each
         fitted stage as it completes and resumes from disk on re-run —
@@ -162,6 +166,14 @@ class Workflow:
         :class:`OpCheckError` instead of launching a device job that will
         OOM minutes in.
 
+        ``host_budget`` (bytes; default the ``TMOG_HOST_BUDGET`` env var)
+        bounds HOST DRAM residency: an in-memory input table over the
+        budget spills to a chunked store (data/chunked.py) and fits
+        out-of-core through the chunked epochs (workflow/ooc.py), and the
+        TM607 residency gate (checkers/plancheck.py) raises
+        :class:`OpCheckError` when a materialized working set the fit
+        cannot avoid (an estimator's input columns) exceeds the budget.
+
         ``telemetry`` (an output directory path, or a prebuilt
         :class:`~transmogrifai_tpu.obs.Telemetry`; default: the
         ``TMOG_TELEMETRY`` env var) wraps the fit in the obs backbone
@@ -176,7 +188,8 @@ class Workflow:
         if tel is None:
             return self._train(test_fraction=test_fraction, seed=seed,
                                checkpointer=checkpointer, strict=strict,
-                               hbm_budget=hbm_budget)
+                               hbm_budget=hbm_budget,
+                               host_budget=host_budget)
         from ..perf import PhaseRecorder, compile_snapshot, record_phases
 
         # ownership-aware activation: a caller that already started this
@@ -188,7 +201,8 @@ class Workflow:
             with record_phases(rec):
                 return self._train(test_fraction=test_fraction, seed=seed,
                                    checkpointer=checkpointer, strict=strict,
-                                   hbm_budget=hbm_budget)
+                                   hbm_budget=hbm_budget,
+                                   host_budget=host_budget)
         finally:
             if owned:
                 # dump in the finally so a FAILED fit still leaves its
@@ -202,7 +216,8 @@ class Workflow:
 
     def _train(self, test_fraction: float = 0.0, seed: int = 42,
                checkpointer=None, strict: bool = False,
-               hbm_budget: Optional[float] = None) -> "WorkflowModel":
+               hbm_budget: Optional[float] = None,
+               host_budget: Optional[float] = None) -> "WorkflowModel":
         if not self.result_features:
             raise ValueError("set_result_features before train()")
         if strict:
@@ -216,12 +231,48 @@ class Workflow:
         blacklist: List[str] = []
         rff_summary = None
         if self._raw_feature_filter is not None:
+            from ..data.chunked import ChunkedDataset
+
+            if isinstance(raw, ChunkedDataset):
+                # the filter's distribution pass is whole-column; fall back
+                # to the materialized table (logged — the filter predates
+                # the out-of-core path and is typically run on samples)
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "RawFeatureFilter on a chunked dataset materializes the "
+                    "raw table in host DRAM")
+                raw = raw.materialize()
             raw, blacklist, rff_summary = self._raw_feature_filter.filter_raw(
                 raw, self.raw_features(), self.result_features)
 
+        # host-DRAM residency (ISSUE 13): an in-memory table over the budget
+        # spills to the chunked store and the whole fit goes out-of-core
+        from ..data.chunked import host_budget as _env_host_budget
+        from ..data.chunked import maybe_chunk
+
+        if host_budget is None:
+            host_budget = _env_host_budget()
+        if host_budget is not None:
+            raw = maybe_chunk(raw, budget=host_budget)
+
         train_ds, test_ds = (raw, None)
         if test_fraction > 0.0:
+            from ..data.chunked import ChunkedDataset
+
+            if isinstance(raw, ChunkedDataset):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "test_fraction on a chunked dataset materializes both "
+                    "splits in host DRAM transiently; use test_fraction=0 "
+                    "for fits whose train split must stay out-of-core")
             train_ds, test_ds = raw.split(test_fraction, seed=seed)
+            if host_budget is not None:
+                # the split materialized in-memory datasets: re-arm the
+                # residency budget on the train split so the fit (and its
+                # TM607 gate) still runs out-of-core when over budget
+                train_ds = maybe_chunk(train_ds, budget=host_budget)
 
         preseeded_selector = None
         warm = self._warm_models
@@ -304,14 +355,17 @@ class Workflow:
                 warm = dict(warm)
                 ds_before = fit_stage_list(train_ds, before, warm,
                                            on_fit=on_fit,
-                                           hbm_budget=hbm_budget)
+                                           hbm_budget=hbm_budget,
+                                           host_budget=host_budget)
                 selector._preselected = workflow_cv_validate(
-                    ds_before, during, selector, hbm_budget=hbm_budget)
+                    ds_before, during, selector, hbm_budget=hbm_budget,
+                    host_budget=host_budget)
                 preseeded_selector = selector
 
         try:
             _, fitted = fit_dag(train_ds, self.result_features, fitted=warm,
-                                on_fit=on_fit, hbm_budget=hbm_budget)
+                                on_fit=on_fit, hbm_budget=hbm_budget,
+                                host_budget=host_budget)
         finally:
             if preseeded_selector is not None and hasattr(
                     preseeded_selector, "_preselected"):
@@ -380,19 +434,29 @@ class WorkflowModel:
                 f"got {type(evaluator).__name__}: call evaluate(evaluator, dataset)")
         return evaluator, dataset
 
+    @staticmethod
+    def _eval_view(scored, names):
+        """Evaluators read whole columns — materialize exactly those when
+        the scored output is chunked (the rest of the table stays spilled)."""
+        from ..data.chunked import as_dataset
+
+        return as_dataset(scored, [n for n in names if n in scored])
+
     def evaluate(self, evaluator: Evaluator, dataset: Optional[Dataset] = None
                  ) -> Dict[str, float]:
         evaluator, dataset = self._check_eval_args(evaluator, dataset)
         label, pred = self._label_and_pred()
         scored = self.score(dataset, keep_intermediate=True)
-        return evaluator.evaluate(scored, label.name, pred.name)
+        view = self._eval_view(scored, [label.name, pred.name])
+        return evaluator.evaluate(view, label.name, pred.name)
 
     def score_and_evaluate(self, evaluator: Evaluator,
                            dataset: Optional[Dataset] = None):
         evaluator, dataset = self._check_eval_args(evaluator, dataset)
         label, pred = self._label_and_pred()
         scored = self.score(dataset, keep_intermediate=True)
-        metrics = evaluator.evaluate(scored, label.name, pred.name)
+        view = self._eval_view(scored, [label.name, pred.name])
+        metrics = evaluator.evaluate(view, label.name, pred.name)
         keep = [f.name for f in self.result_features if f.name in scored]
         return scored.select(keep), metrics
 
@@ -468,7 +532,9 @@ class WorkflowModel:
     # -- serving (serve/, docs/serving.md) -----------------------------------
     def validate(self, serving: bool = True, cost: bool = False,
                  hbm_budget: Optional[float] = None,
-                 single_host: bool = False) -> "DiagnosticReport":
+                 single_host: bool = False,
+                 host_budget: Optional[float] = None,
+                 rows: Optional[int] = None) -> "DiagnosticReport":
         """Static validation of the FITTED model, scoring-path aware.
 
         Same analyzer suite as :meth:`Workflow.validate` but estimators
@@ -490,7 +556,8 @@ class WorkflowModel:
                                         workflow_cv=self.workflow_cv,
                                         serving=serving, fitted=self.fitted,
                                         cost=cost, hbm_budget=hbm_budget,
-                                        single_host=single_host)
+                                        single_host=single_host,
+                                        host_budget=host_budget, rows=rows)
 
     def serving_plan(self, min_bucket: int = 8, max_bucket: int = 1024,
                      strict: bool = True,
