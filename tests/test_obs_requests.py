@@ -1,0 +1,561 @@
+"""Per-request causal tracing, cost accounting, and the fleet ops console
+(ISSUE 14 tentpole + satellites).
+
+Acceptance criteria proven here:
+- one fleet replay (``cli serve --models DIR --telemetry DIR
+  --trace-detail requests``) produces a trace.json from which
+  ``reconstruct_request`` rebuilds, for a chosen request id, the complete
+  causal chain submit → queue → flush → encode → device → host → response
+  with per-phase durations, across a batch shared with another tenant
+  (TestFleetReplayCausalChain);
+- per-tenant device-time accounting sums (exactly) to the batcher's total
+  device span time (TestDeviceCostAccounting);
+- ``detail="requests"`` export stays structurally sound under a threaded
+  multi-tenant submit storm: every async begin pairs with exactly one end,
+  every end links to a real flush span, X spans nest per thread
+  (TestRequestStorm — satellite);
+- the full Prometheus exposition parses and covers every
+  CANONICAL_METRICS entry with HELP/TYPE headers (satellite);
+- fleet fault points carry the tenant into fault_injected flight events
+  AND the auto-dumped snapshot (satellite regression);
+- the out-of-core path records chunk_resume / spill_activation /
+  prefetch_stall flight events (satellite);
+- ``statusz()`` + ``cli top`` render a one-screen fleet snapshot
+  (tentpole surface).
+"""
+
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    FeatureBuilder,
+    Workflow,
+    transmogrify,
+)
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.obs import (
+    CANONICAL_METRICS,
+    FlightRecorder,
+    Telemetry,
+    flight as obs_flight,
+    reconstruct_request,
+    trace as obs_trace,
+)
+from transmogrifai_tpu.obs.reqtrace import request_events
+from transmogrifai_tpu.readers.files import DataReaders
+from transmogrifai_tpu.serve import (
+    FaultHarness,
+    FleetServer,
+    ScoringServer,
+    TransientScoringError,
+)
+
+
+def _train(seed: int, n: int = 200):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(0, 1, n)
+    color = rng.choice(["red", "green", "blue"], n)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(1.5 * x1 + (color == "red"))))
+         ).astype(float)
+    records = [{"label": float(y[i]), "x1": float(x1[i]),
+                "color": str(color[i])} for i in range(n)]
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    f_x1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    f_color = FeatureBuilder.PickList("color").extract_field().as_predictor()
+    checked = label.sanity_check(transmogrify([f_x1, f_color]))
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+    pred = label.transform_with(sel, checked)
+
+    import pandas as pd
+
+    model = (Workflow().set_result_features(label, pred)
+             .set_reader(DataReaders.Simple.dataframe(pd.DataFrame(records)))
+             ).train()
+    nolabel = [{k: v for k, v in r.items() if k != "label"}
+               for r in records]
+    return model, nolabel
+
+
+@pytest.fixture(scope="module")
+def two_models():
+    a = _train(7)
+    b = _train(99)
+    assert a[0].serving_plan().fingerprint != b[0].serving_plan().fingerprint
+    return a, b
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    obs_trace.uninstall_tracer()
+    obs_flight.uninstall_recorder()
+    yield
+    obs_trace.uninstall_tracer()
+    obs_flight.uninstall_recorder()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: fleet replay -> trace.json -> full causal chain per request
+# ---------------------------------------------------------------------------
+
+class TestFleetReplayCausalChain:
+    @pytest.fixture(scope="class")
+    def replay(self, two_models, tmp_path_factory):
+        from transmogrifai_tpu.cli.gen import main
+
+        tmp = tmp_path_factory.mktemp("fleet_replay")
+        (model_a, recs_a), (model_b, recs_b) = two_models
+        models_dir = tmp / "models"
+        model_a.save(str(models_dir / "t_a"))
+        model_b.save(str(models_dir / "t_b"))
+        # interleaved tenants + a generous flush window so flushed batches
+        # mix both tenants (the "batch shared with another tenant" clause)
+        records = []
+        for ra, rb in zip(recs_a[:24], recs_b[:24]):
+            records.append({"tenant": "t_a", **ra})
+            records.append({"tenant": "t_b", **rb})
+        replay_in = tmp / "records.jsonl"
+        replay_in.write_text(
+            "".join(json.dumps(r) + "\n" for r in records))
+        tel_dir = tmp / "tel"
+        statusz = tmp / "statusz.jsonl"
+        rc = main(["serve", "--models", str(models_dir),
+                   "--records", str(replay_in),
+                   "--output", str(tmp / "scores.jsonl"),
+                   "--metrics-out", str(tmp / "metrics.json"),
+                   "--telemetry", str(tel_dir),
+                   "--trace-detail", "requests",
+                   "--max-wait-ms", "60", "--max-batch", "256",
+                   "--statusz-out", str(statusz)])
+        assert rc == 0
+        trace = json.loads((tel_dir / "trace.json").read_text())
+        return tmp, trace, statusz
+
+    def test_causal_chain_across_shared_batch(self, replay):
+        _tmp, trace, _statusz = replay
+        reqs = request_events(trace)
+        assert reqs, "the replay recorded no request tracks"
+        # choose a request whose flushed batch carried BOTH tenants
+        chosen = None
+        for rid, pair in sorted(reqs.items()):
+            if "e" not in pair:
+                continue
+            seq = pair["e"]["args"].get("batch_seq")
+            peers = {p["e"]["args"].get("tenant") for p in reqs.values()
+                     if "e" in p
+                     and p["e"]["args"].get("batch_seq") == seq}
+            if len(peers) >= 2:
+                chosen = rid
+                break
+        assert chosen is not None, "no flush mixed two tenants"
+        chain = reconstruct_request(trace, chosen)
+        # the complete causal chain with per-phase durations
+        assert chain["outcome"] == "ok"
+        assert chain["tenant"] in ("t_a", "t_b")
+        assert chain["queue_ms"] is not None and chain["queue_ms"] >= 0
+        assert chain["total_ms"] >= chain["queue_ms"]
+        assert chain["batch"] is not None and chain["batch"]["size"] >= 2
+        for phase in ("encode", "device", "host"):
+            assert phase in chain["phases"], chain
+            assert chain["phases"][phase]["ms"] >= 0.0
+        # padding waste + bucket of the device dispatch are recorded
+        assert chain["phases"]["device"]["bucket"] >= 1
+        assert chain["phases"]["device"]["padded"] >= 0
+        # the batch really was shared with the other tenant
+        assert len(chain["peer_tenants"]) == 2, chain["peer_tenants"]
+        # submit precedes flush precedes response on the trace timeline
+        assert chain["submit_ts_us"] <= chain["batch"]["ts_us"] + 1.0
+        assert chain["response_ts_us"] >= chain["batch"]["ts_us"]
+
+    def test_every_request_tracked_and_linked(self, replay):
+        _tmp, trace, _statusz = replay
+        reqs = request_events(trace)
+        assert len(reqs) == 48  # one track per replayed record
+        flush_seqs = {ev["args"]["batch_seq"]
+                      for ev in trace["traceEvents"]
+                      if ev.get("ph") == "X"
+                      and ev.get("name") == "serve.flush"}
+        for rid, pair in reqs.items():
+            assert set(pair) == {"b", "e"}, f"request {rid} unpaired"
+            assert pair["e"]["args"]["batch_seq"] in flush_seqs
+
+    def test_statusz_stream_and_cli_top(self, replay, capsys):
+        from transmogrifai_tpu.cli.gen import main
+
+        _tmp, _trace, statusz = replay
+        lines = [json.loads(line) for line
+                 in statusz.read_text().splitlines() if line.strip()]
+        assert lines, "the replay emitted no statusz lines"
+        last = lines[-1]
+        assert set(last["tenants"]) == {"t_a", "t_b"}
+        assert last["fleet"]["slo_monitor_armed"] is True
+        row = last["tenants"]["t_a"]
+        assert row["completed"] == 24
+        assert row["device_seconds"] > 0
+        assert row["budget_remaining"] is not None
+        rc = main(["top", "--statusz", str(statusz), "--once",
+                   "--no-clear"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "t_a" in out and "t_b" in out
+        assert "TENANT" in out and "BUDGET" in out
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: per-tenant device-time accounting sums to the batch total
+# ---------------------------------------------------------------------------
+
+class TestDeviceCostAccounting:
+    def test_per_tenant_device_seconds_sum_to_total(self, two_models):
+        (model_a, recs_a), (model_b, recs_b) = two_models
+        with FleetServer(max_batch=64, max_wait_ms=20) as fleet:
+            fleet.register("a", model_a, slo="gold")
+            fleet.register("b", model_b, slo="bronze")
+            futs = []
+            for ra, rb in zip(recs_a[:40], recs_b[:40]):
+                futs.append(fleet.submit("a", ra))
+                futs.append(fleet.submit("b", rb))
+            for f in futs:
+                f.result(timeout=30)
+            total = fleet.batcher.metrics()["device_seconds"]
+            per_tenant = fleet.batcher.tenant_metrics()
+        assert total > 0
+        assert per_tenant["a"]["device_seconds"] > 0
+        assert per_tenant["b"]["device_seconds"] > 0
+        # exact amortization: the fleet fans each flush out per tenant
+        # sub-batch, so tenant attribution is direct measurement
+        assert sum(row["device_seconds"] for row in per_tenant.values()) \
+            == pytest.approx(total, rel=1e-6)
+
+    def test_single_model_total_and_padding(self, two_models):
+        (model_a, recs_a), _ = two_models
+        with ScoringServer(model_a, max_batch=32, max_wait_ms=5) as server:
+            futs = [server.submit(r) for r in recs_a[:50]]
+            for f in futs:
+                f.result(timeout=30)
+            status = server.statusz()
+        assert status["device_seconds"] > 0
+        assert status["padding_rows"] >= 0
+        assert status["completed"] == 50
+        assert status["breaker"] == "closed"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: threaded multi-tenant submit storm — structural trace checks
+# ---------------------------------------------------------------------------
+
+class TestRequestStorm:
+    def test_no_orphans_under_concurrent_load(self, two_models):
+        from test_obs import nesting_violations
+
+        (model_a, recs_a), (model_b, recs_b) = two_models
+        tel = Telemetry(detail="requests")
+        n_threads, per_thread = 6, 25
+        tel.start()
+        try:
+            with FleetServer(max_batch=32, max_wait_ms=2) as fleet:
+                fleet.register("a", model_a, slo="gold")
+                fleet.register("b", model_b, slo="bronze")
+                errors = []
+
+                def storm(i):
+                    try:
+                        tenant, recs = (("a", recs_a), ("b", recs_b))[i % 2]
+                        futs = [fleet.submit(tenant, recs[j % len(recs)])
+                                for j in range(per_thread)]
+                        for f in futs:
+                            f.result(timeout=60)
+                    except Exception as e:  # noqa: BLE001 — surfaced below
+                        errors.append(e)
+
+                threads = [threading.Thread(target=storm, args=(i,))
+                           for i in range(n_threads)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                assert not errors, errors
+        finally:
+            tel.stop()
+        trace = tel.tracer.chrome_trace()
+        reqs = request_events(trace)
+        assert len(reqs) == n_threads * per_thread
+        # no orphaned async events: every begin pairs with exactly one end
+        for rid, pair in reqs.items():
+            assert set(pair) == {"b", "e"}, f"request {rid} unpaired"
+        # every end links to a real flush span of this trace
+        flush_seqs = {ev["args"]["batch_seq"]
+                      for ev in trace["traceEvents"]
+                      if ev.get("ph") == "X"
+                      and ev.get("name") == "serve.flush"}
+        outcomes = set()
+        for pair in reqs.values():
+            outcomes.add(pair["e"]["args"]["outcome"])
+            assert pair["e"]["args"]["batch_seq"] in flush_seqs
+        assert outcomes == {"ok"}
+        # X spans still nest per thread under the storm
+        assert nesting_violations(trace["traceEvents"]) == []
+
+
+# ---------------------------------------------------------------------------
+# Satellite: Prometheus exposition conformance over the canonical table
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r' (-?(?:[0-9.]+(?:[eE][+-]?[0-9]+)?|inf|nan))$')
+
+
+def _parse_exposition(text: str):
+    """Parse the full text exposition, asserting format conformance:
+    every line is HELP/TYPE/sample, TYPE precedes its family's samples,
+    no duplicate TYPE, every sample belongs to a typed family."""
+    helps, types = {}, {}
+    samples = []
+    assert text.endswith("\n")
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# HELP "):
+            name, _, rest = line[len("# HELP "):].partition(" ")
+            helps[name] = rest
+        elif line.startswith("# TYPE "):
+            name, _, kind = line[len("# TYPE "):].partition(" ")
+            assert name not in types, f"duplicate TYPE for {name}"
+            assert kind in ("counter", "gauge", "summary"), line
+            types[name] = kind
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"malformed exposition line: {line!r}"
+            name = m.group(1)
+            family = name
+            if family not in types:
+                for suffix in ("_count", "_sum"):
+                    if name.endswith(suffix) \
+                            and name[:-len(suffix)] in types:
+                        family = name[:-len(suffix)]
+                        break
+            assert family in types, f"sample {name} precedes its TYPE"
+            if family != name:
+                assert types[family] == "summary", line
+            float(m.group(3))
+            samples.append((name, m.group(2)))
+    return helps, types, samples
+
+
+class TestPrometheusConformance:
+    def test_full_exposition_covers_canonical_table(self, two_models):
+        (model_a, recs_a), (model_b, recs_b) = two_models
+        with FleetServer(max_batch=32, max_wait_ms=5) as fleet:
+            fleet.register("a", model_a, slo="gold")
+            fleet.register("b", model_b, slo="bronze")
+            futs = [fleet.submit("a", r) for r in recs_a[:20]] \
+                + [fleet.submit("b", r) for r in recs_b[:20]]
+            for f in futs:
+                f.result(timeout=30)
+            text = fleet.prometheus()
+        helps, types, samples = _parse_exposition(text)
+        prom_kind = {"counter": "counter", "gauge": "gauge",
+                     "histogram": "summary"}
+        for name, (kind, _own, _alias, help_text) in \
+                CANONICAL_METRICS.items():
+            assert name in types, f"no # TYPE for canonical {name}"
+            assert types[name] == prom_kind[kind], name
+            assert name in helps, f"no # HELP for canonical {name}"
+            assert helps[name] == help_text, name
+        # live per-tenant series made it into the exposition
+        sample_keys = {n + (lab or "") for n, lab in samples}
+        assert 'tmog_serve_batcher_completed_total{tenant="a"}' \
+            in sample_keys
+        assert 'tmog_serve_batcher_device_seconds_total{tenant="b"}' \
+            in sample_keys
+
+    def test_single_server_exposition_parses(self, two_models):
+        (model_a, recs_a), _ = two_models
+        with ScoringServer(model_a, max_batch=16, max_wait_ms=2) as server:
+            futs = [server.submit(r) for r in recs_a[:10]]
+            for f in futs:
+                f.result(timeout=30)
+            _parse_exposition(server.prometheus())
+
+
+# ---------------------------------------------------------------------------
+# Satellite: fleet fault points carry the tenant into the flight snapshot
+# ---------------------------------------------------------------------------
+
+class TestFaultTenantAttribution:
+    def test_route_fault_tagged_and_autodumped_with_tenant(
+            self, two_models, tmp_path):
+        (model_a, recs_a), _ = two_models
+        recorder = obs_flight.install_recorder(
+            FlightRecorder(dump_dir=str(tmp_path)))
+        try:
+            with FleetServer(max_batch=8, max_wait_ms=1) as fleet:
+                fleet.register("victim", model_a, slo="gold")
+                harness = FaultHarness(seed=0)
+                harness.script("route", [TransientScoringError("boom")])
+                with harness:
+                    fut = fleet.submit("victim", recs_a[0])
+                    with pytest.raises(TransientScoringError):
+                        fut.result(timeout=30)
+        finally:
+            obs_flight.uninstall_recorder()
+        faults = recorder.events("fault_injected")
+        assert len(faults) == 1
+        assert faults[0]["data"]["point"] == "route"
+        assert faults[0]["data"]["tenant"] == "victim"
+        dump = json.loads((tmp_path / "flight-fault-001.json").read_text())
+        dumped = [ev for ev in dump["events"]
+                  if ev["kind"] == "fault_injected"]
+        assert dumped and dumped[0]["data"]["tenant"] == "victim"
+
+    def test_serve_level_fault_stays_untagged(self, two_models, tmp_path):
+        (model_a, recs_a), _ = two_models
+        recorder = obs_flight.install_recorder(FlightRecorder())
+        try:
+            plan = model_a.serving_plan()
+            harness = FaultHarness(seed=0)
+            harness.script("device", [TransientScoringError("boom")])
+            with harness, pytest.raises(TransientScoringError):
+                plan.score(recs_a[:4])
+        finally:
+            obs_flight.uninstall_recorder()
+        faults = recorder.events("fault_injected")
+        assert len(faults) == 1
+        assert "tenant" not in faults[0]["data"]
+
+
+# ---------------------------------------------------------------------------
+# Satellite: out-of-core flight coverage (chunk_resume / spill / stall)
+# ---------------------------------------------------------------------------
+
+class TestOutOfCoreFlightEvents:
+    def test_spill_activation_recorded(self, tmp_path):
+        from transmogrifai_tpu.data.chunked import maybe_chunk
+        from transmogrifai_tpu.data.dataset import Column, Dataset
+        from transmogrifai_tpu.types import Real
+
+        ds = Dataset({"x": Column(Real, np.arange(4096, dtype=np.float64),
+                                  np.ones(4096, dtype=np.bool_))})
+        recorder = obs_flight.install_recorder(FlightRecorder())
+        try:
+            out = maybe_chunk(ds, budget=1024,
+                              spill_dir=str(tmp_path / "spill"))
+        finally:
+            obs_flight.uninstall_recorder()
+        from transmogrifai_tpu.data.chunked import ChunkedDataset
+
+        assert isinstance(out, ChunkedDataset)
+        evs = recorder.events("spill_activation")
+        assert len(evs) == 1
+        assert evs[0]["data"]["host_budget"] == 1024
+        assert evs[0]["data"]["dataset_bytes"] > 1024
+
+    def test_prefetch_stall_recorded(self):
+        from transmogrifai_tpu.readers.prefetch import (ChunkPrefetcher,
+                                                        PrefetchStats)
+
+        def slow_loader(ci):
+            time.sleep(0.02)
+            return ci * 10
+
+        stats = PrefetchStats()
+        recorder = obs_flight.install_recorder(FlightRecorder())
+        try:
+            with ChunkPrefetcher(slow_loader, 4, stats=stats) as chunks:
+                got = [item for _ci, item in chunks]
+        finally:
+            obs_flight.uninstall_recorder()
+        assert got == [0, 10, 20, 30]
+        evs = recorder.events("prefetch_stall")
+        assert evs, "an immediately-draining consumer must record stalls"
+        assert stats.stalls == len(evs)
+        assert all(ev["data"]["wait_s"] > 0 for ev in evs)
+        # sentinel/error rows never count as stalls on phantom chunks
+        assert all(ev["data"]["chunk"] < 4 for ev in evs)
+
+    def test_chunk_resume_recorded(self, tmp_path):
+        from transmogrifai_tpu.data.chunked import ChunkedDataset
+        from transmogrifai_tpu.data.dataset import Column, Dataset
+        from transmogrifai_tpu.readers import OffsetCheckpoint
+        from transmogrifai_tpu.types import Real, RealNN
+        from transmogrifai_tpu.workflow.dag import compute_dag
+        from transmogrifai_tpu.workflow.ooc import (EpochStats,
+                                                    chunked_transform_epoch)
+
+        rng = np.random.default_rng(3)
+        n = 600
+        cols = {f"num{i}": Column(Real, rng.normal(size=n),
+                                  np.ones(n, dtype=np.bool_))
+                for i in range(3)}
+        cols["label"] = Column(
+            RealNN, (rng.random(n) > 0.5).astype(np.float64),
+            np.ones(n, dtype=np.bool_))
+        ds = Dataset(cols)
+        label = FeatureBuilder.of("label", RealNN).extract_field() \
+            .as_response()
+        feats = [FeatureBuilder.of(f"num{i}", Real).extract_field()
+                 .as_predictor() for i in range(3)]
+        checked = label.sanity_check(transmogrify(feats))
+        m = (Workflow().set_input_dataset(ds)
+             .set_result_features(label, checked)).train()
+        runners = [m.fitted.get(s.uid, s)
+                   for layer in compute_dag(m.result_features)
+                   for s in layer]
+        cds = ChunkedDataset.from_dataset(
+            ds, chunk_rows=256, spill_dir=str(tmp_path / "store"))
+        ckpt = OffsetCheckpoint(str(tmp_path / "offsets.json"))
+        chunked_transform_epoch(cds, runners, checkpoint=ckpt)
+
+        # re-run the SAME committed epoch with the recorder installed: the
+        # resume skips every chunk and records exactly that
+        recorder = obs_flight.install_recorder(FlightRecorder())
+        try:
+            stats = EpochStats()
+            chunked_transform_epoch(cds, runners, checkpoint=ckpt,
+                                    stats=stats)
+        finally:
+            obs_flight.uninstall_recorder()
+        assert stats.chunks_skipped == cds.n_chunks
+        evs = recorder.events("chunk_resume")
+        assert len(evs) == 1
+        assert evs[0]["data"]["skipped_chunks"] == cds.n_chunks
+        assert evs[0]["data"]["total_chunks"] == cds.n_chunks
+
+
+# ---------------------------------------------------------------------------
+# statusz: the JSON endpoint feeding the console
+# ---------------------------------------------------------------------------
+
+class TestStatusz:
+    def test_fleet_statusz_rps_and_json_stable(self, two_models):
+        from transmogrifai_tpu.cli.top import format_statusz
+        from transmogrifai_tpu.obs.metrics import assert_json_stable
+
+        (model_a, recs_a), _ = two_models
+        with FleetServer(max_batch=16, max_wait_ms=2) as fleet:
+            fleet.arm_slo_monitor()
+            fleet.register("a", model_a, slo="gold")
+            first = fleet.statusz()  # rps baseline
+            assert first["tenants"]["a"]["rps"] is None
+            futs = [fleet.submit("a", r) for r in recs_a[:30]]
+            for f in futs:
+                f.result(timeout=30)
+            time.sleep(0.01)
+            status = fleet.statusz()
+        row = status["tenants"]["a"]
+        assert row["rps"] is not None and row["rps"] > 0
+        assert row["completed"] == 30
+        assert row["breaker"] == "closed"
+        assert row["warm_buckets"] > 0
+        assert row["budget_remaining"] == 1.0  # clean traffic, full budget
+        assert status["fleet"]["slo_monitor_armed"] is True
+        assert_json_stable(status)  # the statusz JSONL line contract
+        frame = format_statusz(status)
+        assert "a" in frame and "gold" in frame

@@ -393,6 +393,11 @@ class TestBenchSmoke:
         assert ob["unexpected_compiles"] == 0, ob
         assert ob["disabled_rps"] > 0 and ob["enabled_rps"] > 0
         assert ob["trace_events"] > 0  # the tracer actually recorded spans
+        # ISSUE 14: per-request causal tracing (detail="requests") must
+        # stay under the same <5% overhead contract on the real
+        # submit->flush->response path, and actually record request tracks
+        assert ob["gate_requests_overhead_lt_5pct"] is True, ob
+        assert ob["request_trace_events"] > 0, ob
         # continual control plane (ISSUE 9): the stream section pushes
         # records through drift-check + shadow-score, and the frozen-prep
         # warm refit must recompile NOTHING (plan cache + sweep executable

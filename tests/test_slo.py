@@ -1,0 +1,359 @@
+"""SLO error-budget/burn-rate monitor (ISSUE 14: obs/slo.py).
+
+Acceptance criteria proven here:
+- burn-rate monitor e2e (TestBurnRateE2E): induced overload sheds one
+  tenant, the monitor fires TM902 + an ``slo_burn`` flight event while the
+  tenant's window budget is still positive (i.e. BEFORE exhaustion),
+  continued overload exhausts the budget (TM903) and arms the PR 12
+  shed-tier escalation (the tenant joins the batcher's degraded set), and
+  per-tenant device-time accounting sums to the batcher's total device
+  span time;
+- deterministic unit coverage (fake clock + hand-built counters) of the
+  burn math, firing hysteresis, exhaustion/recovery escalation, and the
+  trainer's stream-cadence polling hook.
+"""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    FeatureBuilder,
+    Workflow,
+    transmogrify,
+)
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.obs import (
+    FlightRecorder,
+    SloBudget,
+    SloMonitor,
+    flight as obs_flight,
+)
+from transmogrifai_tpu.obs.metrics import MetricsRegistry
+from transmogrifai_tpu.readers.files import DataReaders
+from transmogrifai_tpu.serve import FleetServer, LoadShedError
+
+
+def _train(seed: int, n: int = 200):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(0, 1, n)
+    y = (rng.random(n) < 1 / (1 + np.exp(-1.5 * x1))).astype(float)
+    records = [{"label": float(y[i]), "x1": float(x1[i])}
+               for i in range(n)]
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    f_x1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    checked = label.sanity_check(transmogrify([f_x1]))
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+    pred = label.transform_with(sel, checked)
+
+    import pandas as pd
+
+    model = (Workflow().set_result_features(label, pred)
+             .set_reader(DataReaders.Simple.dataframe(pd.DataFrame(records)))
+             ).train()
+    return model, [{"x1": r["x1"]} for r in records]
+
+
+@pytest.fixture(scope="module")
+def fleet_model():
+    return _train(11)
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight():
+    obs_flight.uninstall_recorder()
+    yield
+    obs_flight.uninstall_recorder()
+
+
+# ---------------------------------------------------------------------------
+# Deterministic unit coverage: fake clock, hand-built counters
+# ---------------------------------------------------------------------------
+
+class _Counters:
+    """Hand-drivable per-tenant good/bad series in a real registry."""
+
+    def __init__(self, tenant="t"):
+        self.registry = MetricsRegistry()
+        labels = {"tenant": tenant}
+        self.completed = self.registry.counter(
+            "tmog_serve_batcher_completed_total", labels=labels)
+        self.shed = self.registry.counter(
+            "tmog_serve_batcher_shed_total", labels=labels)
+        self.deadline = self.registry.counter(
+            "tmog_serve_batcher_deadline_expired_total", labels=labels)
+        self.failed = self.registry.counter(
+            "tmog_serve_batcher_failed_total", labels=labels)
+
+
+class TestSloMonitorUnit:
+    BUDGET = SloBudget(target=0.9, window_s=600.0, fast_burn=5.0,
+                       slow_burn=3.0, short_window_s=10.0,
+                       long_window_s=60.0)
+
+    def test_burn_fires_before_budget_exhausts(self):
+        c = _Counters()
+        clock = [0.0]
+        mon = SloMonitor(c.registry, {"t": "svc"},
+                         budgets={"svc": self.BUDGET},
+                         clock=lambda: clock[0])
+        mon.poll()  # zero baseline sample anchors the windows
+        clock[0] = 5.0
+        c.completed.inc(1000)  # healthy history inside the window
+        st = mon.poll()["t"]
+        assert st["budget_remaining"] == 1.0 and st["firing"] == []
+        assert mon.diagnostics() == []
+
+        clock[0] = 20.0
+        c.shed.inc(30)  # 100% bad over the short window -> burn 10x > 5x
+        st = mon.poll()["t"]
+        assert "fast" in st["firing"]
+        # the point of burn-rate alerting: the finding lands while most of
+        # the window budget is still unspent
+        assert 0.0 < st["budget_remaining"] < 1.0
+        codes = [d.code for d in mon.diagnostics()]
+        assert "TM902" in codes and "TM903" not in codes
+
+    def test_firing_is_edge_triggered_with_hysteresis(self):
+        c = _Counters()
+        clock = [0.0]
+        mon = SloMonitor(c.registry, {"t": "svc"},
+                         budgets={"svc": self.BUDGET},
+                         clock=lambda: clock[0])
+        mon.poll()  # zero baseline
+        clock[0] = 5.0
+        c.completed.inc(1000)
+        mon.poll()
+        clock[0] = 20.0
+        c.shed.inc(30)
+        mon.poll()
+        n_fired = len(mon.diagnostics())
+        assert n_fired > 0
+        # still burning: no duplicate finding while the alert stays up
+        clock[0] = 22.0
+        c.shed.inc(5)
+        mon.poll()
+        assert len(mon.diagnostics()) == n_fired
+        # recovery far below threshold/2 re-arms; a fresh burn re-fires
+        clock[0] = 120.0
+        c.completed.inc(5000)
+        mon.poll()
+        clock[0] = 130.0
+        c.shed.inc(600)
+        mon.poll()
+        assert len(mon.diagnostics()) > n_fired
+
+    def test_exhaustion_escalates_and_recovery_disarms(self):
+        c = _Counters()
+        clock = [0.0]
+        escalations = []
+        recorder = obs_flight.install_recorder(FlightRecorder())
+        try:
+            mon = SloMonitor(
+                c.registry, {"t": "svc"}, budgets={"svc": self.BUDGET},
+                clock=lambda: clock[0],
+                escalate=lambda t, d: escalations.append((t, d)))
+            mon.poll()  # zero baseline
+            clock[0] = 5.0
+            c.completed.inc(100)
+            mon.poll()
+            clock[0] = 10.0
+            c.shed.inc(50)  # consumed = 50/(150*0.1) >> 1 -> exhausted
+            st = mon.poll()["t"]
+            assert st["budget_remaining"] <= 0.0
+            assert st["escalated"] is True
+            assert escalations == [("t", True)]
+            codes = [d.code for d in mon.diagnostics()]
+            assert "TM903" in codes
+            # recovery: enough good traffic to clear the re-arm threshold
+            clock[0] = 60.0
+            c.completed.inc(50_000)
+            st = mon.poll()["t"]
+            assert st["escalated"] is False
+            assert escalations == [("t", True), ("t", False)]
+            kinds = {ev["data"]["code"] for ev
+                     in recorder.events("slo_burn")}
+            assert kinds == {"TM902", "TM903"}
+            esc = recorder.events("slo_escalation")
+            assert [ev["data"]["degraded"] for ev in esc] == [True, False]
+        finally:
+            obs_flight.uninstall_recorder()
+
+    def test_rearm_disarms_previous_monitors_escalations(self, fleet_model):
+        """Replacing the fleet monitor must release tenants the OLD monitor
+        degraded — the successor's empty escalation set can never issue
+        their recovery call."""
+        from transmogrifai_tpu.serve import FleetServer
+
+        model, _records = fleet_model
+        with FleetServer(max_batch=8, max_wait_ms=1) as fleet:
+            fleet.register("t", model, slo="bronze")
+            mon1 = fleet.arm_slo_monitor()
+            mon1._escalated.add("t")  # as if "t" exhausted its budget
+            fleet.batcher.set_degraded("t", True)
+            fleet.arm_slo_monitor()  # re-arm with fresh budgets
+            assert "t" not in fleet.batcher._degraded
+
+    def test_no_traffic_no_findings(self):
+        c = _Counters()
+        mon = SloMonitor(c.registry, {"t": "svc"},
+                         budgets={"svc": self.BUDGET}, clock=lambda: 0.0)
+        for _ in range(5):
+            st = mon.poll()["t"]
+        assert st["budget_remaining"] == 1.0
+        assert st["firing"] == [] and mon.diagnostics() == []
+
+    def test_budget_validation(self):
+        with pytest.raises(ValueError, match="target"):
+            SloBudget(target=1.5)
+        with pytest.raises(ValueError, match="windows"):
+            SloBudget(window_s=-1)
+
+    def test_trainer_polls_monitor(self, fleet_model):
+        """The continual trainer drives an armed monitor at stream cadence
+        and folds its findings into the trainer diagnostics log."""
+        from transmogrifai_tpu.serve import ScoringServer
+        from transmogrifai_tpu.workflow.continual import ContinualTrainer
+
+        model, records = fleet_model
+
+        class _OneBatchReader:
+            last_records = records[:8]
+
+            def stream_datasets(self, raws):
+                from transmogrifai_tpu.readers.base import rows_to_dataset
+
+                yield rows_to_dataset(self.last_records, list(raws),
+                                      allow_missing_response=True)
+
+        polls = []
+
+        class _SpyMonitor:
+            def poll(self):
+                polls.append(1)
+                return {}
+
+            def diagnostics(self):
+                return []
+
+            def status(self):
+                return {"spied": True}
+
+        with ScoringServer(model, max_batch=8, max_wait_ms=1) as server:
+            trainer = ContinualTrainer(server, model, _OneBatchReader(),
+                                       refit_enabled=False,
+                                       slo_monitor=_SpyMonitor())
+            metrics = trainer.run(max_batches=1)
+        assert polls == [1]
+        assert metrics["slo"] == {"spied": True}
+
+
+# ---------------------------------------------------------------------------
+# Acceptance e2e: overload -> shed -> TM902 before exhaustion -> escalation
+# ---------------------------------------------------------------------------
+
+class TestBurnRateE2E:
+    def test_overload_burn_exhaustion_and_cost_accounting(self,
+                                                          fleet_model):
+        model, records = fleet_model
+        budgets = {
+            "gold": SloBudget(),  # defaults: gold never fires here
+            # a sub-second fast window so the burn evaluates against the
+            # post-settle baseline sample instead of the whole history
+            "bronze": SloBudget(target=0.5, window_s=3600.0,
+                                fast_burn=1.5, slow_burn=5.0,
+                                short_window_s=0.2, long_window_s=60.0),
+        }
+        recorder = obs_flight.install_recorder(FlightRecorder())
+        try:
+            # a small queue + a long flush window hold the pending set
+            # still, so a gold burst deterministically sheds bronze
+            with FleetServer(max_batch=4096, max_wait_ms=250.0,
+                             max_queue=32) as fleet:
+                monitor = fleet.arm_slo_monitor(budgets=budgets)
+                fleet.register("og", model, slo="gold")
+                fleet.register("ob", model, slo="bronze")
+                monitor.poll()  # zero baseline anchors the budget window
+
+                # phase 1 — healthy bronze history builds window budget
+                futs = [fleet.submit("ob", records[i % len(records)])
+                        for i in range(30)]
+                for f in futs:
+                    f.result(timeout=60)
+                monitor.poll()  # post-settle burn-rate baseline
+                import time as _time
+
+                _time.sleep(0.25)  # age the baseline past the fast window
+
+                # phase 2 — overload: fill the queue with bronze, then a
+                # gold burst sheds 24 of them (lowest tier first)
+                bronze = [fleet.submit("ob", records[i % len(records)])
+                          for i in range(32)]
+                gold = [fleet.submit("og", records[i % len(records)])
+                        for i in range(24)]
+                st = monitor.poll()["ob"]
+                shed_now = sum(1 for f in bronze
+                               if f.done() and isinstance(
+                                   f.exception(), LoadShedError))
+                assert shed_now == 24
+                # TM902 fires BEFORE the window budget is exhausted
+                assert "fast" in st["firing"] or "slow" in st["firing"]
+                assert st["budget_remaining"] > 0.0, st
+                codes = [d.code for d in monitor.diagnostics()]
+                assert "TM902" in codes and "TM903" not in codes
+                burn_events = recorder.events("slo_burn")
+                assert burn_events \
+                    and burn_events[0]["data"]["tenant"] == "ob"
+
+                for f in gold:
+                    f.result(timeout=60)
+                for f in bronze:
+                    if not (f.done() and isinstance(f.exception(),
+                                                    LoadShedError)):
+                        f.result(timeout=60)
+
+                # phase 3 — a second overload round exhausts the budget:
+                # TM903 + the PR 12 shed-tier escalation arms (the tenant
+                # joins the batcher's degraded set)
+                bronze2 = [fleet.submit("ob", records[i % len(records)])
+                           for i in range(32)]
+                gold2 = [fleet.submit("og", records[i % len(records)])
+                         for i in range(24)]
+                st = monitor.poll()["ob"]
+                assert st["budget_remaining"] <= 0.0
+                assert st["escalated"] is True
+                assert "ob" in fleet.batcher._degraded
+                codes = [d.code for d in monitor.diagnostics()]
+                assert "TM903" in codes
+                esc = recorder.events("slo_escalation")
+                assert esc and esc[0]["data"] == {
+                    "tenant": "ob", "slo": "bronze", "degraded": True}
+
+                for f in gold2:
+                    f.result(timeout=60)
+                for f in bronze2:
+                    if not (f.done() and isinstance(f.exception(),
+                                                    LoadShedError)):
+                        f.result(timeout=60)
+
+                # gold stayed clean the whole time
+                gold_st = monitor.poll()["og"]
+                assert gold_st["firing"] == []
+                assert gold_st["budget_remaining"] == 1.0
+
+                # acceptance: per-tenant device-time accounting sums (to
+                # float precision) to the batcher's total device span time
+                total = fleet.batcher.metrics()["device_seconds"]
+                per_tenant = fleet.batcher.tenant_metrics()
+                assert total > 0
+                assert sum(row["device_seconds"]
+                           for row in per_tenant.values()) \
+                    == pytest.approx(total, rel=1e-6)
+
+                # statusz surfaces the incident for `cli top`
+                status = fleet.statusz()
+                assert status["tenants"]["ob"]["escalated"] is True
+                assert status["tenants"]["ob"]["budget_remaining"] <= 0.0
+        finally:
+            obs_flight.uninstall_recorder()

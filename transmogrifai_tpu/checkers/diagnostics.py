@@ -300,6 +300,20 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "fingerprint against the TM602 static recompile-hazard map "
               "(an unkeyed shape/static, a cache eviction, or prep that is "
               "not actually frozen)"),
+    "TM902": (Severity.WARNING, "SLO error budget burning too fast",
+              "the tenant's bad-event ratio (shed + deadline-expired + "
+              "failed vs completed) over the burn lookback window exceeds "
+              "the sustainable rate for its SLO class; at this rate the "
+              "window budget exhausts well before the window ends — shed "
+              "upstream load, raise the tenant's class, or add capacity "
+              "before TM903 fires (obs/slo.py, docs/observability.md)"),
+    "TM903": (Severity.ERROR, "SLO error budget exhausted",
+              "the tenant consumed its whole error budget for the current "
+              "window; when shed-tier escalation is armed "
+              "(FleetServer.arm_slo_monitor) the tenant is degraded so it "
+              "absorbs further shedding cuts instead of tenants still "
+              "inside budget — it re-arms automatically once the budget "
+              "recovers past the re-arm threshold"),
     # -- leakage ------------------------------------------------------------
     "TM401": (Severity.ERROR, "label leaks into feature path",
               "a response(-derived) feature reaches the model's feature input "

@@ -134,6 +134,14 @@ def add_serve_parser(sub) -> None:
                    help="destination for the periodic metrics-snapshot "
                         "JSONL lines (default: metrics.jsonl in the "
                         "--telemetry dir, else stderr)")
+    p.add_argument("--statusz-out", default=None, metavar="FILE",
+                   help="--models mode: append FleetServer.statusz() JSONL "
+                        "lines (per-tenant rps/p99/budget/breaker/HBM) "
+                        "here during the replay — the `cli top` console's "
+                        "data source")
+    p.add_argument("--statusz-interval", type=float, default=1.0,
+                   help="minimum seconds between statusz lines "
+                        "(default 1.0; a final line always lands)")
 
 
 def _read_records(path: str) -> Tuple[List[Dict[str, Any]], int]:
@@ -374,6 +382,25 @@ def _run_fleet(ns) -> int:
     metrics: Dict[str, Any] = {}
     prom = None
     results: List[Dict[str, Any]] = []
+    # statusz stream (cli top's data source): append-only, time-gated, its
+    # own sink — never the scores file
+    statusz_fh = open(ns.statusz_out, "a") if ns.statusz_out else None
+    statusz_state = {"last": 0.0, "lines": 0}
+
+    def _maybe_statusz(fleet, force=False):
+        if statusz_fh is None:
+            return
+        import time as _time
+
+        now = _time.monotonic()
+        if not force and now - statusz_state["last"] < ns.statusz_interval:
+            return
+        statusz_state["last"] = now
+        statusz_fh.write(json.dumps(fleet.statusz(), sort_keys=True,
+                                    default=str) + "\n")
+        statusz_fh.flush()
+        statusz_state["lines"] += 1
+
     try:
         if tel is not None:
             tel.start()
@@ -382,6 +409,9 @@ def _run_fleet(ns) -> int:
                          resilience=not ns.no_resilience,
                          deadline_ms=ns.deadline_ms,
                          hbm_budget=ns.hbm_budget) as fleet:
+            # the burn-rate monitor rides every fleet replay: statusz
+            # polls it, so budget/burn columns are live in `cli top`
+            fleet.arm_slo_monitor()
             for tenant in tenant_dirs:
                 fleet.register(
                     tenant,
@@ -421,6 +451,8 @@ def _run_fleet(ns) -> int:
                 row, ok = resolve(tenant, f)
                 errors += not ok
                 results.append(row)
+                _maybe_statusz(fleet)
+            _maybe_statusz(fleet, force=True)
             metrics = fleet.metrics()
             prom = fleet.prometheus()
     finally:
@@ -429,10 +461,13 @@ def _run_fleet(ns) -> int:
             tel.dump(metrics_payload={"source": "cli serve --models",
                                       "metrics": metrics},
                      prometheus=prom)
+        if statusz_fh is not None:
+            statusz_fh.close()
     metrics["replay"] = {"records": len(records),
                          "tenants": tenant_dirs,
                          "skipped_malformed": skipped,
-                         "record_errors": errors}
+                         "record_errors": errors,
+                         "statusz_lines": statusz_state["lines"]}
     _write_replay_outputs(ns, results, metrics)
     return 0 if errors == 0 else 1
 

@@ -618,6 +618,14 @@ def maybe_chunk(ds, budget: Optional[int] = None,
     budget = host_budget() if budget is None else int(budget)
     if budget is None or dataset_nbytes(ds) <= budget:
         return ds
+    from ..obs import flight as obs_flight
+
+    # a spill activation is a capacity incident worth a postmortem trail:
+    # the flight recorder (when installed) keeps the exact trigger sizes
+    obs_flight.record_event("spill_activation",
+                            dataset_bytes=int(dataset_nbytes(ds)),
+                            host_budget=int(budget),
+                            chunk_rows=int(chunk_rows))
     return ChunkedDataset.from_dataset(ds, chunk_rows=chunk_rows,
                                        spill_dir=spill_dir)
 

@@ -20,6 +20,11 @@ per-subsystem ad-hoc dicts:
   drift firings, quarantines, injected faults) dumpable to JSON.
 - ``obs.profile`` — the ``TMOG_PROFILE`` jax.profiler hook around fused
   dispatch.
+- ``obs.reqtrace`` — request-scoped causal tracing (ISSUE 14): per-request
+  async tracks linked to their flushed batch, plus the always-on
+  per-tenant device-time cost accounting backbone.
+- ``obs.slo``     — SLO error-budget/burn-rate monitoring over the
+  registry's per-tenant counters (TM902/TM903, shed-tier escalation).
 
 :class:`Telemetry` bundles a tracer + flight recorder + output directory
 behind one switch: ``cli serve --telemetry DIR``,
@@ -35,7 +40,14 @@ import os
 import time
 from typing import Any, Mapping, Optional, Union
 
-from . import flight, metrics, profile, trace  # noqa: F401 — submodule API
+from . import (  # noqa: F401 — submodule API
+    flight,
+    metrics,
+    profile,
+    reqtrace,
+    slo,
+    trace,
+)
 from .flight import (  # noqa: F401
     FlightRecorder,
     active_recorder,
@@ -43,6 +55,8 @@ from .flight import (  # noqa: F401
     record_event,
 )
 from .metrics import CANONICAL_METRICS, MetricsRegistry  # noqa: F401
+from .reqtrace import reconstruct_request, request_events  # noqa: F401
+from .slo import DEFAULT_BUDGETS, SloBudget, SloMonitor  # noqa: F401
 from .trace import Tracer, active_tracer, instant, span  # noqa: F401
 
 #: env switch: a directory path enables telemetry for CLI/train entry points
@@ -163,8 +177,11 @@ def resolve_telemetry(arg: Union[None, str, Telemetry] = None
 
 __all__ = [
     "CANONICAL_METRICS",
+    "DEFAULT_BUDGETS",
     "FlightRecorder",
     "MetricsRegistry",
+    "SloBudget",
+    "SloMonitor",
     "TELEMETRY_ENV",
     "Telemetry",
     "Tracer",
@@ -175,8 +192,12 @@ __all__ = [
     "instant",
     "metrics",
     "profile",
+    "reconstruct_request",
     "record_event",
+    "reqtrace",
+    "request_events",
     "resolve_telemetry",
+    "slo",
     "span",
     "telemetry_active",
     "trace",

@@ -145,9 +145,17 @@ class FlightRecorder:
                                     "location": ctx["site"]})
             log.warning("TM901 %s", msg)
 
-    def on_fault_injected(self, point: str, error: str) -> None:
-        """Record an injected fault; auto-dump when a dump_dir is set."""
-        self.record("fault_injected", point=point, error=error)
+    def on_fault_injected(self, point: str, error: str,
+                          tenant: Optional[str] = None) -> None:
+        """Record an injected fault; auto-dump when a dump_dir is set.
+        ``tenant`` carries the fleet attribution of per-tenant fault
+        points (register/evict/route/shed — serve/faults.py) into the
+        event AND the auto-dumped snapshot, so a scripted fleet fault is
+        attributable to its tenant postmortem."""
+        data = {"point": point, "error": error}
+        if tenant is not None:
+            data["tenant"] = tenant
+        self.record("fault_injected", **data)
         if self.dump_dir is None:
             return
         with self._lock:
@@ -273,9 +281,11 @@ def record_event(kind: str, **data) -> None:
     rec.record(kind, **data)
 
 
-def record_fault(point: str, error: BaseException) -> None:
-    """Hook for the fault harness: record + (configured) auto-dump."""
+def record_fault(point: str, error: BaseException,
+                 tenant: Optional[str] = None) -> None:
+    """Hook for the fault harness: record + (configured) auto-dump, with
+    the firing fault point's tenant attribution when it has one."""
     rec = _RECORDER
     if rec is None:
         return
-    rec.on_fault_injected(point, type(error).__name__)
+    rec.on_fault_injected(point, type(error).__name__, tenant=tenant)

@@ -184,6 +184,12 @@ class Histogram:
         return out
 
 
+#: metric kind -> Prometheus exposition type (histograms export as
+#: summaries: quantile series + _count/_sum)
+_PROM_TYPE = {"counter": "counter", "gauge": "gauge",
+              "histogram": "summary"}
+
+
 def _label_key(labels: Optional[Mapping[str, str]]
                ) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
@@ -240,6 +246,14 @@ class MetricsRegistry:
         with self._lock:
             return [self._metrics[k] for k in sorted(self._metrics)]
 
+    def get(self, name: str,
+            labels: Optional[Mapping[str, str]] = None) -> Optional[Any]:
+        """The registered metric under (name, labels), or None — the
+        read-only accessor consumers like the SLO burn-rate monitor
+        (obs/slo.py) use without get-or-create side effects."""
+        with self._lock:
+            return self._metrics.get((name, _label_key(labels)))
+
     def labeled_values(self, label: str) -> List[str]:
         """Distinct values of ``label`` across registered metrics."""
         label = str(label)
@@ -275,21 +289,36 @@ class MetricsRegistry:
                 out[key] = m.value
         return out
 
-    def to_prometheus(self) -> str:
+    def to_prometheus(self, all_canonical: bool = False) -> str:
         """Prometheus text exposition format (0.0.4).  Histograms render as
-        summaries (quantile series + ``_count``/``_sum``)."""
+        summaries (quantile series + ``_count``/``_sum``).
+
+        ``all_canonical=True`` additionally emits ``# HELP``/``# TYPE``
+        header lines for every ``CANONICAL_METRICS`` entry not (yet)
+        registered — a scrape of a fresh server advertises the full metric
+        surface (zero-sample families are legal exposition), which the
+        conformance test in tests/test_obs_requests.py parses end to end.
+        """
         by_name: Dict[str, List[Any]] = {}
         for m in self.metrics():
             by_name.setdefault(m.name, []).append(m)
+        names = set(by_name)
+        if all_canonical:
+            names.update(CANONICAL_METRICS)
         lines: List[str] = []
-        for name in sorted(by_name):
-            group = by_name[name]
+        for name in sorted(names):
+            group = by_name.get(name, [])
+            if not group:
+                kind, _owner, _alias, help_text = CANONICAL_METRICS[name]
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} "
+                             f"{_PROM_TYPE[kind]}")
+                continue
             first = group[0]
-            if first.help:
-                lines.append(f"# HELP {name} {first.help}")
-            ptype = {"counter": "counter", "gauge": "gauge",
-                     "histogram": "summary"}[first.kind]
-            lines.append(f"# TYPE {name} {ptype}")
+            help_text = first.help or canonical_help(name)
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {_PROM_TYPE[first.kind]}")
             for m in group:
                 lab = _render_labels(m.labels)
                 if isinstance(m, Histogram):
@@ -352,7 +381,17 @@ CANONICAL_METRICS: Dict[str, Tuple[str, str, Optional[str], str]] = {
          "(exact counts)"),
     "tmog_serve_batcher_latency_seconds":
         ("histogram", "batcher", None, "enqueue-to-result latency "
-         "(legacy view: latency_p50_ms/p95/p99)"),
+         "(legacy view: latency_p50_ms/p95/p99; also exported per tenant)"),
+    "tmog_serve_batcher_device_seconds_total":
+        ("counter", "batcher", "device_seconds", "seconds of compiled "
+         "fused-prefix device dispatch spent by flushed batches; the "
+         "per-tenant labeled series amortize each shared batch's device "
+         "time across its constituent tenants (cost accounting, "
+         "obs/reqtrace.py)"),
+    "tmog_serve_batcher_padding_rows_total":
+        ("counter", "batcher", "padding_rows", "filler rows dispatched to "
+         "pad batches up to their power-of-two padding bucket (padding "
+         "waste)"),
     # -- ResilientScorer (serve/resilience.py) ------------------------------
     "tmog_serve_resilience_quarantined_total":
         ("counter", "resilience", "quarantined", "poison records isolated"),

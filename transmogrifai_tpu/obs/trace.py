@@ -39,6 +39,11 @@ from typing import Any, Dict, List, Optional
 #: grow memory; the newest events win (the tail of an incident matters most)
 _DEFAULT_CAPACITY = 262_144
 
+#: Chrome-trace async category of per-request events (obs/reqtrace.py):
+#: the ``(cat, id)`` pair groups one request's begin/end into one async
+#: track, linked to its batch via the end event's ``batch_seq`` arg
+REQUEST_CAT = "serve.request"
+
 #: per-context stack of open span names (parent attribution)
 _SPAN_STACK: "contextvars.ContextVar[tuple]" = contextvars.ContextVar(
     "transmogrifai_tpu_obs_span_stack", default=())
@@ -72,6 +77,11 @@ class Tracer:
         self._added = 0
         #: perf_counter origin: every ts is microseconds since tracer start
         self._t0 = time.perf_counter()
+        #: monotonic twin of the origin, captured back-to-back: request
+        #: records reuse the batcher's existing time.monotonic() stamps
+        #: (zero extra clock reads on the submit hot path) and export
+        #: converts through this origin onto the same timeline
+        self._t0_mono = time.monotonic()
         self._pid = os.getpid()
 
     @property
@@ -97,6 +107,44 @@ class Tracer:
         self._events.append(("i", name, cat, time.perf_counter(), 0.0, tid,
                              args))
 
+    def add_request(self, rid: int, t_enqueue_mono: float, outcome: str,
+                    tenant: Optional[str], slo: Optional[str],
+                    batch_seq: Optional[int] = None) -> None:
+        """One request's whole async track as ONE ring slot (the off-batch
+        resolution paths: shed, deadline-expired, cancelled, rejected).
+
+        The Chrome-trace ``b``/``e`` async event pair (track keyed by
+        ``(cat, id)``) materializes at export with the begin event
+        back-dated to the enqueue timestamp, so a request can never leave
+        an orphaned begin event.  Timestamps are ``time.monotonic()``
+        values (the batcher's existing stamps) converted onto the
+        perf_counter timeline through the paired origins.
+        """
+        tid = threading.get_ident()
+        if tid not in self._tids:
+            self._tids[tid] = threading.current_thread().name
+        self._added = next(self._counter)
+        self._events.append(("R", rid, t_enqueue_mono, time.monotonic(),
+                             outcome, tenant, slo, batch_seq, tid))
+
+    def add_request_batch(self, batch_seq: int, t_claim_mono: float,
+                          rows: List[tuple]) -> None:
+        """Every request track of one flushed batch as ONE ring slot.
+
+        ``rows`` is ``[(rid, t_enqueue_mono, tenant, slo, outcome), ...]``.
+        This is THE per-request hot path (it runs once per flushed batch
+        inside the serve loop the bench ``obs`` <5% requests-detail gate
+        polices), so the per-request cost is one small tuple append — all
+        dict building, b/e pairing, and queue/total timing math happen at
+        export time.
+        """
+        tid = threading.get_ident()
+        if tid not in self._tids:
+            self._tids[tid] = threading.current_thread().name
+        self._added = next(self._counter)
+        self._events.append(("RB", batch_seq, t_claim_mono,
+                             time.monotonic(), tid, rows))
+
     # -- export --------------------------------------------------------------
     def chrome_trace(self) -> Dict[str, Any]:
         """The Chrome trace-event JSON object (Perfetto-loadable)."""
@@ -118,8 +166,53 @@ class Tracer:
                 for tid, name in sorted(tids.items())]
         meta.append({"name": "process_name", "ph": "M", "pid": pid,
                      "tid": 0, "args": {"name": "transmogrifai_tpu"}})
+        t0_mono = self._t0_mono
+
+        def emit_request(events, rid, t_enq, t_end, outcome, tenant, slo,
+                         batch_seq, queue_ms, tid):
+            # one request record -> the async b/e pair on track
+            # (REQUEST_CAT, id); deferred from the hot path (add_request*)
+            begin_args: Dict[str, Any] = {}
+            end_args: Dict[str, Any] = {
+                "outcome": outcome,
+                "total_ms": round(max(t_end - t_enq, 0.0) * 1e3, 3)}
+            if tenant is not None:
+                begin_args["tenant"] = tenant
+                end_args["tenant"] = tenant
+            if slo is not None:
+                begin_args["slo"] = slo
+                end_args["slo"] = slo
+            if batch_seq is not None:
+                end_args["batch_seq"] = batch_seq
+            if queue_ms is not None:
+                end_args["queue_ms"] = round(max(queue_ms, 0.0), 3)
+            common = {"name": "request", "cat": REQUEST_CAT, "id": rid,
+                      "pid": pid, "tid": tid}
+            events.append({**common, "ph": "b",
+                           "ts": round((t_enq - t0_mono) * 1e6, 1),
+                           "args": begin_args})
+            events.append({**common, "ph": "e",
+                           "ts": round((max(t_end, t_enq) - t0_mono) * 1e6,
+                                       1),
+                           "args": end_args})
+
         events: List[dict] = []
-        for ph, name, cat, t, dur_s, tid, args in raw:
+        for row in raw:
+            kind = row[0]
+            if kind == "R":
+                (_ph, rid, t_enq, t_end, outcome, tenant, slo,
+                 batch_seq, tid) = row
+                emit_request(events, rid, t_enq, t_end, outcome, tenant,
+                             slo, batch_seq, None, tid)
+                continue
+            if kind == "RB":
+                _ph, batch_seq, t_claim, t_end, tid, rows = row
+                for rid, t_enq, tenant, slo, outcome in rows:
+                    emit_request(events, rid, t_enq, t_end, outcome,
+                                 tenant, slo, batch_seq,
+                                 (t_claim - t_enq) * 1e3, tid)
+                continue
+            ph, name, cat, t, dur_s, tid, args = row
             ev = {"name": name, "cat": cat, "ph": ph,
                   "ts": round((t - t0) * 1e6, 1), "pid": pid, "tid": tid,
                   "args": args or {}}

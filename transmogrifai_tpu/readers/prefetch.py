@@ -25,7 +25,14 @@ import threading
 import time
 from typing import Any, Callable, Iterator, Optional
 
+from ..obs import flight as obs_flight
+
 _SENTINEL = object()
+
+#: a consumer wait on an EMPTY buffer longer than this records a
+#: ``prefetch_stall`` flight event (sub-ms waits are queue-handoff noise,
+#: not pipeline starvation)
+_STALL_THRESHOLD_S = 0.001
 
 
 def prefetch_depth() -> int:
@@ -45,6 +52,7 @@ class PrefetchStats:
         self.chunks = 0
         self.load_seconds = 0.0
         self.wait_seconds = 0.0
+        self.stalls = 0
 
     @property
     def overlap_fraction(self) -> float:
@@ -59,6 +67,7 @@ class PrefetchStats:
         return {"chunks": self.chunks,
                 "load_seconds": round(self.load_seconds, 4),
                 "wait_seconds": round(self.wait_seconds, 4),
+                "stalls": self.stalls,
                 "overlap_fraction": round(self.overlap_fraction, 4)}
 
 
@@ -116,9 +125,20 @@ class ChunkPrefetcher:
     def __next__(self):
         if self._stop.is_set():
             raise StopIteration
+        empty = self._q.empty()
         t0 = time.perf_counter()
         ci, item, err = self._q.get()
-        self.stats.wait_seconds += time.perf_counter() - t0
+        wait = time.perf_counter() - t0
+        self.stats.wait_seconds += wait
+        if empty and wait > _STALL_THRESHOLD_S and err is None \
+                and item is not _SENTINEL:
+            # the device-dispatch side outran the ingest side: record the
+            # starvation (the bench ingest overlap gate's runtime twin).
+            # Error/end-of-stream rows are excluded — a wait for the
+            # sentinel is not a stall on any real chunk.
+            self.stats.stalls += 1
+            obs_flight.record_event("prefetch_stall", chunk=int(ci),
+                                    wait_s=round(wait, 4))
         if err is not None:
             self.close()
             raise err

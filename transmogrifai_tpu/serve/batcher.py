@@ -49,8 +49,10 @@ Observability: every counter lives in an :class:`~..obs.metrics
 VIEW over the registry (deprecated aliases), so the benchmark/CLI surface
 is unchanged while Prometheus exposition and JSONL snapshots come for
 free.  When an ``obs`` tracer is installed, each flushed batch emits a
-``serve.flush`` span (and, at ``detail="requests"``, each submit an
-``serve.enqueue`` instant) into the Chrome-trace timeline.
+``serve.flush`` span (tagged with the ``batch_seq`` join key) into the
+Chrome-trace timeline; at ``detail="requests"`` every request additionally
+exports its own async track — submit → queue → flush → response with the
+outcome — linked to its batch via ``batch_seq`` (obs/reqtrace.py).
 """
 
 from __future__ import annotations
@@ -62,6 +64,7 @@ from concurrent.futures import Future
 from typing import (Any, Callable, Dict, List, Mapping, NamedTuple, Optional,
                     Sequence, Tuple, Union)
 
+from ..obs import reqtrace
 from ..obs import trace as obs_trace
 from ..obs.metrics import MetricsRegistry
 from .faults import DeadlineExceededError, LoadShedError, fault_point
@@ -101,7 +104,7 @@ _DEGRADED_TIER_PENALTY = 1_000_000
 
 class _Request:
     __slots__ = ("record", "future", "t_enqueue", "deadline", "tenant",
-                 "tier", "slo")
+                 "tier", "slo", "ctx")
 
     def __init__(self, record: Mapping[str, Any],
                  deadline_ms: Optional[float] = None,
@@ -115,6 +118,10 @@ class _Request:
         self.tenant = tenant
         self.tier = tier
         self.slo = slo
+        #: request trace id (obs/reqtrace.py mint_request) — None unless a
+        #: tracer with detail="requests" is installed at submit time;
+        #: cleared when the request's track is emitted
+        self.ctx = None
 
 
 class MicroBatcher:
@@ -173,6 +180,8 @@ class MicroBatcher:
         self._c_deadline = _c("tmog_serve_batcher_deadline_expired_total")
         self._c_shed = _c("tmog_serve_batcher_shed_total")
         self._c_batches = _c("tmog_serve_batcher_batches_total")
+        self._c_device_seconds = _c("tmog_serve_batcher_device_seconds_total")
+        self._c_padding = _c("tmog_serve_batcher_padding_rows_total")
         self._g_depth = self.registry.gauge(
             "tmog_serve_batcher_queue_depth",
             _h("tmog_serve_batcher_queue_depth"))
@@ -218,6 +227,10 @@ class MicroBatcher:
         req = _Request(record, deadline_ms, tenant=tenant,
                        tier=slo_cls.tier if slo_cls is not None else 0,
                        slo=slo_cls.name if slo_cls is not None else None)
+        # minted BEFORE the request enters the queue: the flusher may claim
+        # it the instant the lock releases, and an id attached late would
+        # miss its own flush (obs/reqtrace.py; None at batch detail)
+        req.ctx = reqtrace.mint_request()
         expired: List[_Request] = []
         shed: List[_Request] = []
         try:
@@ -248,18 +261,19 @@ class MicroBatcher:
                 # phantom nonzero depth on an idle queue
                 self._g_depth.set(depth)
                 self._wake.notify_all()
-            tracer = obs_trace.active_tracer()
-            if tracer is not None and tracer.detail == "requests":
-                tracer.add_instant("serve.enqueue", "serve",
-                                   {"queue_depth": depth})
+        except BaseException as e:
+            reqtrace.finish_request(req, f"rejected:{type(e).__name__}")
+            raise
         finally:
             # resolve evicted futures OUTSIDE the lock: set_exception runs
             # client done-callbacks synchronously, and a callback touching
             # the batcher would deadlock on the non-reentrant lock
             for r in expired:
+                reqtrace.finish_request(r, "deadline_expired")
                 r.future.set_exception(DeadlineExceededError(
                     "request deadline expired while queued"))
             for r in shed:
+                reqtrace.finish_request(r, "shed")
                 r.future.set_exception(LoadShedError(
                     f"request shed at tier {r.tier} to admit higher-tier "
                     "traffic under backpressure",
@@ -286,9 +300,14 @@ class MicroBatcher:
             if r.deadline is not None and r.deadline <= now:
                 if r.future.set_running_or_notify_cancel():
                     self._c_deadline.inc()
+                    if r.tenant is not None:
+                        self._tenant_counter(
+                            "tmog_serve_batcher_deadline_expired_total",
+                            r.tenant).inc()
                     expired.append(r)
                 else:
                     self._c_cancelled.inc()
+                    reqtrace.finish_request(r, "cancelled")
             else:
                 keep.append(r)
         self._pending = keep
@@ -325,6 +344,7 @@ class MicroBatcher:
                 shed.append(victim)
             else:
                 self._c_cancelled.inc()
+                reqtrace.finish_request(victim, "cancelled")
         return expired, shed
 
     # -- per-tenant state (the fleet registry drives these) ------------------
@@ -368,15 +388,23 @@ class MicroBatcher:
                                    tenant)
 
     def tenant_metrics(self) -> Dict[str, Dict[str, Any]]:
-        """{tenant: {shed, latency_p50_ms/p95/p99}} over the per-tenant
+        """{tenant: {shed, completed, failed, deadline_expired,
+        device_seconds, latency_p50_ms/p95/p99}} over the per-tenant
         labeled series this batcher has created."""
         with self._tenant_metrics_lock:
             items = dict(self._tenant_metrics)
+        counters = {"tmog_serve_batcher_shed_total": "shed",
+                    "tmog_serve_batcher_completed_total": "completed",
+                    "tmog_serve_batcher_failed_total": "failed",
+                    "tmog_serve_batcher_deadline_expired_total":
+                        "deadline_expired"}
         out: Dict[str, Dict[str, Any]] = {}
         for (name, tenant), m in sorted(items.items()):
             row = out.setdefault(tenant, {})
-            if name == "tmog_serve_batcher_shed_total":
-                row["shed"] = m.value
+            if name in counters:
+                row[counters[name]] = m.value
+            elif name == "tmog_serve_batcher_device_seconds_total":
+                row["device_seconds"] = m.value
             elif name == "tmog_serve_batcher_latency_seconds":
                 for q, key in ((0.50, "latency_p50_ms"),
                                (0.95, "latency_p95_ms"),
@@ -410,6 +438,7 @@ class MicroBatcher:
                     self._c_cancelled.inc()
             self._wake.notify_all()
         for req in evicted:  # outside the lock: done-callbacks may re-enter
+            reqtrace.finish_request(req, "closed")
             req.future.set_exception(BatcherClosedError(
                 "batcher shut down before flush"))
         self._thread.join(timeout)
@@ -438,6 +467,11 @@ class MicroBatcher:
             "deadline_expired": self._c_deadline.value,
             "shed": self._c_shed.value,
             "batches": self._c_batches.value,
+            # unrounded: per-tenant amortized shares must sum EXACTLY to
+            # this total (the cost-accounting invariant the tests pin) —
+            # rounding belongs to display surfaces like `cli top`
+            "device_seconds": self._c_device_seconds.value,
+            "padding_rows": self._c_padding.value,
         }
         with self._lock:
             out["queue_depth"] = len(self._pending)
@@ -489,9 +523,15 @@ class MicroBatcher:
         for r in batch:
             if not r.future.set_running_or_notify_cancel():
                 cancelled += 1
+                reqtrace.finish_request(r, "cancelled")
                 continue
             if r.deadline is not None and r.deadline <= now:
                 expired += 1
+                if r.tenant is not None:
+                    self._tenant_counter(
+                        "tmog_serve_batcher_deadline_expired_total",
+                        r.tenant).inc()
+                reqtrace.finish_request(r, "deadline_expired")
                 r.future.set_exception(DeadlineExceededError(
                     "request deadline expired before flush"))
                 continue
@@ -510,45 +550,119 @@ class MicroBatcher:
             batch = self._claim(batch)
             if not batch:
                 continue
-            # serve.flush: the whole batch lifecycle on this worker thread —
-            # the encode/device/host spans from plan.score nest inside it
-            with obs_trace.span("serve.flush", cat="serve",
-                                batch=len(batch)):
-                try:
-                    if self._fleet:
-                        results = self._score.score_isolated_tenants(
-                            [r.record for r in batch],
-                            [r.tenant for r in batch])
-                    elif self._isolated:
-                        results = self._score.score_isolated(
-                            [r.record for r in batch])
-                    else:
-                        results = self._score([r.record for r in batch])
-                    if len(results) != len(batch):
-                        raise RuntimeError(
-                            f"score_batch returned {len(results)} results "
-                            f"for {len(batch)} records")
-                except Exception as e:  # noqa: BLE001 - failures to futures
-                    self._c_failed.inc(len(batch))
-                    self._c_batches.inc()
-                    self._h_batch_size.observe(len(batch))
-                    for r in batch:
-                        r.future.set_exception(e)
-                    continue
-                now = time.monotonic()
-                ok = [not isinstance(res, Exception) for res in results]
-                self._c_completed.inc(sum(ok))
-                self._c_failed.inc(len(batch) - sum(ok))
+            # the batch trace is ALWAYS minted (a slotted object + a few
+            # phase marks): the per-tenant device-time cost counters must
+            # accumulate with telemetry fully off (obs/reqtrace.py)
+            bt, token = reqtrace.begin_batch(len(batch))
+            try:
+                self._flush(batch, bt)
+            finally:
+                reqtrace.end_batch(token)
+                self._account_batch(bt, batch)
+
+    def _flush(self, batch: List[_Request], bt) -> None:
+        t_claim = time.monotonic()
+        # serve.flush: the whole batch lifecycle on this worker thread —
+        # the encode/device/host spans from plan.score nest inside it, and
+        # batch_seq is the join key per-request async events link through
+        with obs_trace.span("serve.flush", cat="serve",
+                            batch=len(batch), batch_seq=bt.seq):
+            try:
+                if self._fleet:
+                    results = self._score.score_isolated_tenants(
+                        [r.record for r in batch],
+                        [r.tenant for r in batch])
+                elif self._isolated:
+                    results = self._score.score_isolated(
+                        [r.record for r in batch])
+                else:
+                    results = self._score([r.record for r in batch])
+                if len(results) != len(batch):
+                    raise RuntimeError(
+                        f"score_batch returned {len(results)} results "
+                        f"for {len(batch)} records")
+            except Exception as e:  # noqa: BLE001 - failures to futures
+                self._c_failed.inc(len(batch))
                 self._c_batches.inc()
                 self._h_batch_size.observe(len(batch))
-                for r, good in zip(batch, ok):
-                    if good:
-                        lat = now - r.t_enqueue
-                        self._h_latency.observe(lat)
-                        if r.tenant is not None:
-                            self._tenant_latency(r.tenant).observe(lat)
-                for r, res, good in zip(batch, results, ok):
-                    if good:
-                        r.future.set_result(res)
-                    else:
-                        r.future.set_exception(res)
+                # per-tenant failed series too: the SLO burn-rate monitor
+                # reads only labeled counters, and a batch-level scorer
+                # failure is exactly the incident it must not be blind to
+                tenant_failed: Dict[str, int] = {}
+                for r in batch:
+                    if r.tenant is not None:
+                        tenant_failed[r.tenant] = \
+                            tenant_failed.get(r.tenant, 0) + 1
+                for tenant, n in tenant_failed.items():
+                    self._tenant_counter(
+                        "tmog_serve_batcher_failed_total", tenant).inc(n)
+                err = f"error:{type(e).__name__}"
+                for r in batch:
+                    r.future.set_exception(e)
+                self._emit_request_tracks(
+                    bt, t_claim,
+                    [(r, err) for r in batch if r.ctx is not None])
+                return
+            now = time.monotonic()
+            ok = [not isinstance(res, Exception) for res in results]
+            self._c_completed.inc(sum(ok))
+            self._c_failed.inc(len(batch) - sum(ok))
+            self._c_batches.inc()
+            self._h_batch_size.observe(len(batch))
+            tenant_outcomes: Dict[Tuple[str, bool], int] = {}
+            for r, good in zip(batch, ok):
+                if r.tenant is not None:
+                    key = (r.tenant, good)
+                    tenant_outcomes[key] = tenant_outcomes.get(key, 0) + 1
+                if good:
+                    lat = now - r.t_enqueue
+                    self._h_latency.observe(lat)
+                    if r.tenant is not None:
+                        self._tenant_latency(r.tenant).observe(lat)
+            for (tenant, good), n in tenant_outcomes.items():
+                name = "tmog_serve_batcher_completed_total" if good \
+                    else "tmog_serve_batcher_failed_total"
+                self._tenant_counter(name, tenant).inc(n)
+            tracked = []
+            for r, res, good in zip(batch, results, ok):
+                if good:
+                    r.future.set_result(res)
+                else:
+                    r.future.set_exception(res)
+                if r.ctx is not None:
+                    tracked.append(
+                        (r, "ok" if good
+                         else f"error:{type(res).__name__}"))
+            self._emit_request_tracks(bt, t_claim, tracked)
+
+    def _emit_request_tracks(self, bt, t_claim: float, tracked) -> None:
+        """Export the flushed batch's request tracks as ONE ring slot
+        (obs/reqtrace.py): ``tracked`` is [(request, outcome), ...] for the
+        requests that were minted ids at submit.  Per-request cost is one
+        small tuple — this sits inside the <5% requests-detail gate."""
+        if not tracked:
+            return
+        tracer = obs_trace.active_tracer()
+        if tracer is None:
+            return
+        rows = []
+        for r, outcome in tracked:
+            rows.append((r.ctx, r.t_enqueue, r.tenant, r.slo, outcome))
+            r.ctx = None
+        tracer.add_request_batch(bt.seq, t_claim, rows)
+
+    def _account_batch(self, bt, batch: List[_Request]) -> None:
+        """Per-tenant device-time cost accounting: amortize the flushed
+        batch's device phase marks across its constituent tenants (exact
+        for the fleet's per-tenant sub-batch dispatches; record-share for
+        untagged time) — the per-tenant totals sum to the batch total."""
+        device_s, per_tenant, padded = reqtrace.batch_device_cost(
+            bt, [r.tenant for r in batch])
+        if padded:
+            self._c_padding.inc(padded)
+        if device_s <= 0.0:
+            return
+        self._c_device_seconds.inc(device_s)
+        for tenant, secs in per_tenant.items():
+            self._tenant_counter("tmog_serve_batcher_device_seconds_total",
+                                 tenant).inc(secs)

@@ -242,5 +242,11 @@ def fault_point(point: str, **ctx) -> None:
     if err is not None:
         from ..obs import flight as obs_flight
 
-        obs_flight.record_fault(point, err)
+        # the per-tenant fault points (register/evict/route/shed, and any
+        # serve-level point the fleet fires with a tenant in its context)
+        # carry the tenant into the flight event + auto-dumped snapshot
+        tenant = ctx.get("tenant")
+        obs_flight.record_fault(point, err,
+                                tenant=str(tenant)
+                                if tenant is not None else None)
         raise err

@@ -29,6 +29,7 @@ slices them off before anything reads the result.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
@@ -36,6 +37,7 @@ import numpy as np
 from ..checkers.diagnostics import OpCheckError
 from ..data.dataset import Column, Dataset
 from ..obs import flight as obs_flight
+from ..obs import reqtrace
 from ..obs import trace as obs_trace
 from ..obs.profile import maybe_profile
 from ..features.feature import Feature, _NamedExtract
@@ -465,7 +467,18 @@ class CompiledScoringPlan:
 
         from ..readers.base import extract_columns
 
-        with obs_trace.span("serve.encode", cat="serve", records=n):
+        # request-scoped attribution (obs/reqtrace.py): phase marks feed
+        # the per-tenant device-time cost counters, and the tenant arg on
+        # the phase spans lets one trace.json attribute a fleet flush's
+        # sub-batch dispatches to their tenants.  One contextvar read each
+        # when no batch trace / tenant scope is active.
+        bt = reqtrace.active_batch()
+        tenant = reqtrace.current_tenant()
+        t_attr = {} if tenant is None else {"tenant": tenant}
+
+        t0 = time.perf_counter() if bt is not None else 0.0
+        with obs_trace.span("serve.encode", cat="serve", records=n,
+                            **t_attr):
             fault_point("encode", records=records)
             host_cols = extract_columns(records, self._host_raw,
                                         allow_missing_response=True)
@@ -488,18 +501,28 @@ class CompiledScoringPlan:
                                 f"{runner.uid} but absent from the records")
                         entries.append(np.asarray(
                             runner.encode_device_input(slot, col)))
+        if bt is not None:
+            reqtrace.mark_phase("encode", t0, time.perf_counter() - t0,
+                                records=n)
         if self._prefix:
             bucket = _bucket_for(n, self.min_bucket, self.max_bucket)
             compiled = self._ensure_compiled(bucket)
+            t0 = time.perf_counter() if bt is not None else 0.0
             with obs_trace.span("serve.device", cat="serve", records=n,
-                                bucket=bucket):
+                                bucket=bucket, padded=bucket - n, **t_attr):
                 fault_point("device", records=records, bucket=bucket)
                 with maybe_profile("serve"):  # TMOG_PROFILE dispatch hook
                     outs = compiled(*[_pad_rows(a, bucket) for a in entries])
+            if bt is not None:
+                reqtrace.mark_phase("device", t0,
+                                    time.perf_counter() - t0,
+                                    records=n, bucket=bucket,
+                                    padded=bucket - n)
             for f, dev in zip(self._out_features, outs):
                 cols[f.name] = self._materialize(f, np.asarray(dev)[:n])
 
-        with obs_trace.span("serve.host", cat="serve", records=n):
+        t0 = time.perf_counter() if bt is not None else 0.0
+        with obs_trace.span("serve.host", cat="serve", records=n, **t_attr):
             fault_point("host", records=records)
             # per-stage phase spans only at the heavy "requests" detail:
             # serve.host already times the whole remainder, and the default
@@ -509,6 +532,9 @@ class CompiledScoringPlan:
                 Dataset(cols), self._remainder,
                 phases=tracer is None or tracer.detail == "requests")
             out = self._rows_from(ds, n)
+        if bt is not None:
+            reqtrace.mark_phase("host", t0, time.perf_counter() - t0,
+                                records=n)
         with self._lock:
             self._counters["scored_records"] += n
             self._counters["scored_batches"] += 1
@@ -622,7 +648,12 @@ class CompiledScoringPlan:
         ds = Dataset(extract_columns(
             records, [(g.raw_name, g) for g in self._generators],
             allow_missing_response=True))
-        with obs_trace.span("serve.host_fallback", cat="serve", records=n):
+        bt = reqtrace.active_batch()
+        tenant = reqtrace.current_tenant()
+        t_attr = {} if tenant is None else {"tenant": tenant}
+        t0 = time.perf_counter() if bt is not None else 0.0
+        with obs_trace.span("serve.host_fallback", cat="serve", records=n,
+                            **t_attr):
             # same per-stage-span gating as score(): at the default batch
             # detail the degraded path must not flood the tracer with one
             # span per interpreted stage per batch mid-incident
@@ -630,6 +661,9 @@ class CompiledScoringPlan:
             ds = run_host_stages(
                 ds, self._runners,
                 phases=tracer is None or tracer.detail == "requests")
+        if bt is not None:
+            reqtrace.mark_phase("host_fallback", t0,
+                                time.perf_counter() - t0, records=n)
         out = self._rows_from(ds, n)
         with self._lock:
             self._counters["host_scored_records"] = \

@@ -54,6 +54,7 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Union
 
 from ..checkers.diagnostics import OpCheckError
 from ..obs import flight as obs_flight
+from ..obs import reqtrace
 from ..obs.metrics import MetricsRegistry, canonical_help
 from .batcher import DEFAULT_SLO_CLASSES, MicroBatcher, SloClass
 from .faults import fault_point
@@ -485,6 +486,10 @@ class FleetServer:
                                     max_queue=max_queue,
                                     registry=self.registry,
                                     slo_classes=self.models.slo_classes)
+        #: armed by :meth:`arm_slo_monitor`; polled by statusz()/`cli top`
+        self.slo_monitor = None
+        #: {tenant: (monotonic ts, completed)} — the statusz() rps baseline
+        self._statusz_prev: Dict[str, Any] = {}
 
     # -- tenant lifecycle (delegates to the control plane) -------------------
     def register(self, tenant: str, model, slo: str = "bronze",
@@ -559,7 +564,11 @@ class FleetServer:
                         "fleet submit requires a tenant id")
                 state = self.models.get(tenant)
                 fault_point("route", tenant=tenant, records=len(sub))
-                results = state.swapper.score_isolated(sub)
+                # tenant scope: the sub-batch's phase marks and serve spans
+                # carry this tenant, so a shared flush's device time bills
+                # each tenant exactly (obs/reqtrace.py cost accounting)
+                with reqtrace.tenant_scope(tenant):
+                    results = state.swapper.score_isolated(sub)
             except Exception as e:  # noqa: BLE001 — outcome-shaped per tenant
                 results = [e] * len(sub)
                 state = None
@@ -598,8 +607,108 @@ class FleetServer:
 
     def prometheus(self) -> str:
         """Prometheus text exposition of the fleet's shared registry —
-        every series labeled by tenant (docs/observability.md)."""
-        return self.registry.to_prometheus()
+        every series labeled by tenant, with HELP/TYPE headers for the
+        whole canonical name table (docs/observability.md)."""
+        return self.registry.to_prometheus(all_canonical=True)
 
     def metrics_snapshot(self) -> Dict[str, Any]:
         return self.registry.snapshot()
+
+    def arm_slo_monitor(self, budgets=None, escalate: bool = True,
+                        **kw):
+        """Attach an :class:`~..obs.slo.SloMonitor` over the fleet's live
+        tenant table and shared registry.  ``escalate=True`` wires budget
+        exhaustion to :meth:`MicroBatcher.set_degraded` — the exhausted
+        tenant joins the degraded set and absorbs the shedding cuts, so
+        tenants still inside budget keep their p99 (the PR 12 shed-tier
+        escalation).  Pull-based: ``poll()`` runs from :meth:`statusz`,
+        the ``cli top`` refresh loop, or the caller's own cadence.
+        Re-arming first disarms the previous monitor, so tenants it
+        degraded are released instead of orphaned in the degraded set."""
+        from ..obs.slo import SloMonitor
+
+        if self.slo_monitor is not None:
+            self.slo_monitor.disarm()
+
+        def live_tenants() -> Dict[str, str]:
+            out: Dict[str, str] = {}
+            for t in self.models.tenants():
+                try:
+                    out[t] = self.models.get(t).slo
+                except UnknownTenantError:  # raced an unregister
+                    continue
+            return out
+
+        self.slo_monitor = SloMonitor(
+            self.registry, live_tenants, budgets=budgets,
+            escalate=self.batcher.set_degraded if escalate else None, **kw)
+        return self.slo_monitor
+
+    def statusz(self) -> Dict[str, Any]:
+        """One JSON-able fleet status snapshot — the ``statusz`` endpoint
+        and the ``cli top`` console's data source.
+
+        Per tenant: request rate since the previous ``statusz()`` call,
+        p99 latency, shed/deadline/failure counts, amortized device-time
+        seconds, breaker state, warm buckets, and (when
+        :meth:`arm_slo_monitor` was called) the SLO budget/burn status —
+        polling the monitor as a side effect, so a ``cli top`` refresh
+        loop drives burn-rate evaluation for free."""
+        now = time.monotonic()
+        slo_status = self.slo_monitor.poll() \
+            if self.slo_monitor is not None else {}
+        per_tenant = self.batcher.tenant_metrics()
+        batcher = self.batcher.metrics()  # one snapshot, read twice below
+        prev = self._statusz_prev
+        nxt: Dict[str, Any] = {}
+        tenants: Dict[str, Any] = {}
+        for t in self.models.tenants():
+            try:
+                state = self.models.get(t)
+            except UnknownTenantError:
+                continue
+            bt = per_tenant.get(t, {})
+            completed = bt.get("completed", 0)
+            last = prev.get(t)
+            dt = (now - last[0]) if last is not None else None
+            rps = round((completed - last[1]) / dt, 1) \
+                if last is not None and dt and dt > 0 else None
+            nxt[t] = (now, completed)
+            active = state.swapper.active
+            breaker = state.breaker()
+            row: Dict[str, Any] = {
+                "slo": state.slo,
+                "rps": rps,
+                "completed": completed,
+                "failed": bt.get("failed", 0),
+                "shed": bt.get("shed", 0),
+                "deadline_expired": bt.get("deadline_expired", 0),
+                "device_seconds": bt.get("device_seconds", 0.0),
+                "p99_ms": bt.get("latency_p99_ms"),
+                "breaker": breaker.state if breaker is not None else None,
+                "warm_buckets": len(active.plan.warm_buckets()),
+                "fingerprint": active.fingerprint[:16],
+            }
+            if t in slo_status:
+                s = slo_status[t]
+                row.update({"budget_remaining": s["budget_remaining"],
+                            "burn_fast": s["burn_fast"],
+                            "burn_slow": s["burn_slow"],
+                            "slo_firing": s["firing"],
+                            "escalated": s["escalated"]})
+            tenants[t] = row
+        self._statusz_prev = nxt
+        return {
+            "ts": round(time.time(), 3),
+            "tenants": tenants,
+            "fleet": {
+                "tenants": len(tenants),
+                "queue_depth": self.batcher.queue_depth,
+                "resident_hbm_bytes": self.models.resident_hbm_bytes(),
+                "hbm_budget": self.models.hbm_budget,
+                "evictions": self.models._c_evictions.value,
+                "shed": batcher["shed"],
+                "device_seconds": batcher["device_seconds"],
+                "slo_monitor_armed": self.slo_monitor is not None,
+            },
+        }

@@ -38,6 +38,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
 
 from ..obs import flight as obs_flight
+from ..obs import reqtrace
 from ..obs.metrics import MetricsRegistry, canonical_help
 from .faults import CircuitOpenError, PoisonRecordError, is_retryable
 
@@ -286,6 +287,11 @@ class ResilientScorer:
                     self._sleep(delay * (0.5 + 0.5 * self._rng.random()))
                     attempt += 1
                     self._c["retries"].inc()
+                    # the retry lands in the request causal chain: the
+                    # batch's requests see retry_ms > 0 in their trace
+                    reqtrace.mark_phase("retry", time.perf_counter(), 0.0,
+                                        attempt=attempt,
+                                        cause=type(e).__name__)
                     continue
                 if len(records) > 1 and depth < _MAX_SPLIT_DEPTH:
                     # batch-shaped failure (resource exhaustion scales with
@@ -306,6 +312,8 @@ class ResilientScorer:
         if len(records) == 1:
             return [self._quarantine(records[0], exc)]
         self._c["bisect_batches"].inc()
+        reqtrace.mark_phase("bisect", time.perf_counter(), 0.0,
+                            records=len(records))
         mid = len(records) // 2
         out: List[Any] = []
         for half in (records[:mid], records[mid:]):

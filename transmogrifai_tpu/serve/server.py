@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Union
 
@@ -283,10 +284,32 @@ class ScoringServer:
 
     def prometheus(self) -> str:
         """Prometheus text exposition of the server's metrics registry
-        (canonical ``tmog_*`` names — docs/observability.md)."""
-        return self.registry.to_prometheus()
+        (canonical ``tmog_*`` names, with HELP/TYPE headers for the whole
+        canonical table — docs/observability.md)."""
+        return self.registry.to_prometheus(all_canonical=True)
 
     def metrics_snapshot(self) -> Dict[str, Any]:
         """Stable-key-ordered JSON-able snapshot of the registry (the
         ``cli serve`` periodic JSONL line)."""
         return self.registry.snapshot()
+
+    def statusz(self) -> Dict[str, Any]:
+        """One JSON-able status line for the single-model server — the
+        single-tenant sibling of :meth:`~.registry.FleetServer.statusz`."""
+        bat = self.batcher.metrics()
+        res = self.resilience
+        breaker = res.breaker.state if res is not None else None
+        return {
+            "ts": round(time.time(), 3),
+            "fingerprint": self.plan.fingerprint[:16],
+            "queue_depth": bat["queue_depth"],
+            "completed": bat["completed"],
+            "failed": bat["failed"],
+            "deadline_expired": bat["deadline_expired"],
+            "p99_ms": bat["latency_p99_ms"],
+            "device_seconds": bat["device_seconds"],
+            "padding_rows": bat["padding_rows"],
+            "breaker": breaker,
+            "warm_buckets": len(self.plan.warm_buckets()),
+            "candidate_staged": self.has_candidate(),
+        }

@@ -755,8 +755,16 @@ class ContinualTrainer:
                  drift_params: Optional[Mapping[str, Any]] = None,
                  on_batch: Optional[Callable] = None,
                  refit_enabled: bool = True,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 slo_monitor=None):
         self._server = server
+        #: optional SLO burn-rate monitor (obs/slo.py): the control loop
+        #: polls it after every streamed batch, so a long-running follow
+        #: process evaluates error-budget burn at stream cadence without a
+        #: background thread; its TM902/TM903 findings join the trainer's
+        #: bounded diagnostics log
+        self._slo_monitor = slo_monitor
+        self._slo_diags_seen = 0
         self.refit_enabled = bool(refit_enabled)
         self._model = model
         self._reader = reader
@@ -842,6 +850,7 @@ class ContinualTrainer:
             self._c["records"].inc(len(records))
             self._ingest(ds, records)
             self._tick()
+            self._poll_slo()
             if max_batches is not None \
                     and self._c["batches"].value \
                     - self._c_base["batches"] >= max_batches:
@@ -1100,10 +1109,23 @@ class ContinualTrainer:
             del self.diagnostics[:len(self.diagnostics)
                                  - self.max_diagnostics]
 
+    def _poll_slo(self) -> None:
+        """Drive the armed SLO monitor at stream cadence and fold its NEW
+        TM902/TM903 findings into the trainer's diagnostics log."""
+        if self._slo_monitor is None:
+            return
+        self._slo_monitor.poll()
+        diags = self._slo_monitor.diagnostics()
+        if len(diags) > self._slo_diags_seen:
+            self._note(diags[self._slo_diags_seen:])
+            self._slo_diags_seen = len(diags)
+
     # -- observability -------------------------------------------------------
     def metrics(self) -> Dict[str, Any]:
         out: Dict[str, Any] = dict(self.counters)
         out["diagnostics_recorded"] = len(self.diagnostics)
+        if self._slo_monitor is not None:
+            out["slo"] = self._slo_monitor.status()
         if self._detector is not None:
             out["drift"] = {"records": self._detector.records,
                             "features": self._detector.feature_stats()}

@@ -38,6 +38,7 @@ import numpy as np
 
 from ..data.chunked import ChunkedDataset, ColumnChunkWriter
 from ..data.dataset import Column, Dataset
+from ..obs import flight as obs_flight
 
 log = logging.getLogger(__name__)
 
@@ -217,6 +218,13 @@ def chunked_transform_epoch(cds: ChunkedDataset, runners: Sequence[Any],
             for w in writers.values():
                 w.note_existing(chunk_n)
         stats.chunks_skipped = start
+        if start:
+            # flight-recorder postmortem trail: a resumed epoch records
+            # exactly how much committed prefix it skipped (ISSUE 14 —
+            # the out-of-core path joins the event log)
+            obs_flight.record_event("chunk_resume", epoch=epoch_id,
+                                    skipped_chunks=start,
+                                    total_chunks=n_chunks)
     elif checkpoint is not None and resident_out:
         log.info("chunked epoch %s produces resident column(s) %s: "
                  "crash-resume disabled for this epoch", epoch_id,
