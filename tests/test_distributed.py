@@ -117,11 +117,67 @@ class TestFailHardOnMultiWorkerMarkers:
 
 
 class TestTwoProcessExecution:
-    """REAL multi-process coverage (VERDICT r3 #8): two OS processes
-    bootstrap via jax.distributed (localhost coordinator, CPU backend, 2
-    virtual devices each), build the framework's global_mesh, ingest
-    host_local_rows slices, and the psum-backed column stats must match a
-    single-process numpy computation."""
+    """Multi-process execution coverage (ISSUE 15 satellite): re-enabled
+    STRUCTURALLY — the single-process tests below drive the real
+    global-array assembly seam (``global_row_array`` + ``host_row_span``
+    arithmetic) under mocked ``process_index``/``process_count``, the same
+    pattern ``test_host_local_rows_multiprocess_math`` established; ONLY the
+    true two-OS-process run (which needs multi-process CPU collectives the
+    bundled jaxlib lacks) keeps its hardware xfail."""
+
+    def test_assembly_path_single_process(self):
+        """``global_row_array`` is the ingest seam every host calls with its
+        decoded span; single-process it must produce exactly the placed
+        global array (the logical array both paths define)."""
+        from transmogrifai_tpu.parallel.mesh import make_mesh, use_mesh
+
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(48, 3)).astype(np.float32)
+        with use_mesh(make_mesh()):
+            g = distributed.global_row_array(x, n_global_rows=48)
+            assert g.shape == (48, 3)
+            shapes = {s.data.shape for s in g.addressable_shards}
+            assert shapes == {(6, 3)}  # 48 rows / 8 devices on the data axis
+            np.testing.assert_array_equal(np.asarray(g), x)
+        # no mesh: plain placement, same logical array
+        g2 = distributed.global_row_array(x)
+        np.testing.assert_array_equal(np.asarray(g2), x)
+
+    def test_assembly_arithmetic_two_mocked_hosts(self, monkeypatch):
+        """The multi-process branch's contract, checked without a backend:
+        each mocked host owns exactly its ``host_local_rows`` span, a
+        wrong-sized block is refused with the span in the message, and the
+        spans tile the global row range."""
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        n = 100
+        spans = distributed.host_row_spans(n)
+        assert [(s.start, s.stop) for s in spans] == [(0, 50), (50, 100)]
+        for pid in range(2):
+            monkeypatch.setattr(jax, "process_index", lambda p=pid: p)
+            assert distributed.host_local_rows(n) == spans[pid]
+        # the assembly entry refuses a block that is not this host's span
+        from transmogrifai_tpu.parallel.mesh import make_mesh, use_mesh
+
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        with use_mesh(make_mesh()):
+            with pytest.raises(ValueError, match=r"rows \[0, 50\)"):
+                distributed.global_row_array(
+                    np.zeros((49, 3), np.float32), n_global_rows=100)
+
+    def test_span_contributions_compose_to_global_stats(self):
+        """The psum math the two-process worker exercises on hardware,
+        decomposed over spans: per-span moment/correlation contributions
+        must sum exactly to the single-process statistics."""
+        rng = np.random.default_rng(0)
+        x = rng.integers(-3, 4, size=(1024, 8)).astype(np.float64)
+        spans = distributed.host_row_spans(1024, 2)
+        total = sum(x[s].sum(axis=0) for s in spans)
+        sq = sum((x[s] ** 2).sum(axis=0) for s in spans)
+        np.testing.assert_array_equal(total, x.sum(axis=0))
+        np.testing.assert_array_equal(sq, (x ** 2).sum(axis=0))
+        mean = total / 1024
+        var = sq / 1024 - mean ** 2
+        np.testing.assert_allclose(var, x.var(axis=0), rtol=1e-12)
 
     @pytest.mark.xfail(
         strict=False,

@@ -447,6 +447,22 @@ class TestBenchSmoke:
             cal = parsed["irls_sweep_flops_calibration"]
             assert 0.2 <= cal <= 5.0, \
                 f"static FLOP model drifted from the analytic count: {cal}"
+        # pod-scale dp x mp sweeps (ISSUE 15): the multihost section emits
+        # in --smoke with ZERO warm sharded backend compiles, bitwise
+        # sharded-vs-single parity, a per-host-clean collective certificate,
+        # and self-describing mesh/topology provenance
+        assert secs["multihost"]["status"] == "ok", secs["multihost"]
+        mh = parsed["multihost"]
+        assert mh["warm_sharded_backend_compiles"] == 0, mh
+        assert mh["gate_zero_warm_sharded_compiles"] is True, mh
+        assert mh["sharded_parity_ok"] is True, mh
+        assert mh["gate_collectives_not_rows_proportional"] is True, mh
+        assert mh["sharded_fold_models_per_sec"] > 0
+        assert mh["single_fold_models_per_sec"] > 0
+        prov = mh["provenance"]
+        assert prov["mesh_shape"] == {"data": 4, "model": 2}, prov
+        assert prov["process_count"] == 1 and prov["global_devices"] == 8
+        assert "analyzer_collective_bytes_per_step" in prov
         # Pallas kernel dispatch section (ISSUE 10): runs in interpret mode
         # under --smoke, always emits, inline exact-int8 parity must hold,
         # and the JSON carries the tuning provenance of the run

@@ -111,7 +111,11 @@ class TestStyleValidation:
         prefetch pipeline (readers/prefetch.py) are hot ingest paths with
         exactly the thread-shared state (the prefetch queue/worker, the
         chunk writers) TM306 polices, so the gate also asserts both ingest
-        modules are in the linted set."""
+        modules are in the linted set; parallel/ joined with the pod-scale
+        dp x mp substrate (ISSUE 15) — the placement/stamp caches
+        (mesh.py) and the distributed bootstrap are exactly the
+        module-level-mutable-state and hot-path shape the gate exists for,
+        and the sharding-constraint helpers sit inside every traced sweep."""
         from transmogrifai_tpu.checkers.opcheck import (
             lint_file,
             lint_file_concurrency,
@@ -120,7 +124,7 @@ class TestStyleValidation:
         findings = []
         linted = []
         for sub in ("serve", "perf", "perf/kernels", "checkers", "cli",
-                    "workflow", "readers", "obs", "data"):
+                    "workflow", "readers", "obs", "data", "parallel"):
             d = os.path.join(PKG_ROOT, sub)
             for f in sorted(os.listdir(d)):
                 if not f.endswith(".py"):
@@ -140,6 +144,11 @@ class TestStyleValidation:
                            os.path.join("workflow", "ooc.py")):
             assert ingest_mod in linted, \
                 f"the ingest module {ingest_mod} left the lint gate"
+        for pod_mod in (os.path.join("parallel", "mesh.py"),
+                        os.path.join("parallel", "distributed.py"),
+                        os.path.join("perf", "kernels", "routing.py")):
+            assert pod_mod in linted, \
+                f"the pod-scale module {pod_mod} left the lint gate"
         assert not findings, (
             "unallowlisted hazards in serve//perf/ (fix them, or mark "
             "intentional ones inline with '# opcheck: allow(TMxxx) reason'):\n"

@@ -16,6 +16,13 @@ Exit-code contract (docs/static_analysis.md):
 The baseline stores stable error keys — ``code @ stageUid-or-location`` —
 not messages, so message rewording does not churn it.
 
+The ``--cost`` lint pass the gate typically wraps now includes the
+TM608/TM609 static scalability checks (checkers/plancheck.py, ISSUE 15):
+rows-proportional collective volume and over-share replicated operands under
+an ambient mesh.  Both are WARNING severity — they print through the gate
+for visibility and never flip the exit code; arm them in a baseline run with
+``-- --workflow ... --cost --single-host`` (see docs/static_analysis.md).
+
 Usage::
 
     python tools/lint_gate.py [--baseline tools/lint_baseline.json]

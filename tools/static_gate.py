@@ -30,12 +30,41 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import ir_gate  # noqa: E402
 import lint_gate  # noqa: E402
+
+#: family markers the golden corpus must keep pinned — a re-golden (or a
+#: hand edit) that drops every family carrying one of these would silently
+#: un-pin a whole program surface.  ``@mesh4x2`` is the pod-scale sharded
+#: lowering (ISSUE 15): losing it would let the sharded sweep/transform
+#: forms (and their TM705-absence proof) drift unreviewed.
+REQUIRED_FAMILY_MARKERS = ("@mesh4x2", "@interpret", "@chunk")
+
+
+def check_required_families(goldens_dir: str) -> int:
+    """rc 1 when the corpus index no longer holds any family for one of the
+    REQUIRED_FAMILY_MARKERS (missing corpus handled by ir_gate itself)."""
+    index_path = os.path.join(goldens_dir, "index.json")
+    try:
+        with open(index_path) as fh:
+            entries = json.load(fh).get("entries", {})
+    except (OSError, ValueError):
+        # absent OR malformed corpus (JSONDecodeError is a ValueError) is
+        # ir_gate's (fatal) finding, not ours
+        return 0
+    rc = 0
+    for marker in REQUIRED_FAMILY_MARKERS:
+        hits = [k for k in entries if marker in k]
+        if not hits:
+            print(f"static_gate: FAIL — no golden family carries {marker!r}; "
+                  f"the corpus un-pinned a required program surface")
+            rc = 1
+    return rc
 
 
 def main(argv=None) -> int:
@@ -66,6 +95,10 @@ def main(argv=None) -> int:
         rc_ir = ir_gate.main(ir_argv)
         print(f"static_gate: ir_gate rc={rc_ir}")
         rc = max(rc, rc_ir)
+        goldens_dir = ns.goldens or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tests", "goldens", "ir")
+        rc = max(rc, check_required_families(goldens_dir))
 
     if lint_args:
         print("static_gate: running lint_gate ...")

@@ -200,6 +200,22 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "mode; raise host_budget, narrow the feature vector, or "
               "reduce rows — spilling cannot shrink a working set the fit "
               "itself must assemble"),
+    "TM608": (Severity.WARNING, "collective volume scales with global rows",
+              "the plan's per-step cross-device collective volume grows "
+              "proportionally with the row bucket (a replicated pin or "
+              "all-gather of a row-shaped operand), so adding hosts adds "
+              "DCN traffic instead of removing work — the program won't "
+              "scale past one host; keep row operands pinned to the data "
+              "axis (parallel/mesh.py:constrain_rows) so collectives carry "
+              "only per-feature statistics, and replicate only (d,)-sized "
+              "blocks"),
+    "TM609": (Severity.WARNING, "replicated operands exceed per-host HBM share",
+              "operands replicated on every host (baked constants / "
+              "fully-replicated pins) exceed the per-host share of the armed "
+              "hbm_budget; replication cannot be sharded away by adding "
+              "hosts, so the plan stops scaling when one host's copy no "
+              "longer fits — shard the operand over the data/model axis or "
+              "shrink the baked state"),
     "TM605": (Severity.WARNING, "layout/order-dependent numerics",
               "the plan contains ops whose floating-point result depends on "
               "reduction order or data layout (float sort keys, "

@@ -800,6 +800,66 @@ def _sweep_entries() -> List[CorpusEntry]:
             statics=dict(metric_fn=_binary_metric(), link="sigmoid"),
             min_devices=8)
 
+    def _fresh_jit(jitted, static_argnames):
+        """A FRESH-IDENTITY jit wrapper around a module-level sweep program.
+
+        The sweep programs read the ambient mesh at trace time (their
+        ``constrain_*`` annotations), but jax's tracing caches key on the
+        underlying CALLABLE plus avals/shardings — and the corpus lowers on
+        abstract specs with NO shardings, so lowering the meshed variant
+        through the same program object that already lowered the unmeshed
+        family silently reuses the unmeshed trace (a fresh ``jax.jit`` of
+        the same function does too).  A new wrapper function per snapshot
+        defeats the cache by identity.  (Real dispatch never hits this:
+        meshed operands carry NamedShardings that key the trace apart, and
+        the AOT cache keys on the mesh token besides.)
+        """
+        import functools
+
+        import jax as _jax
+
+        inner = jitted.__wrapped__
+
+        @functools.wraps(inner)
+        def _mesh_variant(*args, **kwargs):
+            return inner(*args, **kwargs)
+
+        return _jax.jit(_mesh_variant, static_argnames=static_argnames)
+
+    def irls_meshed():
+        """The dp x mp SHARDED IRLS sweep (ISSUE 15): rows constrained to the
+        data axis, the beta batch to the model axis — the corpus pins the
+        sharded lowering (constraint inventory included) across jax bumps,
+        and the TM705 scan proves the sharded-sort hazard stays absent."""
+        from ..models.logistic import _irls_sweep
+        from ..parallel.mesh import make_mesh, use_mesh
+
+        with use_mesh(make_mesh(4, 2)):
+            return snapshot_program(
+                "models.logistic.irls_sweep@mesh4x2",
+                _fresh_jit(_irls_sweep, ("max_iter", "has_intercept")),
+                [_spec(n, d + 1), _spec(n), _spec(k, n), _spec(g)],
+                statics=dict(max_iter=3, has_intercept=True),
+                min_devices=8)
+
+    def svc_meshed():
+        """The sharded SVC CV program under the 4x2 mesh — the sort-based
+        metric runs inside, so this family is the standing TM705 regression
+        surface for the sharded sweep path."""
+        from ..models.svm import _svc_cv_program
+        from ..parallel.mesh import make_mesh, use_mesh
+
+        with use_mesh(make_mesh(4, 2)):
+            return snapshot_program(
+                "models.svm.svc_cv_program@mesh4x2",
+                _fresh_jit(_svc_cv_program,
+                           ("max_iter", "has_intercept", "metric_fn")),
+                [_spec(n, d), _spec(n), _spec(n), _spec(k, n), _spec(k, n),
+                 _spec(g)],
+                statics=dict(max_iter=3, has_intercept=True,
+                             metric_fn=_binary_metric()),
+                min_devices=8)
+
     return [
         CorpusEntry("models.logistic.irls_sweep", irls),
         CorpusEntry("models.logistic.fista_sweep", fista),
@@ -811,6 +871,10 @@ def _sweep_entries() -> List[CorpusEntry]:
         CorpusEntry("models.base.eval_softmax_sweep", eval_softmax),
         CorpusEntry("models.base.eval_linear_sweep@mesh4x2",
                     eval_linear_meshed, min_devices=8),
+        CorpusEntry("models.logistic.irls_sweep@mesh4x2", irls_meshed,
+                    min_devices=8),
+        CorpusEntry("models.svm.svc_cv_program@mesh4x2", svc_meshed,
+                    min_devices=8),
     ]
 
 
@@ -869,10 +933,29 @@ def _plan_entries() -> List[CorpusEntry]:
                                    max_bucket=64, strict=False)
         return snapshot_scoring_plan(plan, bucket=64)
 
+    def transform_prefix_meshed():
+        """The dp x mp SHARDED transform prefix (ISSUE 15): every entry row
+        block constrained to the data axis — pinned so the pod-scale
+        transform program form (and its collective inventory: layout pins
+        only, NO all-gathers) survives jax bumps.  Built under the mesh, so
+        the plan fingerprint carries the mesh token (distinct from the
+        unmeshed family by design)."""
+        from ..parallel.mesh import make_mesh, use_mesh
+        from ..workflow.plan import ColumnarTransformPlan
+
+        with use_mesh(make_mesh(4, 2)):
+            _features, runners = _plan_fixture_runners()
+            plan = ColumnarTransformPlan(runners,
+                                         frozenset({"x1", "x2", "b1"}))
+            return snapshot_transform_plan(
+                plan, bucket=64, key="workflow.plan.transform_prefix@mesh4x2")
+
     return [
         CorpusEntry("workflow.plan.transform_prefix", transform_prefix),
         CorpusEntry("workflow.plan.transform_prefix@chunk",
                     transform_prefix_chunk),
+        CorpusEntry("workflow.plan.transform_prefix@mesh4x2",
+                    transform_prefix_meshed, min_devices=8),
         CorpusEntry("serve.plan.scoring_prefix", scoring_prefix),
     ]
 
@@ -972,11 +1055,24 @@ def _kernel_entries() -> List[CorpusEntry]:
             "perf.kernels.encode@interpret", fn.lower(*specs),
             content_fingerprint=cache_key_fingerprint(fn, *specs))
 
+    def route_interpret():
+        from ..perf.kernels.routing import row_select_lanes_pallas
+
+        def route_program(binned, idx):
+            return row_select_lanes_pallas(binned, idx, interpret=True)
+
+        fn = jax.jit(route_program)  # opcheck: allow(TM303) lower-only snapshot path, zero backend compiles
+        specs = [_spec(n, d, dtype="int32"), _spec(L, n, dtype="int32")]
+        return snapshot_lowered(
+            "perf.kernels.route@interpret", fn.lower(*specs),
+            content_fingerprint=cache_key_fingerprint(fn, *specs))
+
     return [
         CorpusEntry("perf.kernels.hist@interpret", hist_interpret),
         CorpusEntry("perf.kernels.hist@tpu", hist_tpu),
         CorpusEntry("perf.kernels.split_scan@interpret", split_interpret),
         CorpusEntry("perf.kernels.encode@interpret", encode_interpret),
+        CorpusEntry("perf.kernels.route@interpret", route_interpret),
     ]
 
 

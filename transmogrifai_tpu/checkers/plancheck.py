@@ -191,6 +191,12 @@ class _Tally:
     bytes_written: int = 0
     op_counts: Dict[str, int] = field(default_factory=dict)
     collectives: Dict[str, int] = field(default_factory=dict)
+    #: modeled cross-device traffic (bytes) of the collective/resharding ops:
+    #: full operand bytes for true collectives and fully-replicating
+    #: constraints (a potential all-gather), zero for constraints that keep a
+    #: dimension sharded (layout-preserving pins move nothing) — the TM608
+    #: scalability evidence
+    collective_bytes: int = 0
     order_accums: int = 0
     order_sorts: int = 0
     notes: List[str] = field(default_factory=list)
@@ -203,11 +209,31 @@ class _Tally:
             self.op_counts[k] = self.op_counts.get(k, 0) + v * times
         for k, v in other.collectives.items():
             self.collectives[k] = self.collectives.get(k, 0) + v * times
+        self.collective_bytes += other.collective_bytes * times
         self.order_accums += other.order_accums * times
         self.order_sorts += other.order_sorts * times
         for n in other.notes:
             if n not in self.notes:
                 self.notes.append(n)
+
+
+def _collective_volume(eqn) -> int:
+    """Modeled cross-device byte volume of one collective/resharding eqn.
+
+    ``sharding_constraint`` charges its operand bytes only when the target
+    sharding is FULLY REPLICATED — the shape a GSPMD all-gather materializes
+    (the eval sweeps' metric pin is exactly this, deliberately); a constraint
+    that keeps any dimension sharded is a layout pin and moves nothing by
+    itself.  True collectives (psum/all_gather/...) always charge operand
+    bytes.  An upper bound either way — XLA may fuse or elide."""
+    in_bytes = sum(_aval_bytes(v.aval) for v in eqn.invars
+                   if hasattr(v, "aval"))
+    if eqn.primitive.name != "sharding_constraint":
+        return in_bytes
+    sh = eqn.params.get("sharding")
+    if sh is None or bool(getattr(sh, "is_fully_replicated", False)):
+        return in_bytes
+    return 0
 
 
 def _sub_jaxprs(eqn) -> List[Tuple[Any, int]]:
@@ -239,6 +265,23 @@ def _open_jaxpr(j):
     A ClosedJaxpr's constants are bound to ``jaxpr.constvars``, so their
     bytes are accounted exactly once through the constvar avals."""
     return getattr(j, "jaxpr", j)
+
+
+def _const_bytes(j) -> int:
+    """Bytes of constants baked at ANY nesting level of the jaxpr tree.
+
+    A jit-wrapped program stages as ONE pjit eqn whose consts live in the
+    sub-ClosedJaxpr — the top-level constvars are empty — and every real
+    caller hands analyze_program/trace_cost a jit-wrapped fn, so the TM609
+    replication evidence must see through call boundaries.  Counted once per
+    binding site (residency, not traffic), summed across sites: an upper
+    bound when branches share a constant."""
+    jaxpr = _open_jaxpr(j)
+    total = sum(_aval_bytes(v.aval) for v in jaxpr.constvars)
+    for eqn in jaxpr.eqns:
+        for sub, _times in _sub_jaxprs(eqn):
+            total += _const_bytes(sub)
+    return total
 
 
 def _walk_jaxpr(j, tally: _Tally, depth: int = 0) -> int:
@@ -318,6 +361,7 @@ def _walk_jaxpr(j, tally: _Tally, depth: int = 0) -> int:
                                        for v in eqn.outvars)
             if name in _COLLECTIVE_PRIMS:
                 tally.collectives[name] = tally.collectives.get(name, 0) + 1
+                tally.collective_bytes += _collective_volume(eqn)
             any_float = any(_is_float(v.aval) for v in eqn.invars
                             if hasattr(v, "aval"))
             if any_float and name in _ORDER_ACCUM_PRIMS:
@@ -362,6 +406,11 @@ class SegmentCost:
     peak_live_bytes: int = 0
     op_counts: Dict[str, int] = field(default_factory=dict)
     collectives: Dict[str, int] = field(default_factory=dict)
+    #: modeled cross-device traffic of the collective/resharding ops (TM608)
+    collective_bytes: int = 0
+    #: per-host-replicated entry bytes: the program's baked constants, which
+    #: every host holds in full regardless of mesh size (TM609 evidence)
+    replicated_bytes: int = 0
     order_accums: int = 0
     order_sorts: int = 0
     notes: List[str] = field(default_factory=list)
@@ -387,6 +436,7 @@ class SegmentCost:
             "intensity": round(self.intensity, 4),
             "memoryBound": self.memory_bound,
             "collectives": dict(self.collectives),
+            "collectiveBytes": self.collective_bytes,
             "orderSensitiveOps": {"accumulations": self.order_accums,
                                   "sorts": self.order_sorts},
             "notes": list(self.notes),
@@ -402,6 +452,10 @@ class BucketCost:
     bytes_read: int
     bytes_written: int
     peak_hbm_bytes: int
+    #: modeled cross-device collective traffic per step at this bucket —
+    #: the TM608 scalability evidence (rows-proportional growth across the
+    #: ladder means the program cannot scale past one host)
+    collective_bytes: int = 0
 
     @property
     def intensity(self) -> float:
@@ -412,6 +466,7 @@ class BucketCost:
             "bucket": self.bucket, "flops": self.flops,
             "bytesRead": self.bytes_read, "bytesWritten": self.bytes_written,
             "peakHbmBytes": self.peak_hbm_bytes,
+            "collectiveBytes": self.collective_bytes,
             "intensity": round(self.intensity, 4),
         }
 
@@ -438,6 +493,9 @@ class PlanCostReport:
     buckets: List[BucketCost] = field(default_factory=list)
     hazards: List[RecompileHazard] = field(default_factory=list)
     collectives: Dict[str, int] = field(default_factory=dict)
+    #: per-host-replicated entry bytes (baked constants) at the reference
+    #: bucket — the operands adding hosts cannot shard away (TM609)
+    replicated_bytes: int = 0
     #: order/layout-sensitive op counts (TM605 evidence): float accumulations
     #: and float sorts in the traced program
     order_accums: int = 0
@@ -458,6 +516,13 @@ class PlanCostReport:
     def peak_hbm_bytes(self) -> int:
         return max((b.peak_hbm_bytes for b in self.buckets), default=0)
 
+    @property
+    def collective_bytes_per_step(self) -> int:
+        """Modeled cross-device collective traffic of one dispatch at the
+        largest analyzed bucket (the bench ``multihost`` section's
+        analyzer-predicted number)."""
+        return self.buckets[-1].collective_bytes if self.buckets else 0
+
     def memory_bound_segments(self) -> List[SegmentCost]:
         return [s for s in self.segments if s.memory_bound and s.bytes_total]
 
@@ -467,6 +532,8 @@ class PlanCostReport:
             "totalFlops": self.total_flops,
             "totalBytes": self.total_bytes,
             "peakHbmBytes": self.peak_hbm_bytes,
+            "collectiveBytesPerStep": self.collective_bytes_per_step,
+            "replicatedBytes": self.replicated_bytes,
             "buckets": [b.to_dict() for b in self.buckets],
             "segments": [s.to_dict() for s in self.segments],
             "recompileHazards": [h.to_dict() for h in self.hazards],
@@ -500,9 +567,14 @@ class PlanCostReport:
         if self.collectives:
             inv = ", ".join(f"{k} x{v}" for k, v in
                             sorted(self.collectives.items()))
-            lines.append(f"  collectives/resharding: {inv}")
+            lines.append(f"  collectives/resharding: {inv} "
+                         f"({_fmt_bytes(self.collective_bytes_per_step)}"
+                         f"/step)")
         else:
             lines.append("  collectives/resharding: none")
+        if self.replicated_bytes:
+            lines.append(f"  per-host replicated operands: "
+                         f"{_fmt_bytes(self.replicated_bytes)}")
         if self.order_accums or self.order_sorts:
             lines.append(f"  order-sensitive ops: "
                          f"{self.order_accums} float accumulation(s), "
@@ -535,10 +607,15 @@ def trace_cost(fn, *specs, name: str = "program") -> SegmentCost:
     closed = jax.make_jaxpr(fn)(*specs)
     tally = _Tally()
     peak = _walk_jaxpr(closed, tally)
+    # baked constants at every nesting level (a jit-wrapped fn binds them in
+    # its pjit sub-jaxpr, not the top-level constvars): the operands every
+    # host replicates in full regardless of mesh size (TM609 evidence)
+    replicated = _const_bytes(closed)
     return SegmentCost(
         name=name, flops=tally.flops, bytes_read=tally.bytes_read,
         bytes_written=tally.bytes_written, peak_live_bytes=peak,
         op_counts=tally.op_counts, collectives=tally.collectives,
+        collective_bytes=tally.collective_bytes, replicated_bytes=replicated,
         order_accums=tally.order_accums, order_sorts=tally.order_sorts,
         notes=tally.notes)
 
@@ -607,7 +684,10 @@ def _analyze_fused(fused_fn, specs_per_bucket, wiring, label: str,
         report.buckets.append(BucketCost(
             bucket=bucket, flops=seg.flops, bytes_read=seg.bytes_read,
             bytes_written=seg.bytes_written,
-            peak_hbm_bytes=seg.peak_live_bytes))
+            peak_hbm_bytes=seg.peak_live_bytes,
+            collective_bytes=seg.collective_bytes))
+        report.replicated_bytes = max(report.replicated_bytes,
+                                      seg.replicated_bytes)
         for k, v in seg.collectives.items():
             report.collectives[k] = max(report.collectives.get(k, 0), v)
         for n in seg.notes:
@@ -692,10 +772,13 @@ def analyze_transform_plan(plan, dataset) -> PlanCostReport:
     columns themselves are never lifted."""
     import jax
 
-    from ..workflow.plan import _transform_bucket
+    from ..workflow.plan import mesh_aligned_tile
 
     n = dataset.n_rows
-    bucket = _transform_bucket(n)
+    # the DISPATCH tile, not the bare pow2/8192 bucket: under a mesh whose
+    # data axis does not divide the bucket, _place pads up to the mesh
+    # multiple — the admission gate must certify the program that runs
+    bucket = mesh_aligned_tile(n)
 
     def spec_for(key, rows: int):
         if key[0] == "lift":
@@ -737,6 +820,70 @@ def analyze_transform_plan(plan, dataset) -> PlanCostReport:
             "shape reuses one executable, a drifting row count compiles one "
             "per multiple")
     return report
+
+
+def analyze_program(fn, specs_per_bucket, label: str = "program"
+                    ) -> PlanCostReport:
+    """Static cost report of an arbitrary (jit-wrapped or plain) program
+    across a row-bucket ladder — the sweep-program twin of
+    :func:`analyze_transform_plan`.
+
+    ``specs_per_bucket`` is ``[(bucket, [specs...]), ...]``; statics bind
+    via ``functools.partial``/lambda before the call.  This is the entry the
+    TM608/TM609 scalability pass and the bench ``multihost`` section use to
+    cost the sharded fold x grid sweep programs (collective bytes per step,
+    replicated operand bytes) at ZERO backend compiles."""
+    return _analyze_fused(fn, list(specs_per_bucket), None, label)
+
+
+#: TM608 threshold: per-step collective volume counted as rows-proportional
+#: when its growth across the bucket ladder is at least this fraction of the
+#: row growth (1.0 = exactly linear; 0.5 tolerates a constant component)
+ROWS_PROPORTIONAL_FRACTION = 0.5
+
+#: TM609 threshold: fraction of the armed per-host HBM budget that
+#: replicated (per-host, non-shardable) operands may occupy
+REPLICATED_HBM_SHARE = 0.5
+
+
+def scalability_diagnostics(report: PlanCostReport,
+                            hbm_budget: Optional[float] = None
+                            ) -> List[Diagnostic]:
+    """TM608/TM609: the static scalability gate (pod-scale readiness at zero
+    hardware).  Mesh-scoped by construction — an unmeshed trace has no
+    collectives and its baked constants are not *replicas* of anything, so
+    both checks are quiet off-mesh and CI plans analyzed without a mesh
+    never churn."""
+    diags: List[Diagnostic] = []
+    if report.mesh is None:
+        return diags
+
+    if len(report.buckets) >= 2:
+        ladder = sorted(report.buckets, key=lambda b: b.bucket)
+        lo, hi = ladder[0], ladder[-1]
+        if hi.bucket > lo.bucket and hi.collective_bytes > 0:
+            rows_ratio = hi.bucket / lo.bucket
+            vol_ratio = hi.collective_bytes / max(lo.collective_bytes, 1)
+            if vol_ratio >= ROWS_PROPORTIONAL_FRACTION * rows_ratio:
+                diags.append(make_diagnostic(
+                    "TM608",
+                    f"plan {report.plan}: per-step collective volume grows "
+                    f"with global rows ({_fmt_bytes(lo.collective_bytes)} at "
+                    f"bucket {lo.bucket} -> {_fmt_bytes(hi.collective_bytes)} "
+                    f"at bucket {hi.bucket}, x{vol_ratio:.1f} for x"
+                    f"{rows_ratio:.0f} rows) — the program moves row-shaped "
+                    f"data over the mesh and will not scale past one host"))
+
+    if hbm_budget is not None and report.replicated_bytes > \
+            REPLICATED_HBM_SHARE * hbm_budget:
+        diags.append(make_diagnostic(
+            "TM609",
+            f"plan {report.plan}: {_fmt_bytes(report.replicated_bytes)} of "
+            f"per-host replicated operands (baked constants) exceed "
+            f"{REPLICATED_HBM_SHARE:.0%} of the {_fmt_bytes(int(hbm_budget))} "
+            f"per-host budget — replication cannot be sharded away by "
+            f"adding hosts"))
+    return diags
 
 
 def analyze_transform(dataset, result_features, fitted) -> Optional[PlanCostReport]:
@@ -798,6 +945,9 @@ def cost_diagnostics(report: PlanCostReport,
             f"plan {report.plan}: {len(slow)} memory-bound segment(s) below "
             f"{intensity_threshold:.1f} FLOPs/byte — Pallas fused-kernel "
             f"candidates: {names}"))
+
+    # TM608/TM609: the static scalability pass (mesh-scoped; quiet off-mesh)
+    diags.extend(scalability_diagnostics(report, hbm_budget=hbm_budget))
 
     sorts, accums = report.order_sorts, report.order_accums
     if sorts or (accums and report.mesh is not None):

@@ -37,10 +37,19 @@ def _ridge_core(x: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, reg: jnp.ndarray
 
 @partial(jax.jit, static_argnames=("has_intercept",))
 def _ridge_sweep(x, y, train_w, regs, has_intercept: bool = True):
+    """dp x mp sharding annotations as in logistic._irls_sweep: rows pin to
+    the data axis (the normal-equation psums carry only (d, d) blocks), the
+    beta batch's grid axis to the model axis; identity off-mesh."""
+    from ..parallel.mesh import constrain_fold_rows, constrain_grid, \
+        constrain_rows
+
+    x, y, train_w = constrain_rows(x), constrain_rows(y), \
+        constrain_fold_rows(train_w)
     fit_fold = jax.vmap(
         lambda w, reg: _ridge_core(x, y, w, reg, has_intercept=has_intercept),
         in_axes=(0, None))
-    return jax.vmap(lambda reg: fit_fold(train_w, reg), in_axes=0)(regs)
+    return constrain_grid(
+        jax.vmap(lambda reg: fit_fold(train_w, reg), in_axes=0)(regs))
 
 
 class LinearRegression(PredictionEstimatorBase):

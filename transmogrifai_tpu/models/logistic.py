@@ -150,13 +150,28 @@ def _fista_elastic(x, y, w, l1, l2, max_iter, has_intercept: bool = True):
 
 @partial(jax.jit, static_argnames=("max_iter", "has_intercept"))
 def _irls_sweep(x, y, train_w, regs, max_iter, has_intercept: bool = True):
-    """vmap the IRLS fit over fold weights (k, n) and reg grid (g,) -> betas (g, k, d+1)."""
+    """vmap the IRLS fit over fold weights (k, n) and reg grid (g,) -> betas (g, k, d+1).
+
+    dp x mp sharding rides ambient ``with_sharding_constraint`` annotations
+    (parallel/mesh.py:constrain_* — identity off-mesh, so the single-host
+    program is byte-identical to the pre-annotation form): row operands pin
+    to the data axis so XLA keeps the IRLS row math shard-local (the psums
+    carry only the (d, d) Hessian/gradient statistics), and the (g, k, d+1)
+    beta batch pins its grid axis to the model axis.  The executable cache
+    keys on the ambient mesh token, so traces under different meshes/process
+    topologies never alias.
+    """
+    from ..parallel.mesh import constrain_fold_rows, constrain_grid, \
+        constrain_rows
+
+    x, y, train_w = constrain_rows(x), constrain_rows(y), \
+        constrain_fold_rows(train_w)
     fit_fold = jax.vmap(
         lambda w, reg: _irls_core(x, y, w, reg, max_iter,
                                   has_intercept=has_intercept),
         in_axes=(0, None))
     fit_grid = jax.vmap(lambda reg: fit_fold(train_w, reg), in_axes=0)
-    return fit_grid(regs)
+    return constrain_grid(fit_grid(regs))
 
 
 @partial(jax.jit, static_argnames=("max_iter", "has_intercept"))
@@ -164,13 +179,19 @@ def _fista_sweep(x, y, train_w, l1s, l2s, max_iter, has_intercept: bool = True):
     """vmap the EXACT elastic-net FISTA fit over fold weights (k, n) and the
     (l1, l2) grid (g,) -> betas (g, k, d+1).  Grid points with l1 > 0 are ranked
     under the same composite objective the final fit solves (ADVICE r1: the
-    smooth approximation could re-order near-tied grids that vary elastic_net)."""
+    smooth approximation could re-order near-tied grids that vary elastic_net).
+    Sharding annotations as in :func:`_irls_sweep` (identity off-mesh)."""
+    from ..parallel.mesh import constrain_fold_rows, constrain_grid, \
+        constrain_rows
+
+    x, y, train_w = constrain_rows(x), constrain_rows(y), \
+        constrain_fold_rows(train_w)
     fit_fold = jax.vmap(
         lambda w, l1, l2: _fista_elastic(x, y, w, l1, l2, max_iter,
                                          has_intercept=has_intercept),
         in_axes=(0, None, None))
     fit_grid = jax.vmap(lambda l1, l2: fit_fold(train_w, l1, l2))
-    return fit_grid(l1s, l2s)
+    return constrain_grid(fit_grid(l1s, l2s))
 
 
 @partial(jax.jit, static_argnames=("has_intercept", "standardize"))

@@ -65,7 +65,16 @@ def _svc_cv_program(x, y, y_pm, train_w, val_w, regs, max_iter: int,
     (matching _fit_arrays), then the grid vmaps over regs and folds vmap over
     weights; metrics evaluate on the fold margins without leaving the chip.
     Mirrors the reference's all-fold concurrency (OpCrossValidation.scala:114).
+
+    dp x mp sharding rides ambient ``with_sharding_constraint`` annotations
+    (identity off-mesh): row operands pin to the data axis so the per-fold
+    standardization/descent psums carry only (d,)-sized statistics.
     """
+    from ..parallel.mesh import constrain_fold_rows, constrain_rows
+
+    x, y, y_pm = constrain_rows(x), constrain_rows(y), constrain_rows(y_pm)
+    train_w = constrain_fold_rows(train_w)
+    val_w = constrain_fold_rows(val_w)
 
     def one_fold(w, vw):
         sw = jnp.maximum(w.sum(), 1e-12)

@@ -54,6 +54,7 @@ import numpy as np
 from ..data.dataset import Column
 from ..perf.kernels import dispatch as _kdispatch
 from ..perf.kernels import histogram as _khist
+from ..perf.kernels import routing as _krout
 from ..perf.kernels import splitscan as _ksplit
 from ..stages.base import Param
 from .base import PredictionEstimatorBase, PredictionModelBase
@@ -289,19 +290,13 @@ class Tree(NamedTuple):
 _soft_threshold = _ksplit.soft_threshold
 
 
-def _row_select(binned: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
-    """binned[i, idx[i]] as a fused compare-multiply-reduce, not a gather.
-
-    TPU lowers a per-row dynamic-minor gather (take_along_axis on the (n, d)
-    code matrix) to an extremely slow serialized access pattern — it was the
-    dominant cost of tree growth/prediction (time scaled with trees x levels
-    and was independent of bin count).  The one-hot compare fuses into a
-    streaming reduce over the feature axis: one sequential read of the codes
-    at full HBM bandwidth.  Exact for codes < 2^24 (f32 integers).
-    """
-    d = binned.shape[1]
-    oh = (jnp.arange(d, dtype=jnp.int32)[None, :] == idx[:, None])
-    return (binned.astype(jnp.float32) * oh).sum(axis=1).astype(jnp.int32)
+#: binned[i, idx[i]] as a fused compare-multiply-reduce, not a gather: TPU
+#: lowers a per-row dynamic-minor gather (take_along_axis on the (n, d) code
+#: matrix) to an extremely slow serialized access pattern — it was the
+#: dominant cost of tree growth/prediction.  ONE definition now lives in
+#: perf/kernels/routing.py (shared by the XLA path, the Pallas routing
+#: kernel, and the parity tests).
+_row_select = _krout.row_select_xla
 
 
 def _node_lookup(tbl: jnp.ndarray, node: jnp.ndarray) -> jnp.ndarray:
@@ -320,14 +315,12 @@ def _node_lookup(tbl: jnp.ndarray, node: jnp.ndarray) -> jnp.ndarray:
     return (oh[:, :, None] * tbl[None, :, :]).sum(axis=1)            # (n, K)
 
 
-def _row_select_l(binned: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
-    """binned[i, idx[l, i]] per lane — lane-batched ``_row_select``.
-
-    binned: (n, d) shared codes; idx: (L, n) -> (L, n)."""
-    d = binned.shape[1]
-    oh = (jnp.arange(d, dtype=jnp.int32)[None, None, :] == idx[:, :, None])
-    return (binned.astype(jnp.float32)[None] * oh).sum(axis=-1) \
-        .astype(jnp.int32)
+#: binned[i, idx[l, i]] per lane — the sweep fold-take routing pass, now the
+#: DISPATCHED entry of perf/kernels/routing.py: compiled Pallas on TPU (VMEM
+#: admission guarded), the shared XLA compare-reduce elsewhere; interpret
+#: mode pins bitwise parity in CI.  The dispatch mode rides cache_token(), so
+#: routing-kernel executables never alias across modes.
+_row_select_l = _krout.row_select_lanes
 
 
 def _node_lookup_l(tbl: jnp.ndarray, node: jnp.ndarray) -> jnp.ndarray:
@@ -847,7 +840,19 @@ def _gbt_cv_program(binned, y, train_w, val_w, key, n_rounds, max_depth, n_bins,
     The prior margin is recomputed per fold from the fold's training weights —
     exactly what ``_fit_arrays`` would produce on that fold.  Folds are LANES
     of one joint boosting run (``_fit_gbt_lanes``): each round grows all
-    folds' trees in one histogram GEMM sharing the one-hot operand (r5)."""
+    folds' trees in one histogram GEMM sharing the one-hot operand (r5).
+
+    dp x mp sharding rides ambient row annotations (identity off-mesh): the
+    (n, d) bin codes and the per-fold weight rows pin to the data axis, so
+    the histogram GEMMs reduce shard-locally and the psums carry only the
+    (lanes, bins x features) histogram blocks — per-host rows, never global
+    rows.  Metric payloads keep their fold-vmapped layout (the watch-item
+    test pins that form bitwise; see test_use_mesh.py)."""
+    from ..parallel.mesh import constrain_fold_rows, constrain_rows
+
+    binned, y = constrain_rows(binned), constrain_rows(y)
+    train_w = constrain_fold_rows(train_w)
+    val_w = constrain_fold_rows(val_w)
     base = jax.vmap(lambda w_: _base_score_device(
         y, w_, objective, num_class, scale_pos_weight))(train_w)     # (k, K)
     margin, _ = _fit_gbt_lanes(
@@ -872,7 +877,18 @@ def _forest_cv_program(binned, y, y_cols, train_w, val_w, feat_masks, boot_w,
     """All folds of one forest grid point (fit + predict + metric) in one
     program.  The (fold x tree) grid flattens into k*T lanes of ONE joint
     ``_grow_trees`` call — every lane shares the histogram GEMM's one-hot
-    operand instead of regenerating it per fold per tree (r5)."""
+    operand instead of regenerating it per fold per tree (r5).
+
+    dp x mp row annotations as in :func:`_gbt_cv_program` (identity
+    off-mesh): bin codes, targets, fold weights, and the per-tree bootstrap
+    rows pin to the data axis; the small (T, d) feature masks replicate."""
+    from ..parallel.mesh import constrain_fold_rows, constrain_rows
+
+    binned, y = constrain_rows(binned), constrain_rows(y)
+    y_cols = constrain_rows(y_cols)
+    train_w = constrain_fold_rows(train_w)
+    val_w = constrain_fold_rows(val_w)
+    boot_w = constrain_fold_rows(boot_w)
     k, n = train_w.shape
     n_trees, _ = feat_masks.shape
     K = y_cols.shape[1]

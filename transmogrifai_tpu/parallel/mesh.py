@@ -30,7 +30,7 @@ def make_mesh(
     devices: Optional[Sequence] = None,
 ) -> Mesh:
     """A (data, model) mesh.  Default: all devices on the data axis."""
-    devs = np.asarray(devices if devices is not None else jax.devices())
+    devs = np.asarray(devices if devices is not None else jax.devices())  # opcheck: allow(TM301) Device objects, not a traced jax value
     total = devs.size
     if n_data is None:
         n_data = total // n_model
@@ -135,29 +135,102 @@ def place_rows(arr, mesh: Optional[Mesh] = None):
     return jax.device_put(np.asarray(arr), row_sharding(mesh))
 
 
+def _effective_spec(shape, axes, mesh) -> P:
+    """The ONE degradation rule place()/constrain() share: an axis the mesh
+    doesn't know, or whose dimension size doesn't divide the mesh axis,
+    degrades to replication (sharding is a layout hint, never semantics — a
+    1-point grid over a 2-way model axis must still run/trace)."""
+    return P(*(
+        a if (a in mesh.axis_names
+              and i < len(shape)
+              and int(shape[i]) % int(mesh.shape[a]) == 0)
+        else None
+        for i, a in enumerate(axes)))
+
+
 def place(arr, axes: Tuple[Optional[str], ...], mesh: Optional[Mesh] = None):
     """Device-put with an explicit PartitionSpec over the ambient (or given)
     mesh; plain jnp.asarray when no mesh is active.
 
-    Robust by construction: axes the mesh doesn't know, or whose dimension
-    size doesn't divide the mesh axis, degrade to replication (device_put
-    enforces divisibility eagerly, and sharding is a layout hint, never
-    semantics — a 1-point grid over a 2-way model axis must still run).
-    Arrays already on device reshard in place (no host round-trip).
+    Robust by construction (:func:`_effective_spec` degradation; device_put
+    enforces divisibility eagerly).  Arrays already on device reshard in
+    place (no host round-trip).
     """
     import jax.numpy as jnp
-    from jax.sharding import PartitionSpec
 
     mesh = mesh if mesh is not None else current_mesh()
     if mesh is None:
         return jnp.asarray(arr)
     if not isinstance(arr, jax.Array):
         arr = np.asarray(arr)
-    eff = tuple(
-        a if (a in mesh.axis_names and arr.shape[i] % mesh.shape[a] == 0)
-        else None
-        for i, a in enumerate(axes))
-    return jax.device_put(arr, NamedSharding(mesh, PartitionSpec(*eff)))
+    eff = _effective_spec(arr.shape, axes, mesh)
+    return jax.device_put(arr, NamedSharding(mesh, eff))
+
+
+def mesh_token(mesh: Optional[Mesh] = None) -> Optional[tuple]:
+    """Hashable topology token of the ambient (or given) mesh: axis names,
+    per-axis sizes, and the PROCESS topology (process count, devices per
+    process).  None without a mesh.
+
+    This is the component every executable-cache key and plan fingerprint
+    carries so a multi-host program can never alias a single-host one: an
+    8-device mesh on one host and a 2-host x 4-device mesh have identical
+    device-array shapes but different DCN boundaries — XLA lowers different
+    collectives for them, so their executables must key apart.
+    """
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None:
+        return None
+    # mesh in hand => the backend is initialized; process topology is cheap
+    return (tuple(mesh.axis_names),
+            tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+            int(jax.process_count()), len(jax.local_devices()))  # opcheck: allow(TM301) process/axis counts are python ints, not jax values
+
+
+def constrain(x, *axes, mesh: Optional[Mesh] = None):
+    """``with_sharding_constraint`` over the ambient (or given) mesh —
+    IDENTITY when no mesh is active (the SNIPPETS [3] pattern: annotations
+    are layout constraints for the GSPMD partitioner, never semantics, and
+    must no-op off-mesh so one program body serves both modes).
+
+    ``axes`` name a mesh axis per dimension (None = replicate that dim).
+    Robust by construction, mirroring :func:`place`: axes the mesh doesn't
+    know, or whose dimension size doesn't divide the mesh axis, degrade to
+    replication — a 1-point grid over a 2-way model axis must still trace.
+    Safe under ``jit``: the mesh is read at trace time and the executable
+    cache keys on :func:`mesh_token`, so traces under different meshes never
+    alias.
+    """
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None:
+        return x
+    eff = _effective_spec(getattr(x, "shape", ()), axes, mesh)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, eff))
+
+
+def constrain_rows(x, mesh: Optional[Mesh] = None):
+    """Constrain the leading (row) axis over the data axis — the per-host
+    row-block annotation every sweep/transform program applies to its row
+    operands so XLA keeps row math shard-local (collectives carry only the
+    (d,)-sized statistics, never the rows).  Identity without a mesh."""
+    ndim = getattr(x, "ndim", 1)
+    return constrain(x, DATA_AXIS, *((None,) * (ndim - 1)), mesh=mesh)
+
+
+def constrain_fold_rows(x, mesh: Optional[Mesh] = None):
+    """Constrain a (k, n) fold-weight block: rows (axis 1) over data,
+    the small fold axis replicated.  Identity without a mesh."""
+    ndim = getattr(x, "ndim", 2)
+    return constrain(x, None, DATA_AXIS, *((None,) * (ndim - 2)), mesh=mesh)
+
+
+def constrain_grid(x, mesh: Optional[Mesh] = None):
+    """Constrain the leading (grid/model-batch) axis over the model axis —
+    the fold x grid batch annotation that makes a sweep two-dimensionally
+    parallel (each model-axis slice fits its grid points on its own row
+    shard).  Identity without a mesh."""
+    ndim = getattr(x, "ndim", 1)
+    return constrain(x, MODEL_AXIS, *((None,) * (ndim - 1)), mesh=mesh)
 
 
 def pad_rows_for_mesh(*arrays, mesh: Optional[Mesh] = None):
@@ -222,6 +295,14 @@ def pad_rows_bucketed_for_mesh(*arrays, n: Optional[int] = None):
 # transfer) but old blocks roll off so device memory stays bounded.
 _PLACED_ROWS_CACHE: dict = {}
 _PLACED_ROWS_CACHE_MAX = 3
+
+#: one lock for all three placement caches (stamp memo + row/aux placement
+#: FIFOs): fleet refit loops and concurrent selector fits share them.  The
+#: device transfers themselves run OUTSIDE the lock — a double-place on a
+#: concurrent miss is benign (last insert wins), a torn dict is not.
+import threading as _threading
+
+_PLACEMENT_LOCK = _threading.RLock()
 
 
 _STAMP_MEMO: dict = {}
@@ -289,7 +370,8 @@ def _content_stamp(a: np.ndarray) -> bytes:
     memoizable = contiguous and a.nbytes >= _STAMP_MEMO_MIN_BYTES
     if memoizable:  # the memo (and _quick_sig) need zero-copy byte views
         memo_key = id(a)
-        hit = _STAMP_MEMO.get(memo_key)
+        with _PLACEMENT_LOCK:
+            hit = _STAMP_MEMO.get(memo_key)
         # a hit requires an OWNER array that is still frozen: a re-enabled
         # writeable flag means the caller intends to mutate -> full re-hash.
         # Views never qualify — a mutation through the view or its base
@@ -309,27 +391,30 @@ def _content_stamp(a: np.ndarray) -> bytes:
         # so any entry whose array cannot be frozen would be guarded by the
         # sampled quick_sig alone — exactly the stale-placement hazard the
         # r4 advisor flagged.  Views always take the full re-hash path.
-        try:
-            ref = weakref.ref(a)  # before the freeze: a weakref-refusing
-            # subclass must not leave the array frozen with no memo entry
-            # whose eviction would restore it
-            was_writeable = bool(a.flags.writeable)
-            a.flags.writeable = False  # mutations now raise, loudly
-            _STAMP_MEMO[memo_key] = (ref, (a.shape, a.dtype.str),
-                                     _quick_sig(a), stamp, was_writeable)
-        except (TypeError, ValueError):
-            pass  # weakref-refusing subclass / flag-locked array: no memo
-        for k in [k for k, v in _STAMP_MEMO.items() if v[0]() is None]:
-            _STAMP_MEMO.pop(k)  # prune entries whose array died
-        while len(_STAMP_MEMO) > _STAMP_MEMO_MAX:
-            _evict_stamp(next(iter(_STAMP_MEMO)))
+        with _PLACEMENT_LOCK:
+            try:
+                ref = weakref.ref(a)  # before the freeze: a weakref-refusing
+                # subclass must not leave the array frozen with no memo entry
+                # whose eviction would restore it
+                was_writeable = bool(a.flags.writeable)
+                a.flags.writeable = False  # mutations now raise, loudly
+                _STAMP_MEMO[memo_key] = (ref, (a.shape, a.dtype.str),
+                                         _quick_sig(a), stamp, was_writeable)
+            except (TypeError, ValueError):
+                pass  # weakref-refusing subclass / flag-locked array: no memo
+            for k in [k for k, v in _STAMP_MEMO.items() if v[0]() is None]:
+                _STAMP_MEMO.pop(k)  # prune entries whose array died
+            while len(_STAMP_MEMO) > _STAMP_MEMO_MAX:
+                _evict_stamp(next(iter(_STAMP_MEMO)))
     return stamp
 
 
 def _evict_stamp(key) -> None:
     """Drop a memo entry and lift its freeze (the caller owns the array
-    again once nothing vouches for its content)."""
-    entry = _STAMP_MEMO.pop(key, None)
+    again once nothing vouches for its content).  Callers hold (or may
+    re-enter — RLock) the placement lock."""
+    with _PLACEMENT_LOCK:
+        entry = _STAMP_MEMO.pop(key, None)
     if entry is not None:
         arr = entry[0]()
         if arr is not None and entry[4]:  # restore ONLY if we froze it
@@ -352,14 +437,16 @@ def place_cached(arr: np.ndarray, axes: tuple,
     mesh = mesh if mesh is not None else current_mesh()
     arr = np.asarray(arr)
     key = (arr.shape, str(arr.dtype), _content_stamp(arr), tuple(axes), mesh)
-    hit = _PLACED_AUX_CACHE.pop(key, None)
-    if hit is not None:
-        _PLACED_AUX_CACHE[key] = hit  # LRU: a hit re-inserts at the back
-        return hit
+    with _PLACEMENT_LOCK:
+        hit = _PLACED_AUX_CACHE.pop(key, None)
+        if hit is not None:
+            _PLACED_AUX_CACHE[key] = hit  # LRU: a hit re-inserts at the back
+            return hit
     placed = place(arr, tuple(axes), mesh=mesh)
-    _PLACED_AUX_CACHE[key] = placed
-    while len(_PLACED_AUX_CACHE) > _PLACED_AUX_CACHE_MAX:
-        _PLACED_AUX_CACHE.pop(next(iter(_PLACED_AUX_CACHE)))
+    with _PLACEMENT_LOCK:
+        _PLACED_AUX_CACHE[key] = placed
+        while len(_PLACED_AUX_CACHE) > _PLACED_AUX_CACHE_MAX:
+            _PLACED_AUX_CACHE.pop(next(iter(_PLACED_AUX_CACHE)))
     return placed
 
 
@@ -384,14 +471,16 @@ def place_rows_bucketed_cached(arr: np.ndarray,
     # key on the Mesh OBJECT (hashable), not id(mesh): a recycled id after GC
     # could otherwise serve arrays sharded under a dead mesh (r3 advisor)
     key = (arr.shape, str(arr.dtype), _content_stamp(arr), mesh)
-    hit = _PLACED_ROWS_CACHE.pop(key, None)
-    if hit is not None:
-        _PLACED_ROWS_CACHE[key] = hit  # LRU: a hit re-inserts at the back
-        return hit
+    with _PLACEMENT_LOCK:
+        hit = _PLACED_ROWS_CACHE.pop(key, None)
+        if hit is not None:
+            _PLACED_ROWS_CACHE[key] = hit  # LRU: a hit re-inserts at the back
+            return hit
     padded, n_valid = pad_rows_bucketed_for_mesh(arr)[0], arr.shape[0]
     placed = place_rows(padded, mesh)
     if insert:
-        _PLACED_ROWS_CACHE[key] = (placed, n_valid)
-        while len(_PLACED_ROWS_CACHE) > _PLACED_ROWS_CACHE_MAX:
-            _PLACED_ROWS_CACHE.pop(next(iter(_PLACED_ROWS_CACHE)))
+        with _PLACEMENT_LOCK:
+            _PLACED_ROWS_CACHE[key] = (placed, n_valid)
+            while len(_PLACED_ROWS_CACHE) > _PLACED_ROWS_CACHE_MAX:
+                _PLACED_ROWS_CACHE.pop(next(iter(_PLACED_ROWS_CACHE)))
     return placed, n_valid

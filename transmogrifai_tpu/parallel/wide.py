@@ -95,7 +95,7 @@ def _col_stats_fn(mesh: Mesh):
         corr = cov / jnp.maximum(sx * sy, 1e-12)
         return mean, var, xmin, xmax, corr
 
-    return jax.jit(shard_map(
+    return jax.jit(shard_map(  # opcheck: allow(TM303) built once per mesh, lru_cache-memoized factory
         local_stats, mesh=mesh,
         in_specs=(P(None, DATA_AXIS), P()),
         out_specs=(P(DATA_AXIS),) * 5))
@@ -144,7 +144,7 @@ def _gram_ring_fn(mesh: Mesh):
         # blocks[j] = X_local^T X_j / n -> concat into the (d_local, d) block-row
         return jnp.concatenate([blocks[j] for j in range(k)], axis=1)
 
-    return jax.jit(shard_map(local_gram, mesh=mesh,
+    return jax.jit(shard_map(local_gram, mesh=mesh,  # opcheck: allow(TM303) built once per mesh, lru_cache-memoized factory
                              in_specs=(P(None, DATA_AXIS),),
                              out_specs=P(DATA_AXIS, None)))
 

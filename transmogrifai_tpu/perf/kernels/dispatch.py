@@ -165,6 +165,21 @@ def split_mode(per_lane_hist_bytes: int) -> Optional[str]:
     return _admit(4 * per_lane_hist_bytes)
 
 
+def route_mode(d: int, lanes: int, block_rows: int = 256) -> Optional[str]:
+    """Dispatch decision for the routing kernel (perf/kernels/routing.py):
+    the VMEM working set per grid step is the (block, d) code tile, the
+    (block, lanes) index/output tiles, and the (block, d, lanes)
+    compare-reduce temporaries — of which up to THREE are live at once
+    (the widened bool compare mask, its f32 cast, and the codes*oh product
+    before the reduce), so that term is charged 3x: undersizing admits a
+    kernel Mosaic then fails to allocate at compile time instead of taking
+    the silent XLA fallback (the hist_mode hazard)."""
+    ws = (block_rows * d * 8                    # codes (int32 in + f32 cast)
+          + 2 * block_rows * lanes * 4          # idx + routed output
+          + 3 * block_rows * d * lanes * 4)     # mask + one-hot + product
+    return _admit(ws)
+
+
 def encode_mode(width: int, block_rows: int = 1024) -> Optional[str]:
     """Dispatch decision for the serving encode kernels; degenerate widths
     stay on the XLA path (zero-column outputs are host-shape plumbing, not

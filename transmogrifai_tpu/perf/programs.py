@@ -137,12 +137,13 @@ def _static_item_sig(v) -> Any:
 
 
 def _mesh_sig():
-    from ..parallel.mesh import current_mesh
+    """Ambient-mesh component of every cache key: axis names/sizes PLUS the
+    process topology (``mesh_token``) — a 2-host x 4-device mesh and a
+    single-host 8-device mesh lower different collectives (DCN at the host
+    boundary), so their executables must never alias."""
+    from ..parallel.mesh import mesh_token
 
-    mesh = current_mesh()
-    if mesh is None:
-        return None
-    return (tuple(mesh.axis_names), tuple(np.asarray(mesh.devices).shape))
+    return mesh_token()
 
 
 def _make_key(fn, args, kwargs: Dict[str, Any], statics: Dict[str, Any],

@@ -112,8 +112,12 @@ def check_plan_admission(plan, hbm_budget: float) -> DiagnosticReport:
         return report  # all-host plan: no device buffers to admit
     cost = analyze_scoring_plan(plan)
     report.plan_cost = cost
+    # TM601 gates admission; TM609 (per-host replicated operands over the
+    # budget share — the pod-scale blocker) rides along as a warning when
+    # the plan was built under a mesh, so fleet operators see the scale-out
+    # ceiling at admission time instead of at the first multi-host deploy
     report.extend(d for d in cost_diagnostics(cost, hbm_budget=hbm_budget)
-                  if d.code == "TM601")
+                  if d.code in ("TM601", "TM609"))
     return report
 
 

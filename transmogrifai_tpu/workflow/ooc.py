@@ -157,7 +157,7 @@ def chunked_transform_epoch(cds: ChunkedDataset, runners: Sequence[Any],
     from ..readers.prefetch import PrefetchStats, prefetch_chunks
     from ..utils.listener import active_listeners
     from .plan import (check_plan_hbm_budget, fused_transforms_enabled,
-                       plan_for, run_host_stages)
+                       mesh_aligned_tile, plan_for, run_host_stages)
 
     runners = list(runners)
     if not runners:
@@ -180,6 +180,13 @@ def chunked_transform_epoch(cds: ChunkedDataset, runners: Sequence[Any],
 
     n_chunks = cds.n_chunks
     chunk_rows = cds.chunk_rows
+    # the dispatch tile is computed ONCE per epoch: the chunk quantum rounded
+    # up to the ambient mesh's data-axis multiple (mesh_aligned_tile — a
+    # no-op off-mesh and on meshes whose dp axis divides 8192).  Every chunk
+    # pads to THIS tile up front, so chunk boundaries under ``use_mesh`` hit
+    # one row-sharded executable — never a per-chunk re-pad inside _place,
+    # never a second bucket shape (regression-pinned in test_multihost.py)
+    tile = mesh_aligned_tile(chunk_rows)
     stats.chunks_total = n_chunks
     if hbm_budget is not None and plan is not None and n_chunks:
         # the admission gate sees the CHUNK tile — that is the program that
@@ -243,9 +250,9 @@ def chunked_transform_epoch(cds: ChunkedDataset, runners: Sequence[Any],
         for ci, ds_chunk in chunks:
             n = ds_chunk.n_rows
             if plan is not None:
-                padded = _pad_chunk(ds_chunk, chunk_rows) or ds_chunk
+                padded = _pad_chunk(ds_chunk, tile) or ds_chunk
                 try:
-                    out = plan.apply_prefix(padded)
+                    out = plan.apply_prefix(padded, tile=tile)
                 except Exception as e:  # noqa: BLE001 — fall back, stay correct
                     log.warning("chunked fused dispatch failed (%s: %s); "
                                 "host path for the rest of the epoch",

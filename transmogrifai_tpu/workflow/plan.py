@@ -98,6 +98,23 @@ def _transform_bucket(n: int) -> int:
     return -(-n // _TRANSFORM_BUCKET_CHUNK) * _TRANSFORM_BUCKET_CHUNK
 
 
+def mesh_aligned_tile(n: int) -> int:
+    """The fused-dispatch row tile for ``n`` rows: the pow2/8192 bucket,
+    rounded up to the ambient mesh's data-axis multiple so row sharding
+    splits evenly across the dp axis.  This is THE one place encoding the
+    bucket x mesh composition rule — the chunked epoch (workflow/ooc.py)
+    calls it ONCE per epoch so every chunk pads to the same mesh-divisible
+    tile up front (zero new executables across chunk boundaries on a mesh,
+    no per-chunk re-pad)."""
+    from ..parallel.mesh import DATA_AXIS, current_mesh
+
+    bucket = _transform_bucket(int(n))
+    mesh = current_mesh()
+    if mesh is not None:
+        bucket += (-bucket) % int(mesh.shape[DATA_AXIS])
+    return bucket
+
+
 def fused_transforms_enabled() -> bool:
     """Process-wide default for the fused transform path (TMOG_FUSED_TRANSFORM,
     on unless explicitly set to 0)."""
@@ -198,6 +215,7 @@ def stage_content_fingerprint(stages: Sequence[Any],
     counter, NOT id() — recycled ids would let a new plan inherit a dead
     plan's executables).
     """
+    from ..parallel.mesh import mesh_token
     from ..perf.kernels.dispatch import cache_token
     from ..stages.base import Estimator
     from .serde import _Encoder, encode_stage
@@ -212,6 +230,11 @@ def stage_content_fingerprint(stages: Sequence[Any],
             # bucketize stages trace to Pallas or XLA kernels depending on
             # it, so plans in different modes must never share executables
             "kernels": cache_token(),
+            # ambient mesh + process topology (parallel/mesh.py): the fused
+            # prefix bakes its sharding annotations at trace time, so a
+            # multi-host plan must never alias a single-host plan of the
+            # same fitted content (same rule run_cached keys enforce)
+            "mesh": mesh_token(),
         }
         h = hashlib.sha256(
             json.dumps(payload, sort_keys=True, default=repr).encode())
@@ -344,6 +367,14 @@ class ColumnarTransformPlan:
             | {name for (_r, _s, name) in self._entry_encoders.values()})
 
     def _fused(self, *entries):
+        # dp x mp: every entry is a row block — pin rows to the data axis so
+        # the fused prefix stays shard-local end to end (device transforms
+        # are row-local by contract, so a correctly annotated prefix lowers
+        # with NO collectives; the TM608 scalability pass asserts that).
+        # Identity when traced without an ambient mesh.
+        from ..parallel.mesh import constrain_rows
+
+        entries = [constrain_rows(e) for e in entries]
         env: Dict[str, Any] = {}
         for runner, srcs, out_uid in self._wiring:
             ops = [env[key] if tag == "env" else entries[key]
@@ -409,31 +440,34 @@ class ColumnarTransformPlan:
                     runner.encode_device_input(slot, dataset[name])))
         return out
 
-    def _place(self, entries: List[np.ndarray], n: int):
-        """Bucket+mesh pad the row axis and place with row sharding."""
+    def _place(self, entries: List[np.ndarray], n: int,
+               tile: Optional[int] = None):
+        """Bucket+mesh pad the row axis and place with row sharding.
+
+        ``tile`` overrides the bucket with a caller-computed row tile (the
+        chunked epoch computes its mesh-aligned tile ONCE and pads every
+        chunk to it up front, so chunk boundaries hit one executable with no
+        per-chunk re-pad here)."""
         from ..parallel.mesh import current_mesh, pad_axis, place_rows
 
-        bucket = _transform_bucket(n)
+        bucket = int(tile) if tile is not None else mesh_aligned_tile(n)
         mesh = current_mesh()
-        if mesh is not None:
-            from ..parallel.mesh import DATA_AXIS
-
-            mult = mesh.shape[DATA_AXIS]
-            bucket += (-bucket) % mult
         placed = [place_rows(pad_axis(e, 0, bucket)[0]
-                             if e.shape[0] != bucket else e, mesh)[0]
+                             if e.shape[0] != bucket else e, mesh)
                   if mesh is not None else
                   pad_axis(e, 0, bucket)[0]
                   for e in entries]
         return placed, bucket
 
-    def apply_prefix(self, dataset: Dataset) -> Dataset:
+    def apply_prefix(self, dataset: Dataset,
+                     tile: Optional[int] = None) -> Dataset:
         """Run ONLY the fused device prefix, appending its output columns.
 
         The host remainder belongs to the caller: the plan cache keys on
         prefix content alone, so a cached plan's own ``_remainder`` list may
         hold stale stage objects from an earlier train of the same prep —
-        callers must run their CURRENT remainder runners.
+        callers must run their CURRENT remainder runners.  ``tile`` pins the
+        row bucket (the chunked epoch's pre-aligned chunk tile).
         """
         import jax
 
@@ -446,7 +480,7 @@ class ColumnarTransformPlan:
         n = dataset.n_rows
         with phase("transform.fused_plan"):
             entries = self._host_entries(dataset)
-            placed, _bucket = self._place(entries, n)
+            placed, _bucket = self._place(entries, n, tile=tile)
             if self._jitted is None:
                 self._jitted = jax.jit(self._fused)  # opcheck: allow(TM303) built once per plan, memoized on self._jitted
             outs = run_cached(self._jitted, *placed,
