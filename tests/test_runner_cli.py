@@ -503,6 +503,68 @@ def build():
         assert r.returncode == 1
         assert "TM306" in r.stdout
 
+    def test_threads_json_round_trip(self, tmp_path):
+        """Satellite (ISSUE 16): ``--threads --format json`` emits exactly
+        one ``{"threadModel": ...}`` summary line plus one TM31x diagnostic
+        per line, all parseable — the threads-gate contract."""
+        p = tmp_path / "racy.py"
+        p.write_text(
+            "import threading\n\n"
+            "class Counter:\n"
+            "    def __init__(self):\n"
+            "        self._n = 0\n"
+            "        self._t = threading.Thread(target=self._run)\n\n"
+            "    def _run(self):\n"
+            "        self._n += 1\n\n"
+            "    def bump(self):\n"
+            "        self._n += 1\n")
+        r = self._lint("--path", str(p), "--threads", "--format", "json",
+                       "--fail-on", "error")
+        assert r.returncode == 1, r.stdout + r.stderr
+        lines = [json.loads(ln) for ln in r.stdout.strip().splitlines()]
+        models = [obj for obj in lines if "threadModel" in obj]
+        diags = [obj for obj in lines if "code" in obj]
+        assert len(models) == 1, r.stdout
+        model = models[0]["threadModel"]
+        assert {"threads", "sharedClasses", "waiters", "callbacks",
+                "lockOrderEdges", "analyzedFiles"} <= set(model)
+        assert model["threads"][0]["target"] == "Counter._run"
+        assert model["sharedClasses"] == ["Counter"]
+        assert model["analyzedFiles"] == 1
+        # the summary line comes FIRST (gates stream-parse diagnostics)
+        assert "threadModel" in lines[0]
+        assert diags, r.stdout
+        for obj in diags:
+            assert {"code", "severity", "stageUid", "location",
+                    "message"} <= set(obj)
+            assert obj["code"] == "TM312"
+            assert obj["severity"] == "error"
+
+    def test_threads_clean_surface_exits_zero(self, tmp_path):
+        p = tmp_path / "fine.py"
+        p.write_text(
+            "import threading\n\n"
+            "class Counter:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0\n"
+            "        self._t = threading.Thread(target=self._run)\n\n"
+            "    def _run(self):\n"
+            "        with self._lock:\n"
+            "            self._n += 1\n\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._n += 1\n")
+        r = self._lint("--path", str(p), "--threads", "--format", "json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        lines = [json.loads(ln) for ln in r.stdout.strip().splitlines()]
+        assert len(lines) == 1 and "threadModel" in lines[0], r.stdout
+
+    def test_threads_without_path_refuses(self):
+        r = self._lint("--threads")
+        assert r.returncode != 0
+        assert "nothing to lint" in r.stderr
+
 
 class TestCliLintCost:
     """``cli lint --cost`` (ISSUE 6 tentpole): the PlanCostReport from the

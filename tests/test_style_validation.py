@@ -154,6 +154,39 @@ class TestStyleValidation:
             "intentional ones inline with '# opcheck: allow(TMxxx) reason'):\n"
             + "\n".join(findings))
 
+    def test_self_hosted_threads_gate(self):
+        """ISSUE 16 acceptance gate: the TM31x whole-program concurrency
+        analyzer (checkers/threadcheck.py) runs over the full threaded
+        surface — every finding is either fixed or suppressed inline with a
+        justified ``# opcheck: allow(TM31x)`` marker, so the gate starts and
+        stays green.  The thread-model assertions keep the gate honest: a
+        discovery regression that stopped seeing the background threads
+        would otherwise turn this into a green nothing."""
+        from transmogrifai_tpu.checkers.threadcheck import analyze_files
+
+        paths = []
+        for sub in ("serve", "obs", "parallel", "perf", "perf/kernels",
+                    "checkers"):
+            d = os.path.join(PKG_ROOT, sub)
+            paths += sorted(os.path.join(d, f) for f in os.listdir(d)
+                            if f.endswith(".py"))
+        paths += [os.path.join(PKG_ROOT, "workflow", "continual.py"),
+                  os.path.join(PKG_ROOT, "readers", "prefetch.py"),
+                  os.path.join(PKG_ROOT, "data", "chunked.py")]
+        analysis = analyze_files(paths)
+        findings = [f"{os.path.relpath(f.filename, PKG_ROOT)}:{f.lineno} "
+                    f"{f.code} {f.qualname}: {f.message}"
+                    for f in analysis.findings]
+        assert not findings, (
+            "unallowlisted TM31x concurrency findings (fix them, or mark "
+            "justified ones inline with '# opcheck: allow(TM31x) reason'):\n"
+            + "\n".join(findings))
+        model = analysis.model.to_dict()
+        targets = {t["target"] for t in model["threads"]}
+        assert {"MicroBatcher._run", "SwappableScorer._shadow_worker",
+                "ChunkPrefetcher._run"} <= targets, targets
+        assert len(model["lockOrderEdges"]) >= 3, model["lockOrderEdges"]
+
     def test_concurrency_rule_sees_through_the_caches(self):
         """The TM306 heuristic itself must keep WORKING on the real caches:
         stripping the lock from a known-locked mutation makes it fire.  (A
@@ -181,15 +214,16 @@ class TestStyleValidation:
         """Stale-marker guard: every inline ``opcheck: allow`` marker must sit
         in a file whose unsuppressed lint would actually fire — a marker that
         no longer suppresses anything should be deleted.  Re-lints with the
-        WIDEST rule set (every function + the TM306 concurrency rule), since
-        serve//perf/ markers may suppress findings outside the default
-        hazard-function gate."""
+        WIDEST rule set (every function + the TM306 concurrency rule + the
+        TM31x thread analyzer), since serve//perf/ markers may suppress
+        findings outside the default hazard-function gate."""
         import re
 
         from transmogrifai_tpu.checkers.opcheck import (
             lint_module_concurrency,
             lint_source,
         )
+        from transmogrifai_tpu.checkers.threadcheck import analyze_source
 
         marker = re.compile(r"opcheck:\s*allow\(TM\d{3}")  # same shape _ALLOW_RE accepts
         for root, _dirs, files in os.walk(PKG_ROOT):
@@ -217,6 +251,9 @@ class TestStyleValidation:
                 fired |= {fi.lineno for fi in
                           lint_module_concurrency(stripped, filename=path,
                                                   tree=tree)}
+                fired |= {fi.lineno for fi in
+                          analyze_source(stripped, filename=path,
+                                         tree=tree).findings}
                 stale = [ln for ln in marked if ln not in fired]
                 assert not stale, \
                     f"{path}: stale opcheck allow markers at lines {stale}"

@@ -1,0 +1,599 @@
+"""TM31x concurrency analyzer (ISSUE 16 tentpole): lockset/guarded-by
+inference, lock-order deadlock graph, and blocking-under-lock detection
+(checkers/threadcheck.py).
+
+Discipline mirrored from test_plancheck.py: every seeded fixture fires
+exactly its own code, every quiet fixture stays silent, and the whole
+analysis is pure AST work — the compile probe must read ZERO backend
+compiles across a full self-host pass.  The regression tests at the bottom
+pin the real races this analyzer surfaced in the serving stack (prefetch
+stats accumulators, flight-recorder counter snapshot, fault-harness
+schedule edits) as behavioral tests, not just lint assertions.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from transmogrifai_tpu.checkers.threadcheck import (
+    analyze_files,
+    analyze_source,
+    module_global_findings,
+)
+from transmogrifai_tpu.perf import measure_compiles
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "transmogrifai_tpu")
+
+
+def codes(src, filename="fixture.py"):
+    return sorted({f.code for f in analyze_source(src, filename).findings})
+
+
+# ---------------------------------------------------------------------------
+# seeded one-shot fixtures: each fires exactly its own code
+# ---------------------------------------------------------------------------
+
+TM311_FIXTURE = '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._total = self._total + 1
+
+    def snapshot(self):
+        return self._total
+'''
+
+TM312_FIXTURE = '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._n = 0
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        self._n += 1
+
+    def bump(self):
+        self._n += 1
+'''
+
+TM313_FIXTURE = '''
+import threading
+
+_A_LOCK = threading.Lock()
+_B_LOCK = threading.Lock()
+
+def forward():
+    with _A_LOCK:
+        with _B_LOCK:
+            pass
+
+def backward():
+    with _B_LOCK:
+        with _A_LOCK:
+            pass
+'''
+
+TM314_FIXTURE = '''
+import threading
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._num = 0.0
+        self._den = 1.0
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self._num = 1.0
+            self._den = 2.0
+
+    def ratio(self):
+        return self._num / self._den
+'''
+
+TM315_FIXTURE = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            pass
+
+    def stop(self):
+        with self._lock:
+            self._thread.join()
+'''
+
+SEEDED = {
+    "TM311": TM311_FIXTURE,
+    "TM312": TM312_FIXTURE,
+    "TM313": TM313_FIXTURE,
+    "TM314": TM314_FIXTURE,
+    "TM315": TM315_FIXTURE,
+}
+
+
+@pytest.mark.parametrize("code", sorted(SEEDED))
+def test_seeded_fixture_fires_exactly_its_own_code(code):
+    assert codes(SEEDED[code]) == [code]
+
+
+def test_seeded_fixtures_carry_both_sites():
+    """TM311/TM314 messages name the guarded counter-site, TM313 the full
+    cycle path with per-edge sites, TM315 the held lock."""
+    f311 = analyze_source(TM311_FIXTURE, "f.py").findings[0]
+    assert "line" in f311.message and "Counter._lock" in f311.message
+    f313 = analyze_source(TM313_FIXTURE, "f.py").findings[0]
+    assert "f:_A_LOCK" in f313.message and "f:_B_LOCK" in f313.message
+    f315 = analyze_source(TM315_FIXTURE, "f.py").findings[0]
+    assert "Worker._lock" in f315.message
+
+
+# ---------------------------------------------------------------------------
+# quiet-on-correct-code fixtures: the fixed version of each hazard is silent
+# ---------------------------------------------------------------------------
+
+QUIET = {
+    # TM311: every access of the shared attr holds the guard
+    "TM311": '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._total = self._total + 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._total
+''',
+    # TM312: the read-modify-write takes a lock on both sides
+    "TM312": '''
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self._n += 1
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+''',
+    # TM313: both paths honor one global acquisition order
+    "TM313": '''
+import threading
+
+_A_LOCK = threading.Lock()
+_B_LOCK = threading.Lock()
+
+def forward():
+    with _A_LOCK:
+        with _B_LOCK:
+            pass
+
+def also_forward():
+    with _A_LOCK:
+        with _B_LOCK:
+            pass
+''',
+    # TM314: the multi-field read snapshots under the writers' lock
+    "TM314": '''
+import threading
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._num = 0.0
+        self._den = 1.0
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self._num = 1.0
+            self._den = 2.0
+
+    def ratio(self):
+        with self._lock:
+            return self._num / self._den
+''',
+    # TM315: the join happens after the lock is released
+    "TM315": '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            pass
+
+    def stop(self):
+        with self._lock:
+            pass
+        self._thread.join()
+''',
+}
+
+
+@pytest.mark.parametrize("code", sorted(QUIET))
+def test_quiet_fixture_is_silent(code):
+    assert codes(QUIET[code]) == []
+
+
+# ---------------------------------------------------------------------------
+# analyzer semantics worth pinning individually
+# ---------------------------------------------------------------------------
+
+def test_condition_aliasing_no_false_positive():
+    """``Condition(self._lock)`` canonicalizes to the underlying lock, so a
+    ``with self._cond:`` access site counts as holding ``_lock``."""
+    src = '''
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._items = []
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._cond:
+            self._items.append(1)
+
+    def drain(self):
+        with self._lock:
+            out = list(self._items)
+            self._items.clear()
+        return out
+'''
+    assert codes(src) == []
+
+
+def test_caller_holds_lock_helper_suffix():
+    """A ``*_locked`` helper is analyzed as entered with the primary lock
+    held (the repo's documented caller-holds-lock convention)."""
+    src = '''
+import threading
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self._n += 1
+
+    def read(self):
+        with self._lock:
+            return self._n
+'''
+    assert codes(src) == []
+
+
+def test_init_construction_happens_before_excluded():
+    """Unlocked writes in ``__init__`` AND in private helpers called only
+    from it never count: construction happens-before any second thread."""
+    src = '''
+import threading
+
+class Plan:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = []
+        self._build()
+
+    def _build(self):
+        self._entries.append(1)
+        self._entries.append(2)
+
+    def read(self):
+        with self._lock:
+            return list(self._entries)
+
+    def grow(self):
+        with self._lock:
+            self._entries.append(3)
+'''
+    assert codes(src) == []
+
+
+def test_declared_concurrent_class_without_own_thread():
+    """RacerD's declared-concurrency assumption: a class that constructs its
+    own lock is analyzed even with no ``Thread(target=...)`` of its own."""
+    src = '''
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        self._n += 1
+
+    def read(self):
+        with self._lock:
+            return self._n
+'''
+    assert codes(src) == ["TM312"]
+
+
+def test_loop_header_reads_are_access_sites():
+    """``for x in self._items:`` is a read of the shared list (the gap that
+    originally hid the fault-harness ``_rules`` race)."""
+    src = '''
+import threading
+
+class H:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules = []
+
+    def add(self, r):
+        self._rules.append(r)
+
+    def check(self):
+        with self._lock:
+            for r in self._rules:
+                pass
+'''
+    assert codes(src) == ["TM312"]
+
+
+def test_lock_order_cycle_across_modules():
+    """TM313 edges merge across files: each module alone is cycle-free."""
+    fwd = '''
+import threading
+from locks import A_LOCK, B_LOCK
+
+def forward():
+    with A_LOCK:
+        with B_LOCK:
+            pass
+'''
+    bwd = '''
+import threading
+from locks import A_LOCK, B_LOCK
+
+def backward():
+    with B_LOCK:
+        with A_LOCK:
+            pass
+'''
+    import ast
+    from transmogrifai_tpu.checkers.threadcheck import analyze_parsed
+
+    one = analyze_parsed([(fwd, "fwd.py", ast.parse(fwd))])
+    assert [f.code for f in one.findings] == []
+    both = analyze_parsed([(fwd, "fwd.py", ast.parse(fwd)),
+                           (bwd, "bwd.py", ast.parse(bwd))])
+    assert sorted({f.code for f in both.findings}) == ["TM313"]
+
+
+def test_inline_allow_marker_suppresses():
+    src = TM312_FIXTURE.replace(
+        "self._n += 1\n\n    def bump",
+        "self._n += 1  # opcheck: allow(TM312) single-writer by design\n\n"
+        "    def bump")
+    found = codes(src)
+    # only the un-marked bump() site remains
+    assert found == ["TM312"]
+    all_marked = TM312_FIXTURE.replace(
+        "self._n += 1",
+        "self._n += 1  # opcheck: allow(TM312) single-writer by design")
+    assert codes(all_marked) == []
+
+
+def test_tm306_delegation_identical_through_both_entry_points():
+    """opcheck.lint_module_concurrency is a delegate of the threadcheck
+    engine: same findings, same code, same allow-marker handling."""
+    from transmogrifai_tpu.checkers.opcheck import lint_module_concurrency
+
+    src = '''
+import threading
+
+_CACHE = {}
+_LOCK = threading.Lock()
+
+def racy(key, value):
+    _CACHE[key] = value
+
+def safe(key, value):
+    with _LOCK:
+        _CACHE[key] = value
+'''
+    a = [(f.code, f.qualname, f.lineno) for f in
+         lint_module_concurrency(src, filename="m.py")]
+    b = [(f.code, f.qualname, f.lineno) for f in
+         module_global_findings(src, filename="m.py")]
+    assert a == b
+    assert [c for c, _q, _l in a] == ["TM306"]
+
+
+# ---------------------------------------------------------------------------
+# self-host: the analyzer over its own serving stack, at zero compiles
+# ---------------------------------------------------------------------------
+
+def _threaded_surface_paths():
+    paths = []
+    for d in ("serve", "obs", "parallel", "perf", os.path.join("perf",
+              "kernels"), "checkers"):
+        full = os.path.join(PKG, d)
+        paths += sorted(os.path.join(full, f) for f in os.listdir(full)
+                        if f.endswith(".py"))
+    paths += [os.path.join(PKG, "workflow", "continual.py"),
+              os.path.join(PKG, "readers", "prefetch.py"),
+              os.path.join(PKG, "data", "chunked.py")]
+    return paths
+
+
+def test_self_host_zero_findings_at_zero_compiles():
+    """The acceptance gate: the full threaded surface analyzes clean (every
+    finding fixed or justified inline) and the probe reads 0 compiles."""
+    with measure_compiles() as c:
+        analysis = analyze_files(_threaded_surface_paths())
+    assert c.backend_compiles == 0
+    assert analysis.findings == [], [
+        f"{f.code} {f.filename}:{f.lineno} {f.message}"
+        for f in analysis.findings]
+
+
+def test_self_host_thread_model_is_nontrivial():
+    """Discovery must actually see the serving stack's structure — a model
+    that found nothing would mean the gate gates nothing."""
+    model = analyze_files(_threaded_surface_paths()).model.to_dict()
+    targets = {t["target"] for t in model["threads"]}
+    assert {"MicroBatcher._run", "SwappableScorer._shadow_worker",
+            "ChunkPrefetcher._run"} <= targets
+    assert {"MicroBatcher", "SwappableScorer",
+            "ChunkPrefetcher"} <= set(model["sharedClasses"])
+    edges = {tuple(e) for e in model["lockOrderEdges"]}
+    assert ("ModelRegistry._admission_lock",
+            "ModelRegistry._lock") in edges
+    assert len(edges) >= 3
+
+
+# ---------------------------------------------------------------------------
+# regression tests for the races the analyzer surfaced (ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+def test_prefetch_stats_concurrent_accumulation_is_exact():
+    """TM312 fix: PrefetchStats accumulators are lock-guarded, so no
+    increment is lost under worker/consumer contention."""
+    from transmogrifai_tpu.readers.prefetch import PrefetchStats
+
+    stats = PrefetchStats()
+    N, K = 8, 500
+
+    def worker():
+        for _ in range(K):
+            stats.add_load(0.001)
+            stats.add_wait(0.0005, stalled=True)
+            stats.add_chunk()
+
+    threads = [threading.Thread(target=worker) for _ in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = stats.to_dict()
+    assert snap["chunks"] == N * K
+    assert snap["stalls"] == N * K
+    assert snap["load_seconds"] == pytest.approx(N * K * 0.001)
+    assert snap["wait_seconds"] == pytest.approx(N * K * 0.0005)
+
+
+def test_flight_payload_counters_consistent_with_events():
+    """TM314 fix: to_payload snapshots dropped/unexpected_compiles under the
+    same lock as the event ring, so ``dropped == last seq - len(events)``
+    holds in EVERY concurrent snapshot (stale unlocked counter reads used to
+    break it)."""
+    from transmogrifai_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=4)
+    stop = threading.Event()
+    bad = []
+
+    def hammer():
+        while not stop.is_set():
+            rec.record("tick")
+
+    def snapshot():
+        while not stop.is_set():
+            p = rec.to_payload()
+            if p["events"]:
+                want = p["events"][-1]["seq"] - len(p["events"])
+                if p["dropped"] != want:
+                    bad.append((p["dropped"], want))
+
+    writers = [threading.Thread(target=hammer) for _ in range(3)]
+    reader = threading.Thread(target=snapshot)
+    for t in writers + [reader]:
+        t.start()
+    time.sleep(0.3)
+    stop.set()
+    for t in writers + [reader]:
+        t.join()
+    assert not bad, f"torn payload snapshots: {bad[:5]}"
+
+
+def test_fault_harness_schedule_edits_race_free():
+    """TM312 fix: script()/fail_when() take the harness lock, so schedule
+    edits concurrent with firing lose no entries."""
+    from transmogrifai_tpu.serve.faults import FaultHarness
+
+    h = FaultHarness()
+    N, K = 4, 200
+
+    def scripter(i):
+        for k in range(K):
+            h.script(f"point-{i}", [None])
+            h.fail_when(f"point-{i}", lambda ctx: False, RuntimeError,
+                        times=1)
+
+    def firer():
+        for _ in range(N * K):
+            h._check("point-0", {})
+
+    threads = [threading.Thread(target=scripter, args=(i,))
+               for i in range(N)] + [threading.Thread(target=firer)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(h._scripts) == N
+    for i in range(N):
+        assert len(h._scripts[f"point-{i}"]) == K
+    assert len(h._rules) == N * K
+    assert h.calls["point-0"] == N * K
+
+
+def test_fixed_race_sites_stay_clean():
+    """The five modules this PR de-raced analyze clean individually — a
+    revert of any fix re-fires its TM31x code here, next to the fix."""
+    fixed = [os.path.join(PKG, "readers", "prefetch.py"),
+             os.path.join(PKG, "obs", "flight.py"),
+             os.path.join(PKG, "serve", "faults.py"),
+             os.path.join(PKG, "serve", "plan.py"),
+             os.path.join(PKG, "serve", "registry.py")]
+    analysis = analyze_files(fixed)
+    assert analysis.findings == [], [
+        f"{f.code} {f.filename}:{f.lineno}" for f in analysis.findings]

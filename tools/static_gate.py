@@ -7,7 +7,11 @@ Runs, in order:
    target needed (the builtin program-family corpus is self-contained);
 2. **lint_gate** (tools/lint_gate.py): the TM1xx-TM6xx diagnostic gate —
    when lint arguments are provided after ``--`` (it needs a --workflow /
-   --model / --path target).
+   --model / --path target);
+3. **threads gate** (``--threads``): the TM31x whole-program concurrency
+   analyzer (checkers/threadcheck.py) over the repo's threaded serving
+   surface (THREADED_SURFACE), through lint_gate's same new-errors-only
+   contract against ``tools/threads_baseline.json``.
 
 One merged exit-code contract, inherited from both gates: rc **1** only when
 either gate finds a NEW error-severity diagnostic relative to its baseline;
@@ -45,6 +49,19 @@ import lint_gate  # noqa: E402
 #: forms (and their TM705-absence proof) drift unreviewed.
 REQUIRED_FAMILY_MARKERS = ("@mesh4x2", "@interpret", "@chunk")
 
+#: the threaded serving surface the ``--threads`` gate lints (ISSUE 16):
+#: every module that owns a lock, a background thread, or state those reach
+THREADED_SURFACE = (
+    "transmogrifai_tpu/serve",
+    "transmogrifai_tpu/obs",
+    "transmogrifai_tpu/parallel",
+    "transmogrifai_tpu/perf",
+    "transmogrifai_tpu/checkers",
+    "transmogrifai_tpu/workflow/continual.py",
+    "transmogrifai_tpu/readers/prefetch.py",
+    "transmogrifai_tpu/data/chunked.py",
+)
+
 
 def check_required_families(goldens_dir: str) -> int:
     """rc 1 when the corpus index no longer holds any family for one of the
@@ -78,6 +95,13 @@ def main(argv=None) -> int:
                     help="lint_gate baseline file")
     ap.add_argument("--skip-ir", action="store_true",
                     help="skip the IR corpus gate")
+    ap.add_argument("--threads", action="store_true",
+                    help="run the TM31x concurrency gate over the threaded "
+                         "serving surface (new-errors-only vs "
+                         "--threads-baseline)")
+    ap.add_argument("--threads-baseline",
+                    default="tools/threads_baseline.json",
+                    help="threads-gate baseline file")
     ap.add_argument("--goldens", default=None, metavar="DIR",
                     help="golden IR corpus directory forwarded to ir_gate")
     ap.add_argument("lint_args", nargs=argparse.REMAINDER,
@@ -106,13 +130,33 @@ def main(argv=None) -> int:
                                   *lint_args])
         print(f"static_gate: lint_gate rc={rc_lint}")
         rc = max(rc, rc_lint)
-    elif ns.skip_ir:
-        # both halves disabled: refuse to report a green nothing
-        raise SystemExit("static_gate: --skip-ir with no lint args runs "
-                         "NO gate — refusing to exit 0")
+    elif ns.skip_ir and not ns.threads:
+        # every gate disabled: refuse to report a green nothing
+        raise SystemExit("static_gate: --skip-ir with no lint args and no "
+                         "--threads runs NO gate — refusing to exit 0")
     else:
         print("static_gate: no lint args — lint_gate skipped "
               "(pass `-- --workflow ... --path ...` to enable)")
+
+    if ns.threads:
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        surface = [os.path.join(repo_root, p) for p in THREADED_SURFACE]
+        missing = [p for p in surface if not os.path.exists(p)]
+        if missing:
+            # a renamed module must shrink THREADED_SURFACE consciously,
+            # not silently drop out of the gate
+            raise SystemExit("static_gate: threads surface missing: "
+                             + ", ".join(missing))
+        threads_args = []
+        for p in surface:
+            threads_args += ["--path", p]
+        threads_args.append("--threads")
+        print("static_gate: running threads gate (TM31x) ...")
+        rc_thr = lint_gate.main(["--baseline", ns.threads_baseline, "--",
+                                 *threads_args])
+        print(f"static_gate: threads gate rc={rc_thr}")
+        rc = max(rc, rc_thr)
 
     print(f"static_gate: {'FAIL' if rc else 'OK'} (rc={rc})")
     return rc

@@ -40,6 +40,12 @@ from .plancheck import (
     cost_diagnostics,
     trace_cost,
 )
+from .threadcheck import (
+    ThreadAnalysis,
+    ThreadModel,
+    analyze_files,
+    analyze_source,
+)
 
 __all__ = [
     "DIAGNOSTIC_CODES",
@@ -54,7 +60,11 @@ __all__ = [
     "RecompileHazard",
     "SegmentCost",
     "Severity",
+    "ThreadAnalysis",
+    "ThreadModel",
+    "analyze_files",
     "analyze_scoring_plan",
+    "analyze_source",
     "analyze_transform",
     "analyze_transform_plan",
     "build_corpus",

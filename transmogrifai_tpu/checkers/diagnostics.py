@@ -115,6 +115,29 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "it — wrap the mutation in `with <lock>:`, or mark a "
               "single-threaded-by-design site with an inline opcheck "
               "allow marker for TM306"),
+    # -- concurrency (TM31x threadcheck analyzer, cli lint --threads) --------
+    "TM311": (Severity.ERROR, "inconsistent lockset on shared attribute",
+              "the attribute is accessed both under and outside its inferred "
+              "guard lock; hoist the unguarded access into `with <lock>:` "
+              "(or justify a benign pattern like double-checked locking with "
+              "an inline opcheck allow marker for TM311)"),
+    "TM312": (Severity.ERROR, "unlocked read-modify-write on shared state",
+              "a `+=`/in-place mutation of a thread-shared attribute or "
+              "module global holds no lock, so concurrent updates lose "
+              "increments; wrap the read-modify-write in `with <lock>:`"),
+    "TM313": (Severity.ERROR, "lock-order cycle (potential deadlock)",
+              "two lock acquisitions nest in opposite orders on different "
+              "call paths; pick one global order (or collapse to a single "
+              "lock) so no cycle remains in the acquired-while-held graph"),
+    "TM314": (Severity.WARNING, "torn multi-field read of guarded state",
+              "a single statement reads several attributes that writers "
+              "update together under a lock; take the same lock around the "
+              "multi-field read so it cannot observe a half-applied update"),
+    "TM315": (Severity.WARNING, "blocking call under a held lock",
+              "a potentially unbounded wait (queue get/put, Thread.join, "
+              "future.result, Condition.wait on a different lock, device "
+              "sync) runs while holding a lock, stalling every other "
+              "acquirer; move the wait outside the `with` block"),
     # -- servability (serving path, opt-in via validate(serving=True)) ------
     "TM501": (Severity.ERROR, "unfitted estimator in scoring path",
               "train the workflow (or warm-start the missing stage) before "

@@ -836,88 +836,6 @@ def _looks_like_lock(expr: ast.AST) -> bool:
     return "lock" in chain.rsplit(".", 1)[-1].lower()
 
 
-class _ConcurrencyLinter(ast.NodeVisitor):
-    """Flags read-modify-writes of module-level mutables outside any
-    ``with <lock>:`` block inside one function body."""
-
-    def __init__(self, mutables: Set[str], qualname: str, filename: str,
-                 lines: List[str]):
-        self.mutables = mutables
-        self.qualname = qualname
-        self.filename = filename
-        self.lines = lines
-        self.lock_depth = 0
-        self.findings: List[LintFinding] = []
-
-    def _flag(self, node: ast.AST, name: str, how: str) -> None:
-        if self.lock_depth > 0:
-            return
-        f = LintFinding(
-            code="TM306",
-            message=f"module-level mutable {name!r} {how} outside a "
-                    "threading lock; concurrent callers race on it",
-            qualname=self.qualname, filename=self.filename,
-            lineno=getattr(node, "lineno", 0))
-        lineno = f.lineno
-        if 0 < lineno <= len(self.lines):
-            m = _ALLOW_RE.search(self.lines[lineno - 1])
-            if m and "TM306" in m.group(1):
-                return
-        self.findings.append(f)
-
-    def visit_With(self, node: ast.With) -> None:
-        locky = any(_looks_like_lock(item.context_expr)
-                    for item in node.items)
-        if locky:
-            self.lock_depth += 1
-        self.generic_visit(node)
-        if locky:
-            self.lock_depth -= 1
-
-    visit_AsyncWith = visit_With
-
-    def _target_mutable(self, target: ast.AST) -> Optional[str]:
-        if isinstance(target, ast.Subscript) \
-                and isinstance(target.value, ast.Name) \
-                and target.value.id in self.mutables:
-            return target.value.id
-        return None
-
-    def visit_Assign(self, node: ast.Assign) -> None:
-        for t in node.targets:
-            name = self._target_mutable(t)
-            if name:
-                self._flag(node, name, "item-assigned")
-        self.generic_visit(node)
-
-    def visit_AugAssign(self, node: ast.AugAssign) -> None:
-        name = self._target_mutable(node.target)
-        # `_CACHE |= d` / `_CACHE += [...]` on the bare name mutates the
-        # container in place — the same race as `.update()`/`.extend()`
-        if name is None and isinstance(node.target, ast.Name) \
-                and node.target.id in self.mutables:
-            name = node.target.id
-        if name:
-            self._flag(node, name, "augmented-assigned")
-        self.generic_visit(node)
-
-    def visit_Delete(self, node: ast.Delete) -> None:
-        for t in node.targets:
-            name = self._target_mutable(t)
-            if name:
-                self._flag(node, name, "item-deleted")
-        self.generic_visit(node)
-
-    def visit_Call(self, node: ast.Call) -> None:
-        func = node.func
-        if isinstance(func, ast.Attribute) \
-                and func.attr in _MUTATOR_METHODS \
-                and isinstance(func.value, ast.Name) \
-                and func.value.id in self.mutables:
-            self._flag(node, func.value.id, f"mutated via .{func.attr}()")
-        self.generic_visit(node)
-
-
 def lint_module_concurrency(source: str, filename: str = "<string>",
                             tree: Optional[ast.AST] = None
                             ) -> List[LintFinding]:
@@ -929,32 +847,17 @@ def lint_module_concurrency(source: str, filename: str = "<string>",
     Only mutations inside function bodies are flagged — module top-level
     mutation runs once, single-threaded, at import time.  ``tree`` reuses an
     already-parsed AST of ``source``.
+
+    The rule is a DELEGATE: its engine (mutable-global discovery, the
+    with-lock scope tracker, the allow-marker check) lives in the TM31x
+    concurrency analyzer (checkers/threadcheck.py) so the shallow
+    module-global rule and the class-level lockset rules cannot drift.
+    The import is lazy to keep threadcheck -> opcheck the only module-level
+    import direction between the two.
     """
-    if tree is None:
-        tree = ast.parse(source, filename=filename)
-    lines = source.splitlines()
-    mutables: Set[str] = set()
-    for node in tree.body:
-        targets: List[ast.AST] = []
-        if isinstance(node, ast.Assign):
-            targets, value = node.targets, node.value
-        elif isinstance(node, ast.AnnAssign) and node.value is not None:
-            targets, value = [node.target], node.value
-        else:
-            continue
-        if _is_mutable_ctor(value):
-            for t in targets:
-                if isinstance(t, ast.Name):
-                    mutables.add(t.id)
-    if not mutables:
-        return []
-    out: List[LintFinding] = []
-    for qualname, fn in _iter_functions(tree):
-        linter = _ConcurrencyLinter(mutables, qualname, filename, lines)
-        for stmt in fn.body:
-            linter.visit(stmt)
-        out.extend(linter.findings)
-    return out
+    from .threadcheck import module_global_findings
+
+    return module_global_findings(source, filename=filename, tree=tree)
 
 
 def lint_file_concurrency(path: str) -> List[LintFinding]:

@@ -10,7 +10,9 @@ Modes, combinable:
 - ``--path FILE_OR_DIR``  AST-lints python sources for JAX hazards (TM3xx) in
   ``transform_columns``/``fit_columns``/``device_transform`` bodies
   (``--all-functions`` widens to every function; ``--concurrency`` adds the
-  TM306 unsynchronized-module-state rule).
+  TM306 unsynchronized-module-state rule; ``--threads`` runs the TM31x
+  whole-program concurrency analyzer — lockset inference, lock-order
+  deadlock graph, blocking-under-lock — over ALL --path files at once).
 - ``--workflow module:attr``  imports ``attr`` from ``module`` (a Workflow, a
   fitted WorkflowModel, a zero-arg factory returning either, or a list of
   result features) and runs the full analyzer suite over the DAG — no data
@@ -27,7 +29,8 @@ Modes, combinable:
 Output: human text by default; ``--format json`` emits ONE JSON OBJECT PER
 LINE — each diagnostic as ``{"code", "severity", "stageUid", "location",
 "message", "fixHint"}``, preceded (under ``--cost``) by one
-``{"planCostReport": {...}}`` line — the machine contract
+``{"planCostReport": {...}}`` line and (under ``--threads``) by one
+``{"threadModel": {...}}`` line — the machine contract
 ``tools/lint_gate.py`` consumes.  (``--json``, kept for compatibility,
 prints the old single JSON array.)
 
@@ -61,6 +64,13 @@ def add_lint_parser(sub) -> None:
     p.add_argument("--concurrency", action="store_true",
                    help="add the TM306 rule to --path lint: module-level "
                         "mutable dict/list mutated outside a threading lock")
+    p.add_argument("--threads", action="store_true",
+                   help="run the TM31x whole-program concurrency analyzer "
+                        "(checkers/threadcheck.py) over every --path file: "
+                        "lockset/guarded-by inference (TM311/TM312/TM314), "
+                        "lock-order deadlock graph (TM313), blocking under a "
+                        "held lock (TM315); --format json adds one "
+                        "{\"threadModel\": ...} summary line")
     p.add_argument("--serving", action="store_true",
                    help="add the TM5xx servability analyzers (host "
                         "round-trips in the fused scoring prefix, unbounded "
@@ -173,6 +183,9 @@ def run_lint(ns) -> int:
     if ns.host_budget is not None and ns.rows is None:
         raise SystemExit("lint: --host-budget needs --rows N (the TM607 "
                          "residency estimate is linear in rows)")
+    if ns.threads and not ns.path:
+        raise SystemExit("lint: --threads needs --path targets (the TM31x "
+                         "analyzer runs over the given source files)")
     report = DiagnosticReport()
     ir_diff = None
     if ir:
@@ -208,6 +221,7 @@ def run_lint(ns) -> int:
     if cost_reports:
         report.plan_cost = cost_reports[-1]
     only = None if ns.all_functions else HAZARD_FUNCTION_NAMES
+    thread_items = []  # (src, fname, tree): the --threads whole-program set
     for path in ns.path:
         for fname in _python_files(path):
             try:
@@ -230,6 +244,17 @@ def run_lint(ns) -> int:
                     location=f"{fname}:{getattr(e, 'lineno', 0) or 0}")])
                 continue
             report.extend(f.to_diagnostic() for f in findings)
+            if ns.threads:
+                thread_items.append((src, fname, tree))
+    thread_model = None
+    if ns.threads and thread_items:
+        # whole-program pass: lock-order cycles (TM313) span modules, so the
+        # analyzer sees every parseable --path file in ONE run
+        from ..checkers.threadcheck import analyze_parsed
+
+        analysis = analyze_parsed(thread_items)
+        report.extend(f.to_diagnostic() for f in analysis.findings)
+        thread_model = analysis.model
 
     if ns.as_json:
         import json
@@ -242,6 +267,8 @@ def run_lint(ns) -> int:
                  for rep in residency_reports]
         if ir_diff is not None:
             blob.append({"irDiff": ir_diff.to_dict()})
+        if thread_model is not None:
+            blob.append({"threadModel": thread_model.to_dict()})
         print(json.dumps(blob, indent=2))
     elif ns.out_format == "json":
         import json
@@ -254,6 +281,8 @@ def run_lint(ns) -> int:
             print(json.dumps({"hostResidencyReport": rep.to_dict()}))
         if ir_diff is not None:
             print(json.dumps({"irDiff": ir_diff.to_dict()}))
+        if thread_model is not None:
+            print(json.dumps({"threadModel": thread_model.to_dict()}))
         for d in report:
             print(json.dumps(d.to_dict()))
     else:
@@ -263,6 +292,8 @@ def run_lint(ns) -> int:
             print(rep.pretty())
         if ir_diff is not None:
             print(_ir_pretty(ir_diff))
+        if thread_model is not None:
+            print(_thread_model_pretty(thread_model))
         print(report.pretty())
 
     threshold = Severity[ns.fail_on.upper()]
@@ -323,6 +354,22 @@ def _run_ir(ns, report):
             f"environment) — refusing to report a green nothing")
     report.extend(diff.diagnostics)
     return diff
+
+
+def _thread_model_pretty(model) -> str:
+    m = model.to_dict()
+    lines = [f"Thread model: {len(m['threads'])} thread entry point"
+             f"{'' if len(m['threads']) == 1 else 's'}, "
+             f"{len(m['sharedClasses'])} shared-reachable class"
+             f"{'' if len(m['sharedClasses']) == 1 else 'es'}, "
+             f"{len(m['lockOrderEdges'])} lock-order edge"
+             f"{'' if len(m['lockOrderEdges']) == 1 else 's'} "
+             f"({m['analyzedFiles']} files)"]
+    for t in m["threads"]:
+        lines.append(f"  thread: {t['target']} ({t['file']}:{t['line']})")
+    for outer, inner in m["lockOrderEdges"]:
+        lines.append(f"  lock order: {outer} -> {inner}")
+    return "\n".join(lines)
 
 
 def _ir_pretty(diff) -> str:

@@ -195,14 +195,18 @@ class FlightRecorder:
     def to_payload(self, reason: str = "on_demand") -> Dict[str, Any]:
         """JSON-able dump payload with stable key ordering."""
         with self._lock:
+            # counters snapshot under the same lock as the event ring so a
+            # dump can't pair a fresh event list with stale/torn counters
             events = [dict(ev) for ev in self._events]
             diags = list(self._diags)
-        return {"dropped": self.dropped,
+            dropped = self.dropped
+            unexpected = self.unexpected_compiles
+        return {"dropped": dropped,
                 "dumped_at": round(time.time(), 3),
                 "events": events,
                 "reason": reason,
                 "tm_diagnostics": diags,
-                "unexpected_compiles": self.unexpected_compiles}
+                "unexpected_compiles": unexpected}
 
     def dump(self, path: Optional[str] = None,
              reason: str = "on_demand") -> str:

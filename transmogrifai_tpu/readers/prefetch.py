@@ -46,29 +46,58 @@ def prefetch_depth() -> int:
 
 
 class PrefetchStats:
-    """Counters of one prefetched iteration (bench ``ingest`` section)."""
+    """Counters of one prefetched iteration (bench ``ingest`` section).
+
+    The worker thread accumulates ``load_seconds`` while the consumer thread
+    accumulates ``wait_seconds``/``stalls``/``chunks``, and ``to_dict`` /
+    ``overlap_fraction`` may be read mid-run (the fleet console polls them) —
+    so every update goes through a lock-guarded accumulator and the report
+    paths snapshot under the same lock (TM312: two threads read-modify-write
+    these fields; TM314: the overlap ratio reads two of them together)."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.chunks = 0
         self.load_seconds = 0.0
         self.wait_seconds = 0.0
         self.stalls = 0
+
+    def add_load(self, seconds: float) -> None:
+        """Worker-side: one chunk's produce time."""
+        with self._lock:
+            self.load_seconds += seconds
+
+    def add_wait(self, seconds: float, stalled: bool = False) -> None:
+        """Consumer-side: one ``__next__``'s queue wait (+ stall count)."""
+        with self._lock:
+            self.wait_seconds += seconds
+            if stalled:
+                self.stalls += 1
+
+    def add_chunk(self) -> None:
+        with self._lock:
+            self.chunks += 1
+
+    def _overlap_locked(self) -> float:
+        if self.load_seconds <= 0.0:
+            return 1.0
+        return max(0.0, min(1.0, 1.0 - self.wait_seconds / self.load_seconds))
 
     @property
     def overlap_fraction(self) -> float:
         """Fraction of total load time hidden behind the consumer's work:
         1.0 = every chunk was already staged when asked for; 0.0 = the
         consumer waited out every load (no overlap)."""
-        if self.load_seconds <= 0.0:
-            return 1.0
-        return max(0.0, min(1.0, 1.0 - self.wait_seconds / self.load_seconds))
+        with self._lock:
+            return self._overlap_locked()
 
     def to_dict(self) -> dict:
-        return {"chunks": self.chunks,
-                "load_seconds": round(self.load_seconds, 4),
-                "wait_seconds": round(self.wait_seconds, 4),
-                "stalls": self.stalls,
-                "overlap_fraction": round(self.overlap_fraction, 4)}
+        with self._lock:
+            return {"chunks": self.chunks,
+                    "load_seconds": round(self.load_seconds, 4),
+                    "wait_seconds": round(self.wait_seconds, 4),
+                    "stalls": self.stalls,
+                    "overlap_fraction": round(self._overlap_locked(), 4)}
 
 
 class ChunkPrefetcher:
@@ -105,7 +134,7 @@ class ChunkPrefetcher:
                 except BaseException as e:  # noqa: BLE001 — ship to consumer
                     self._put((ci, _SENTINEL, e))
                     return
-                self.stats.load_seconds += time.perf_counter() - t0
+                self.stats.add_load(time.perf_counter() - t0)
                 self._put((ci, item, None))
         finally:
             self._put((self._n, _SENTINEL, None))
@@ -129,14 +158,14 @@ class ChunkPrefetcher:
         t0 = time.perf_counter()
         ci, item, err = self._q.get()
         wait = time.perf_counter() - t0
-        self.stats.wait_seconds += wait
-        if empty and wait > _STALL_THRESHOLD_S and err is None \
-                and item is not _SENTINEL:
-            # the device-dispatch side outran the ingest side: record the
-            # starvation (the bench ingest overlap gate's runtime twin).
-            # Error/end-of-stream rows are excluded — a wait for the
-            # sentinel is not a stall on any real chunk.
-            self.stats.stalls += 1
+        # the device-dispatch side outran the ingest side: record the
+        # starvation (the bench ingest overlap gate's runtime twin).
+        # Error/end-of-stream rows are excluded — a wait for the
+        # sentinel is not a stall on any real chunk.
+        stalled = empty and wait > _STALL_THRESHOLD_S and err is None \
+            and item is not _SENTINEL
+        self.stats.add_wait(wait, stalled=stalled)
+        if stalled:
             obs_flight.record_event("prefetch_stall", chunk=int(ci),
                                     wait_s=round(wait, 4))
         if err is not None:
@@ -145,7 +174,7 @@ class ChunkPrefetcher:
         if item is _SENTINEL:
             self.close()
             raise StopIteration
-        self.stats.chunks += 1
+        self.stats.add_chunk()
         return ci, item
 
     def close(self) -> None:

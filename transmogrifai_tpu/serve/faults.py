@@ -180,13 +180,17 @@ class FaultHarness:
 
     # -- schedule construction ----------------------------------------------
     def script(self, point: str, schedule) -> "FaultHarness":
-        self._scripts.setdefault(point, []).extend(schedule)
+        # _check fires from serving threads (batcher flusher, shadow
+        # worker); schedule edits race with it unless they share its lock
+        with self._lock:
+            self._scripts.setdefault(point, []).extend(schedule)
         return self
 
     def fail_when(self, point: str, predicate: Callable[[dict], bool],
                   make_error: Callable[[], BaseException],
                   times: Optional[int] = None) -> "FaultHarness":
-        self._rules.append([point, predicate, make_error, times])
+        with self._lock:
+            self._rules.append([point, predicate, make_error, times])
         return self
 
     # -- firing --------------------------------------------------------------

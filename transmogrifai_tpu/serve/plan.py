@@ -374,7 +374,10 @@ class CompiledScoringPlan:
 
     # -- compilation ---------------------------------------------------------
     def _ensure_compiled(self, bucket: int):
-        compiled = self._executables.get(bucket)
+        # double-checked locking: the unlocked fast-path read is benign under
+        # the GIL (dict get is atomic; a stale miss just falls through to the
+        # locked re-check), and it keeps the hot scoring path lock-free
+        compiled = self._executables.get(bucket)  # opcheck: allow(TM311) DCL fast path, re-checked under _compile_lock below
         if compiled is not None:
             return compiled
         with self._compile_lock:
@@ -445,8 +448,12 @@ class CompiledScoringPlan:
                                               self.max_bucket))
         if full_ladder:
             # only a FULL bucket-ladder warm arms the TM901 expectation: a
-            # partial warm legitimately compiles its missing buckets later
-            self._warmed = True
+            # partial warm legitimately compiles its missing buckets later;
+            # set under _compile_lock — release_executables clears the flag
+            # under it, and an unlocked write could resurrect a just-evicted
+            # plan's warm status
+            with self._compile_lock:
+                self._warmed = True
         return self
 
     # -- scoring -------------------------------------------------------------

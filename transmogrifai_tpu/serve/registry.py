@@ -320,11 +320,14 @@ class ModelRegistry:
         with self._lock:
             states = [s for t, s in self._tenants.items()
                       if t != exclude_tenant]
+            # snapshot under the same lock _peak_bytes writes under — the
+            # per-plan loop below must not race a concurrent memoization
+            plan_bytes = dict(self._plan_bytes)
         out: Dict[str, int] = {}
         for s in states:
             for plan in s.live_plans():
                 if plan.warm_buckets():
-                    out[plan.fingerprint] = self._plan_bytes.get(
+                    out[plan.fingerprint] = plan_bytes.get(
                         plan.fingerprint, 0)
         return out
 
