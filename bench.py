@@ -1098,6 +1098,96 @@ def bench_fleet(n_records: int):
     return out
 
 
+def bench_deploy(n_records: int):
+    """AOT artifact store (deploy/): cold-start-to-first-score from a
+    packed artifact vs live compilation, and a multi-tenant rollout where
+    every tenant boots from ONE artifact dir.
+
+    Gates: hydration from the artifact performs ZERO backend compiles
+    (boot + first score under the compile probe), rollout registrations
+    stay compile-free, artifact-path scores are bitwise-equal to the
+    live-compiled reference, and the store records hits with no refusals.
+    """
+    import shutil
+    import tempfile
+
+    from transmogrifai_tpu.deploy import (ArtifactStore,
+                                          artifact_store_stats,
+                                          reset_artifact_store_stats)
+    from transmogrifai_tpu.perf import measure_compiles
+    from transmogrifai_tpu.serve import FleetServer
+    from transmogrifai_tpu.serve.plan import _EXEC_CACHE, _EXEC_CACHE_LOCK
+
+    model, records = _serve_fixture(n_records)
+    min_b, max_b = 8, 64
+    probe_recs = records[:64]
+    tenants = ["d_a", "d_b", "d_c", "d_d"]
+
+    out: dict = {"records": len(records), "tenants": len(tenants),
+                 "buckets": [min_b, max_b]}
+
+    # live reference: cold compile + first score, and the bitwise baseline
+    plan_live = model.serving_plan(min_bucket=min_b, max_bucket=max_b)
+    t0 = time.perf_counter()
+    plan_live.warm()
+    ref = plan_live.score(probe_recs)
+    out["live_cold_start_s"] = round(time.perf_counter() - t0, 3)
+    plan_live.release_executables()
+
+    tmp = tempfile.mkdtemp(prefix="bench_deploy_")
+    try:
+        store = ArtifactStore(tmp)
+        t0 = time.perf_counter()
+        store.pack(model, min_bucket=min_b, max_bucket=max_b)
+        out["pack_seconds"] = round(time.perf_counter() - t0, 3)
+        out["artifact_bytes"] = sum(
+            os.path.getsize(os.path.join(dp, f))
+            for dp, _dn, fns in os.walk(tmp) for f in fns)
+
+        # simulate a fresh process: nothing compiled, nothing cached
+        with _EXEC_CACHE_LOCK:
+            _EXEC_CACHE.clear()
+        reset_artifact_store_stats()
+
+        # cold start from the artifact: register + first score, zero
+        # compiles end to end
+        with measure_compiles() as probe:
+            with FleetServer(max_batch=64, max_wait_ms=1.0,
+                             min_bucket=min_b, max_bucket=max_b) as fleet:
+                t0 = time.perf_counter()
+                fleet.register(tenants[0], model, artifact=store)
+                fleet.submit(tenants[0], records[0]).result(timeout=120)
+                out["cold_start_to_first_score_s"] = round(
+                    time.perf_counter() - t0, 3)
+                boot_compiles = probe.backend_compiles
+
+                # rollout: every further tenant boots from the same dir
+                t0 = time.perf_counter()
+                for t in tenants[1:]:
+                    fleet.register(t, model, artifact=store)
+                out["rollout_register_s"] = round(
+                    time.perf_counter() - t0, 3)
+
+                futs = [fleet.submit(tenants[i % len(tenants)], r)
+                        for i, r in enumerate(probe_recs)]
+                got = [f.result(timeout=120) for f in futs]
+            out["boot_backend_compiles"] = boot_compiles
+            out["total_backend_compiles"] = probe.backend_compiles
+        out["store"] = artifact_store_stats()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    out["gate_zero_compile_boot"] = bool(out["total_backend_compiles"] == 0)
+    out["gate_bitwise_equal"] = bool(got == ref)
+    out["gate_no_refusals"] = bool(
+        out["store"]["refusals"] == 0 and out["store"]["hits"] > 0)
+    out["cold_start_speedup"] = (
+        round(out["live_cold_start_s"]
+              / out["cold_start_to_first_score_s"], 2)
+        if out.get("cold_start_to_first_score_s") else None)
+    return out
+
+
 def bench_multihost(n_rows: int, smoke: bool):
     """Pod-scale dp x mp sweep execution (ISSUE 15): the sharded IRLS
     fold x grid sweep on the (dp, 2) mesh vs the single-device dispatch.
@@ -1499,6 +1589,7 @@ _SECTION_FLOORS = {
     "obs": 40.0,
     "stream": 40.0,
     "fleet": 40.0,
+    "deploy": 30.0,
     "multihost": 40.0,
     "irls_mfu": 60.0,
     "tree_hist": 60.0,
@@ -1564,17 +1655,24 @@ def _run_section(name: str, budget: _Budget, fn, required: bool = False):
 
 def _compile_section() -> dict:
     """Process compile budget: backend compiles, persistent-cache traffic,
-    and the sweep executable-cache counters."""
+    the sweep executable-cache counters, and the deploy artifact-store
+    hit/miss/refusal traffic (one compile story, side by side)."""
+    from transmogrifai_tpu.deploy import artifact_store_stats
     from transmogrifai_tpu.perf import compile_snapshot, program_cache_stats
 
     snap = compile_snapshot().to_dict()
     prog = program_cache_stats()
+    art = artifact_store_stats()
     return {
         **snap,
         "sweep_programs_compiled": prog["programs_compiled"],
         "sweep_cache_hits": prog["cache_hits"],
         "sweep_compile_seconds": prog["compile_seconds"],
         "sweep_fallbacks": prog["fallbacks"],
+        "artifact_hits": art["hits"],
+        "artifact_misses": art["misses"],
+        "artifact_refusals": art["refusals"],
+        "artifact_packed": art["packed"],
     }
 
 
@@ -1697,6 +1795,14 @@ def main(argv=None):
         lambda: bench_fleet(500 if smoke else 2_000))
     if fl is not None:
         _OUT["fleet"] = fl
+
+    # AOT artifact store (deploy/): cold-start-to-first-score from a packed
+    # artifact at zero backend compiles, multi-tenant rollout from one dir
+    dp = _run_section(
+        "deploy", budget,
+        lambda: bench_deploy(500 if smoke else 2_000))
+    if dp is not None:
+        _OUT["deploy"] = dp
 
     # pod-scale dp x mp sweep execution (ISSUE 15): sharded fold x grid
     # dispatch vs single-device, zero warm sharded compiles, and the static

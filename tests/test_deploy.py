@@ -1,0 +1,512 @@
+"""Content-addressed AOT artifact store (ISSUE 17): pack a warmed serving
+plan's executables + checkpoint into an on-disk bundle, hydrate a fleet
+replica from it at ZERO backend compiles, and refuse — never load — a
+stale or tampered artifact (TM510, fail-closed like TM606).
+
+Acceptance criteria proven here:
+- a subprocess-isolated cold start boots N tenants from one artifact dir
+  with ``boot_backend_compiles == 0`` and scores bitwise-equal to the
+  live-compiled reference;
+- a truncated object, a content-fingerprint-drifted manifest, and a
+  jax-version-drifted provenance each REFUSE with TM510 (+ flight event)
+  and fall back to live compilation with bitwise-identical output;
+- environment drift (kernel dispatch mode) is a clean miss — a warning and
+  live compilation, no diagnostic;
+- ``tools/deploy_gate.py`` refuses to report green on an empty or
+  unparseable artifact dir (the ir_gate contract).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    FeatureBuilder,
+    Workflow,
+    transmogrify,
+)
+from transmogrifai_tpu.deploy import (
+    BUNDLE_VERSION,
+    ArtifactStore,
+    DeployBundle,
+    artifact_key,
+    artifact_store_stats,
+    check_bundle,
+    pack_model,
+    reset_artifact_store_stats,
+)
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.obs import flight as obs_flight
+from transmogrifai_tpu.obs.flight import FlightRecorder
+from transmogrifai_tpu.perf import measure_compiles
+from transmogrifai_tpu.perf.kernels.dispatch import force_kernel_mode
+from transmogrifai_tpu.readers.files import DataReaders
+from transmogrifai_tpu.serve import FleetServer
+from transmogrifai_tpu.serve.plan import _EXEC_CACHE, _EXEC_CACHE_LOCK
+
+MIN_BUCKET, MAX_BUCKET = 8, 64
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _train(seed: int, n: int = 220):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(0, 1, n)
+    color = rng.choice(["red", "green", "blue"], n)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(1.5 * x1 + (color == "red"))))
+         ).astype(float)
+    records = [{"label": float(y[i]), "x1": float(x1[i]),
+                "color": str(color[i])} for i in range(n)]
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    f_x1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    f_color = FeatureBuilder.PickList("color").extract_field().as_predictor()
+    checked = label.sanity_check(transmogrify([f_x1, f_color]))
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+    pred = label.transform_with(sel, checked)
+
+    import pandas as pd
+
+    model = (Workflow().set_result_features(label, pred)
+             .set_reader(DataReaders.Simple.dataframe(pd.DataFrame(records)))
+             ).train()
+    nolabel = [{k: v for k, v in r.items() if k != "label"} for r in records]
+    return model, nolabel
+
+
+def _cold():
+    """Simulate a fresh process: nothing resident in the shared cache."""
+    with _EXEC_CACHE_LOCK:
+        _EXEC_CACHE.clear()
+
+
+def _fresh_plan(model):
+    plan = model.serving_plan(min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET)
+    return plan
+
+
+@pytest.fixture(scope="module")
+def packed(tmp_path_factory):
+    """One trained model packed once; tests copy the dir before tampering.
+    ``ref`` is the live-compiled plan's scores — the bitwise baseline."""
+    model, records = _train(7)
+    root = str(tmp_path_factory.mktemp("artifact"))
+    bundle = pack_model(model, root, min_bucket=MIN_BUCKET,
+                        max_bucket=MAX_BUCKET)
+    plan = _fresh_plan(model)
+    ref = plan.score(records[:40])
+    plan.release_executables()
+    return {"model": model, "records": records, "root": root,
+            "bundle": bundle, "ref": ref}
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight():
+    obs_flight.uninstall_recorder()
+    yield
+    obs_flight.uninstall_recorder()
+
+
+class TestPackAndManifest:
+    def test_bundle_layout_and_manifest_schema(self, packed):
+        root = packed["root"]
+        bundle = DeployBundle.load(root)
+        m = bundle.manifest
+        assert m["bundleVersion"] == BUNDLE_VERSION
+        assert os.path.isdir(os.path.join(root, "model"))
+        plan = m["plan"]
+        assert plan["minBucket"] == MIN_BUCKET
+        assert plan["maxBucket"] == MAX_BUCKET
+        assert plan["buckets"] == [8, 16, 32, 64]
+        assert set(plan["objects"]) == {"8", "16", "32", "64"}
+        assert plan["fingerprint"] and plan["contentFingerprint"]
+        assert plan["fingerprint"] != plan["contentFingerprint"]
+        env = m["environment"]
+        import jax
+
+        assert env["jaxVersion"] == jax.__version__
+        assert env["kernelToken"].startswith("kernels:")
+
+    def test_objects_are_content_addressed_by_executable_key(self, packed):
+        bundle = DeployBundle.load(packed["root"])
+        env = bundle.environment
+        for bucket_s, meta in bundle.plan["objects"].items():
+            digest = artifact_key(bundle.plan["fingerprint"], int(bucket_s),
+                                  mesh_token_str=env["meshToken"],
+                                  kernel_token=env["kernelToken"])
+            assert meta["keyDigest"] == digest
+            assert meta["file"] == os.path.join("objects", digest[:2],
+                                                f"{digest}.aotx")
+            path = bundle.object_path(meta["file"])
+            assert os.path.getsize(path) == meta["size"]
+
+    def test_artifact_key_distinguishes_every_component(self):
+        base = artifact_key("fp", 8, mesh_token_str="m", kernel_token="k")
+        assert artifact_key("fp2", 8, mesh_token_str="m",
+                            kernel_token="k") != base
+        assert artifact_key("fp", 16, mesh_token_str="m",
+                            kernel_token="k") != base
+        assert artifact_key("fp", 8, mesh_token_str="m2",
+                            kernel_token="k") != base
+        assert artifact_key("fp", 8, mesh_token_str="m",
+                            kernel_token="k2") != base
+
+    def test_verify_clean_artifact_reports_nothing(self, packed):
+        report, drift = ArtifactStore(packed["root"]).verify(packed["model"])
+        assert report.errors() == []
+        assert drift == []
+
+
+class TestHydrate:
+    def test_hydrate_zero_compiles_bitwise_equal(self, packed):
+        _cold()
+        plan = _fresh_plan(packed["model"])
+        res = ArtifactStore(packed["root"]).hydrate(plan)
+        assert res["refused"] is False
+        assert res["hydrated"] == [8, 16, 32, 64]
+        with measure_compiles() as probe:
+            plan.warm()
+            got = plan.score(packed["records"][:40])
+        assert probe.backend_compiles == 0
+        assert got == packed["ref"]
+        plan.release_executables()
+
+    def test_fleet_register_hydrates_and_dedups_shared_tenants(self, packed):
+        _cold()
+        reset_artifact_store_stats()
+        rec = obs_flight.install_recorder(FlightRecorder())
+        with measure_compiles() as probe:
+            with FleetServer(max_batch=32, max_wait_ms=1.0,
+                             min_bucket=MIN_BUCKET,
+                             max_bucket=MAX_BUCKET) as fleet:
+                for t in ("a", "b", "c"):
+                    fleet.register(t, packed["model"],
+                                   artifact=packed["root"])
+                futs = [fleet.submit(t, r) for t in ("a", "b", "c")
+                        for r in packed["records"][:10]]
+                for f in futs:
+                    f.result(timeout=120)
+        assert probe.backend_compiles == 0
+        stats = artifact_store_stats()
+        # only the FIRST tenant of the fingerprint reads the disk; b and c
+        # dedup through the process-wide executable cache
+        assert stats["hits"] == 4
+        assert stats["refusals"] == 0
+        hydr = rec.events("artifact_hydrated")
+        assert len(hydr) == 1
+        assert hydr[0]["data"]["buckets"] == [8, 16, 32, 64]
+        assert hydr[0]["data"]["live_compile_buckets"] == []
+
+    def test_release_emits_flight_event(self, packed):
+        """Satellite: executable eviction is observable — an incident dump
+        shows WHY a tenant went cold next to the recompile it later paid."""
+        _cold()
+        plan = _fresh_plan(packed["model"])
+        ArtifactStore(packed["root"]).hydrate(plan)
+        rec = obs_flight.install_recorder(FlightRecorder())
+        n = plan.release_executables()
+        assert n > 0
+        evs = rec.events("executable_release")
+        assert len(evs) == 1
+        assert evs[0]["data"]["fingerprint"] == plan.fingerprint
+        assert evs[0]["data"]["buckets"] == [8, 16, 32, 64]
+        assert evs[0]["data"]["drop_shared"] is True
+        # releasing an already-cold plan is silent — no empty event spam
+        assert plan.release_executables() == 0
+        assert len(rec.events("executable_release")) == 1
+
+
+def _tampered_copy(packed, tmp_path, mutate):
+    """Copy the good artifact and apply ``mutate(root)``."""
+    root = str(tmp_path / "artifact")
+    shutil.copytree(packed["root"], root)
+    mutate(root)
+    return root
+
+
+def _assert_refused_with_fallback(packed, root, reason_substr):
+    """The tampered artifact refuses (TM510 + flight event), adopts
+    NOTHING, and the live fallback is bitwise-equal to the reference."""
+    _cold()
+    reset_artifact_store_stats()
+    plan = _fresh_plan(packed["model"])
+    rec = obs_flight.install_recorder(FlightRecorder())
+    try:
+        res = ArtifactStore(root).hydrate(plan, tenant="t")
+        assert res["refused"] is True
+        assert any(reason_substr in r for r in res["reasons"]), res["reasons"]
+        assert res["hydrated"] == []
+        evs = rec.events("artifact_refused")
+        assert len(evs) == 1
+        assert evs[0]["data"]["code"] == "TM510"
+        assert evs[0]["data"]["tenant"] == "t"
+        assert any(reason_substr in r
+                   for r in evs[0]["data"]["reasons"])
+    finally:
+        obs_flight.uninstall_recorder()
+    stats = artifact_store_stats()
+    assert stats["refusals"] == 1 and stats["hits"] == 0
+    # fail-closed does not mean fail-dead: live compilation still serves,
+    # bitwise-equal to the never-packed path
+    plan.warm()
+    assert plan.score(packed["records"][:40]) == packed["ref"]
+    plan.release_executables()
+
+
+class TestRefusal:
+    def test_truncated_object_refused_then_live_fallback(self, packed,
+                                                         tmp_path):
+        def mutate(root):
+            bundle = DeployBundle.load(root)
+            meta = bundle.plan["objects"]["16"]
+            path = bundle.object_path(meta["file"])
+            with open(path, "r+b") as fh:
+                fh.truncate(meta["size"] // 2)
+
+        root = _tampered_copy(packed, tmp_path, mutate)
+        _assert_refused_with_fallback(packed, root, "fails integrity")
+
+    def test_content_fingerprint_drift_refused(self, packed, tmp_path):
+        def mutate(root):
+            path = os.path.join(root, "manifest.json")
+            with open(path) as fh:
+                m = json.load(fh)
+            m["plan"]["contentFingerprint"] = "0" * 64
+            with open(path, "w") as fh:
+                json.dump(m, fh)
+
+        root = _tampered_copy(packed, tmp_path, mutate)
+        _assert_refused_with_fallback(packed, root,
+                                      "content fingerprint mismatch")
+
+    def test_jax_version_drift_refused(self, packed, tmp_path):
+        def mutate(root):
+            path = os.path.join(root, "manifest.json")
+            with open(path) as fh:
+                m = json.load(fh)
+            m["environment"]["jaxVersion"] = "0.0.1"
+            with open(path, "w") as fh:
+                json.dump(m, fh)
+
+        root = _tampered_copy(packed, tmp_path, mutate)
+        _assert_refused_with_fallback(packed, root, "jax-version-coupled")
+
+    def test_missing_manifest_refused(self, packed, tmp_path):
+        root = str(tmp_path / "empty")
+        os.makedirs(root)
+        _assert_refused_with_fallback(packed, root, "manifest unreadable")
+
+    def test_newer_bundle_version_refused(self, packed, tmp_path):
+        def mutate(root):
+            path = os.path.join(root, "manifest.json")
+            with open(path) as fh:
+                m = json.load(fh)
+            m["bundleVersion"] = BUNDLE_VERSION + 1
+            with open(path, "w") as fh:
+                json.dump(m, fh)
+
+        root = _tampered_copy(packed, tmp_path, mutate)
+        _assert_refused_with_fallback(packed, root, "newer than this reader")
+
+    def test_ir_corpus_drift_refused_by_check_bundle(self, packed, tmp_path):
+        """The gate-time corpus check: a program-surface change since pack
+        (one golden's content fingerprint moved) refuses the artifact."""
+        bundle = DeployBundle.load(packed["root"])
+        packed_corpus = bundle.manifest["irCorpus"]
+        if not (packed_corpus and packed_corpus["entries"]):
+            pytest.skip("no IR corpus index in this checkout")
+        key = sorted(packed_corpus["entries"])[0]
+        live = {"entries": dict(packed_corpus["entries"])}
+        live["entries"][key] = "drifted"
+        report, _drift = check_bundle(bundle, live_corpus=live)
+        assert [d.code for d in report.errors()] == ["TM510"]
+        assert key in report.errors()[0].message
+
+
+class TestCleanMiss:
+    def test_kernel_mode_drift_misses_cleanly(self, packed):
+        """Environment drift is NOT tampering: the executable key
+        legitimately differs, so hydration misses back to live compilation
+        with a warning — no TM510, no refusal counter."""
+        _cold()
+        reset_artifact_store_stats()
+        with force_kernel_mode("interpret"):
+            plan = _fresh_plan(packed["model"])
+            rec = obs_flight.install_recorder(FlightRecorder())
+            try:
+                res = ArtifactStore(packed["root"]).hydrate(plan)
+            finally:
+                obs_flight.uninstall_recorder()
+            assert res["refused"] is False
+            assert res["hydrated"] == []
+            assert any("kernel dispatch mode drift" in d
+                       for d in res["drift"])
+            assert rec.events("artifact_refused") == []
+            assert len(rec.events("artifact_miss")) == 1
+        stats = artifact_store_stats()
+        assert stats["refusals"] == 0 and stats["hits"] == 0
+        assert stats["misses"] == 4
+
+    def test_check_bundle_reports_drift_not_error(self, packed):
+        bundle = DeployBundle.load(packed["root"])
+        bundle.manifest["environment"]["kernelToken"] = "kernels:other"
+        report, drift = check_bundle(bundle)
+        assert report.errors() == []
+        assert any("kernel dispatch mode drift" in d for d in drift)
+
+
+class TestColdStartSubprocess:
+    def test_cold_process_boots_fleet_at_zero_compiles(self, packed,
+                                                       tmp_path):
+        """THE acceptance test: a genuinely fresh process (no warm jit
+        caches, no shared executable cache) boots two tenants from the
+        artifact dir, serves at boot_backend_compiles == 0, and its scores
+        are bitwise-equal to this process' live-compiled reference."""
+        recs = packed["records"][:24]
+        recs_path = tmp_path / "records.json"
+        recs_path.write_text(json.dumps(recs))
+        script = tmp_path / "boot.py"
+        script.write_text(
+            "import json, sys\n"
+            "from transmogrifai_tpu.deploy import ArtifactStore, "
+            "DeployBundle\n"
+            "from transmogrifai_tpu.perf import measure_compiles\n"
+            "from transmogrifai_tpu.serve import FleetServer\n"
+            "art, recs_path = sys.argv[1], sys.argv[2]\n"
+            "recs = json.load(open(recs_path))\n"
+            "model = DeployBundle.load(art).load_model()\n"
+            "with measure_compiles() as probe:\n"
+            "    with FleetServer(max_batch=32, max_wait_ms=1.0,\n"
+            f"                     min_bucket={MIN_BUCKET},\n"
+            f"                     max_bucket={MAX_BUCKET}) as fleet:\n"
+            "        fleet.register('a', model, artifact=art)\n"
+            "        fleet.register('b', model, "
+            "artifact=ArtifactStore(art))\n"
+            "        futs = [fleet.submit('ab'[i % 2], r)\n"
+            "                for i, r in enumerate(recs)]\n"
+            "        scores = [f.result(timeout=120) for f in futs]\n"
+            "    compiles = probe.backend_compiles\n"
+            "print(json.dumps({'boot_backend_compiles': compiles,\n"
+            "                  'scores': scores}))\n")
+        # the script lives in tmp, so the repo must reach the child via
+        # PYTHONPATH (python puts the script's dir on sys.path, not cwd)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", "")}
+        out = subprocess.run(
+            [sys.executable, str(script), packed["root"], str(recs_path)],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-3000:]
+        got = json.loads(out.stdout.strip().splitlines()[-1])
+        assert got["boot_backend_compiles"] == 0, out.stderr[-2000:]
+        # bitwise equality across the process boundary: JSON round-trips
+        # Python floats exactly (repr), so == is binary equality
+        assert got["scores"] == json.loads(json.dumps(packed["ref"][:24]))
+
+
+class TestDeployGate:
+    def _gate(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import deploy_gate
+        finally:
+            sys.path.pop(0)
+        return deploy_gate
+
+    def test_good_artifact_rc0(self, packed, capsys):
+        rc = self._gate().main(["--artifact", packed["root"]])
+        assert rc == 0
+        assert "deploy_gate: OK" in capsys.readouterr().out
+
+    def test_tampered_artifact_rc1(self, packed, tmp_path, capsys):
+        def mutate(root):
+            bundle = DeployBundle.load(root)
+            meta = bundle.plan["objects"]["8"]
+            with open(bundle.object_path(meta["file"]), "ab") as fh:
+                fh.write(b"garbage")
+
+        root = _tampered_copy(packed, tmp_path, mutate)
+        rc = self._gate().main(["--artifact", root])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "TM510" in out and "FAIL" in out
+
+    def test_empty_dir_is_fatal_not_green(self, tmp_path):
+        root = str(tmp_path / "nothing")
+        os.makedirs(root)
+        with pytest.raises(SystemExit, match="refusing to report OK"):
+            self._gate().main(["--artifact", root])
+
+    def test_missing_dir_is_fatal_not_green(self, tmp_path):
+        with pytest.raises(SystemExit, match="refusing to report OK"):
+            self._gate().main(["--artifact", str(tmp_path / "absent")])
+
+    def test_unparseable_manifest_is_fatal(self, packed, tmp_path):
+        def mutate(root):
+            with open(os.path.join(root, "manifest.json"), "w") as fh:
+                fh.write("{not json")
+
+        root = _tampered_copy(packed, tmp_path, mutate)
+        with pytest.raises(SystemExit, match="refusing to report OK"):
+            self._gate().main(["--artifact", root])
+
+
+class TestCli:
+    def test_deploy_verify_cli_rc(self, packed, tmp_path, capsys):
+        from transmogrifai_tpu.cli.gen import main as cli_main
+
+        rc = cli_main(["deploy", "verify", "--artifact", packed["root"]])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out.strip())
+        assert summary["refused"] is False
+
+        def mutate(root):
+            path = os.path.join(root, "manifest.json")
+            with open(path) as fh:
+                m = json.load(fh)
+            m["environment"]["jaxVersion"] = "0.0.1"
+            with open(path, "w") as fh:
+                json.dump(m, fh)
+
+        bad = _tampered_copy(packed, tmp_path, mutate)
+        rc = cli_main(["deploy", "verify", "--artifact", bad])
+        assert rc == 1
+        captured = capsys.readouterr()
+        assert json.loads(captured.out.strip())["refused"] is True
+        assert "TM510" in captured.err
+
+    def test_deploy_pack_cli_roundtrip(self, packed, tmp_path, capsys):
+        from transmogrifai_tpu.cli.gen import main as cli_main
+
+        model_dir = str(tmp_path / "model")
+        packed["model"].save(model_dir)
+        out_dir = str(tmp_path / "artifact")
+        rc = cli_main(["deploy", "pack", "--model", model_dir,
+                       "--out", out_dir, "--min-bucket", str(MIN_BUCKET),
+                       "--max-bucket", str(MAX_BUCKET)])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out.strip())
+        assert summary["buckets"] == [8, 16, 32, 64]
+        # the re-packed artifact carries the same CONTENT fingerprint as
+        # the original (same fitted model), so it verifies green too
+        assert summary["contentFingerprint"] == \
+            DeployBundle.load(packed["root"]).plan["contentFingerprint"]
+        rc = cli_main(["deploy", "verify", "--artifact", out_dir])
+        assert rc == 0
+
+
+class TestPackRefusesEmptyWork:
+    def test_pack_host_only_model_raises(self, packed, monkeypatch):
+        """A host-only plan has no executables; packing an empty artifact
+        that every verifier would refuse is refused at CREATION instead."""
+        from transmogrifai_tpu.serve.plan import CompiledScoringPlan
+
+        monkeypatch.setattr(CompiledScoringPlan, "device_stage_uids",
+                            property(lambda self: []))
+        with pytest.raises(ValueError, match="no device prefix"):
+            ArtifactStore(packed["root"] + "_none").pack(packed["model"])

@@ -429,6 +429,25 @@ class TestBenchSmoke:
         assert fl["overload"]["shed_by_tier"]["gold"] == 0
         assert fl["overload"]["gold_completed"] == \
             fl["overload"]["gold_submitted"]
+        # AOT artifact store (ISSUE 17): the deploy section packs the
+        # serving fixture, cold-boots a fleet from the artifact dir at ZERO
+        # backend compiles (register + first score under the probe), rolls
+        # out every further tenant from the same dir, and the artifact-path
+        # scores are bitwise-equal to the live-compiled reference; the
+        # compile section reports the artifact traffic beside the
+        # persistent-cache counters
+        assert secs["deploy"]["status"] == "ok", secs["deploy"]
+        dp = parsed["deploy"]
+        assert dp["gate_zero_compile_boot"] is True, dp
+        assert dp["boot_backend_compiles"] == 0, dp
+        assert dp["total_backend_compiles"] == 0, dp
+        assert dp["gate_bitwise_equal"] is True, dp
+        assert dp["gate_no_refusals"] is True, dp
+        assert dp["store"]["hits"] > 0 and dp["store"]["refusals"] == 0
+        assert dp["cold_start_to_first_score_s"] > 0, dp
+        assert dp["pack_seconds"] > 0 and dp["artifact_bytes"] > 0
+        assert parsed["compile"]["artifact_hits"] >= dp["store"]["hits"]
+        assert parsed["compile"]["artifact_refusals"] == 0
         # static cost model (ISSUE 6): predicted FLOPs/bytes recorded beside
         # the measured transform/sweep numbers, calibration within the band
         assert tr["predicted_flops"] > 0, tr

@@ -115,7 +115,11 @@ class TestStyleValidation:
         dp x mp substrate (ISSUE 15) — the placement/stamp caches
         (mesh.py) and the distributed bootstrap are exactly the
         module-level-mutable-state and hot-path shape the gate exists for,
-        and the sharding-constraint helpers sit inside every traced sweep."""
+        and the sharding-constraint helpers sit inside every traced sweep;
+        deploy/ joined with the AOT artifact store (ISSUE 17) — the
+        hydrate path writes into the live plan's executable table and the
+        process-wide hit/miss counters from whatever thread registers the
+        tenant, exactly the locked-module-state shape TM306 polices."""
         from transmogrifai_tpu.checkers.opcheck import (
             lint_file,
             lint_file_concurrency,
@@ -124,7 +128,8 @@ class TestStyleValidation:
         findings = []
         linted = []
         for sub in ("serve", "perf", "perf/kernels", "checkers", "cli",
-                    "workflow", "readers", "obs", "data", "parallel"):
+                    "workflow", "readers", "obs", "data", "parallel",
+                    "deploy"):
             d = os.path.join(PKG_ROOT, sub)
             for f in sorted(os.listdir(d)):
                 if not f.endswith(".py"):
@@ -149,6 +154,10 @@ class TestStyleValidation:
                         os.path.join("perf", "kernels", "routing.py")):
             assert pod_mod in linted, \
                 f"the pod-scale module {pod_mod} left the lint gate"
+        for dep_mod in (os.path.join("deploy", "store.py"),
+                        os.path.join("deploy", "bundle.py")):
+            assert dep_mod in linted, \
+                f"the deploy module {dep_mod} left the lint gate"
         assert not findings, (
             "unallowlisted hazards in serve//perf/ (fix them, or mark "
             "intentional ones inline with '# opcheck: allow(TMxxx) reason'):\n"
@@ -166,7 +175,7 @@ class TestStyleValidation:
 
         paths = []
         for sub in ("serve", "obs", "parallel", "perf", "perf/kernels",
-                    "checkers"):
+                    "checkers", "deploy"):
             d = os.path.join(PKG_ROOT, sub)
             paths += sorted(os.path.join(d, f) for f in os.listdir(d)
                             if f.endswith(".py"))

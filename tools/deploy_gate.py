@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""deploy_gate — CI gate over a packed AOT artifact dir (deploy/).
+
+Verifies the artifact the rollout is about to ship: manifest integrity
+(every object's sha256), provenance (jax version), staleness (the bundled
+checkpoint's live content fingerprint, and the LIVE IR golden corpus'
+fingerprints against the ones recorded at pack time — so a program-surface
+change since pack blocks the rollout, exactly the PR 7 contract).
+
+Exit-code contract (the ir_gate/lint_gate family):
+
+- rc **1** when verification emits any TM510 refusal — the artifact is
+  stale or tampered and must be re-packed, never shipped;
+- environment drift (mesh/device/kernel) prints as a warning and does NOT
+  flip the rc: the artifact is valid, it just won't hydrate here;
+- a gate that cannot run — no artifact dir, unreadable/unparseable
+  manifest, a checkpoint that will not load — is FATAL (SystemExit),
+  never green: an unverifiable artifact must not read as OK.
+
+Usage::
+
+    python tools/deploy_gate.py --artifact path/to/artifact
+        [--goldens tests/goldens/ir] [--skip-fingerprint]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="deploy_gate",
+        description="fail CI when a packed AOT artifact is stale or "
+                    "tampered (TM510); fatal when it cannot be verified")
+    ap.add_argument("--artifact", required=True,
+                    help="packed artifact dir (cli deploy pack --out)")
+    ap.add_argument("--goldens", default=None, metavar="DIR",
+                    help="live IR golden corpus for the drift check "
+                         "(default: the repo corpus)")
+    ap.add_argument("--skip-fingerprint", action="store_true",
+                    help="skip recomputing the live content fingerprint "
+                         "(skips loading the bundled checkpoint; integrity "
+                         "+ provenance + corpus checks still run)")
+    ns = ap.parse_args(argv)
+
+    if not os.path.isdir(ns.artifact):
+        raise SystemExit(f"deploy_gate: {ns.artifact!r} is not a directory "
+                         "— refusing to report OK")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from transmogrifai_tpu.deploy import ArtifactStore, DeployBundle
+    from transmogrifai_tpu.deploy.bundle import ir_corpus_fingerprints
+
+    try:
+        bundle = DeployBundle.load(ns.artifact)
+    except (OSError, ValueError) as e:
+        # an empty dir / missing / malformed manifest is not "no findings":
+        # there is nothing to verify, and an unverifiable artifact read as
+        # green would mask exactly what this gate exists to catch
+        raise SystemExit(f"deploy_gate: cannot read manifest under "
+                         f"{ns.artifact!r} ({e}) — refusing to report OK")
+
+    model = None
+    if not ns.skip_fingerprint:
+        try:
+            model = bundle.load_model()
+        except Exception as e:  # noqa: BLE001 — any load failure is fatal
+            raise SystemExit(f"deploy_gate: bundled checkpoint will not "
+                             f"load ({type(e).__name__}: {e}) — cannot "
+                             "recompute the live content fingerprint; "
+                             "refusing to report OK")
+
+    live_corpus = ir_corpus_fingerprints(ns.goldens)
+    if live_corpus is None:
+        print("deploy_gate: no live IR corpus index readable — "
+              "corpus-drift check skipped (ir_gate owns the missing-corpus "
+              "failure)")
+
+    report, drift = ArtifactStore(ns.artifact).verify(
+        model, live_corpus=live_corpus)
+    for d in report:
+        print(f"deploy_gate: {d.pretty()}")
+    for w in drift:
+        print(f"deploy_gate: [drift warning] {w}  (never gates)")
+
+    errors = report.errors()
+    if errors:
+        print(f"deploy_gate: FAIL — {len(errors)} TM510 refusal(s); the "
+              "artifact must be re-packed (`cli deploy pack`) from the "
+              "current model and environment, never shipped as-is")
+        return 1
+    print(f"deploy_gate: OK — manifest, {len(bundle.plan.get('objects', {}))}"
+          f" object(s), provenance and fingerprints verified"
+          + (f"; {len(drift)} environment-drift warning(s)" if drift else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -11,7 +11,11 @@ Runs, in order:
 3. **threads gate** (``--threads``): the TM31x whole-program concurrency
    analyzer (checkers/threadcheck.py) over the repo's threaded serving
    surface (THREADED_SURFACE), through lint_gate's same new-errors-only
-   contract against ``tools/threads_baseline.json``.
+   contract against ``tools/threads_baseline.json``;
+4. **deploy_gate** (``--deploy-artifact DIR``): verifies a packed AOT
+   artifact dir (tools/deploy_gate.py) — rc 1 on any TM510 refusal
+   (integrity/provenance/staleness), fatal when the artifact cannot be
+   read at all.
 
 One merged exit-code contract, inherited from both gates: rc **1** only when
 either gate finds a NEW error-severity diagnostic relative to its baseline;
@@ -57,6 +61,7 @@ THREADED_SURFACE = (
     "transmogrifai_tpu/parallel",
     "transmogrifai_tpu/perf",
     "transmogrifai_tpu/checkers",
+    "transmogrifai_tpu/deploy",
     "transmogrifai_tpu/workflow/continual.py",
     "transmogrifai_tpu/readers/prefetch.py",
     "transmogrifai_tpu/data/chunked.py",
@@ -102,6 +107,9 @@ def main(argv=None) -> int:
     ap.add_argument("--threads-baseline",
                     default="tools/threads_baseline.json",
                     help="threads-gate baseline file")
+    ap.add_argument("--deploy-artifact", default=None, metavar="DIR",
+                    help="packed AOT artifact dir to verify via "
+                         "deploy_gate (rc 1 on TM510 refusals)")
     ap.add_argument("--goldens", default=None, metavar="DIR",
                     help="golden IR corpus directory forwarded to ir_gate")
     ap.add_argument("lint_args", nargs=argparse.REMAINDER,
@@ -130,7 +138,7 @@ def main(argv=None) -> int:
                                   *lint_args])
         print(f"static_gate: lint_gate rc={rc_lint}")
         rc = max(rc, rc_lint)
-    elif ns.skip_ir and not ns.threads:
+    elif ns.skip_ir and not ns.threads and not ns.deploy_artifact:
         # every gate disabled: refuse to report a green nothing
         raise SystemExit("static_gate: --skip-ir with no lint args and no "
                          "--threads runs NO gate — refusing to exit 0")
@@ -157,6 +165,17 @@ def main(argv=None) -> int:
                                  *threads_args])
         print(f"static_gate: threads gate rc={rc_thr}")
         rc = max(rc, rc_thr)
+
+    if ns.deploy_artifact:
+        import deploy_gate
+
+        deploy_argv = ["--artifact", ns.deploy_artifact]
+        if ns.goldens:
+            deploy_argv += ["--goldens", ns.goldens]
+        print("static_gate: running deploy_gate ...")
+        rc_dep = deploy_gate.main(deploy_argv)
+        print(f"static_gate: deploy_gate rc={rc_dep}")
+        rc = max(rc, rc_dep)
 
     print(f"static_gate: {'FAIL' if rc else 'OK'} (rc={rc})")
     return rc

@@ -188,6 +188,16 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "even after evicting every cold tenant's buckets (LRU by "
               "last-scored); raise hbm_budget, shrink the bucket ladder "
               "(max_bucket), or unregister tenants"),
+    "TM510": (Severity.ERROR, "deploy artifact refused",
+              "the packed AOT artifact is stale or tampered — truncated/"
+              "hash-mismatched object bytes, a manifest whose plan content "
+              "fingerprint no longer matches the live model, an IR-corpus "
+              "fingerprint that drifted since pack time, or provenance from "
+              "a different jax version (the payload format is version-"
+              "coupled) — and is REFUSED, never loaded (fail-closed, like "
+              "TM606); serving falls back to live compilation, so re-pack "
+              "the bundle (`cli deploy pack`) from the current model and "
+              "environment"),
     # -- plan cost (jaxpr-level static analysis, checkers/plancheck.py) -----
     "TM601": (Severity.ERROR, "plan exceeds the HBM budget",
               "the fused program's peak live-buffer estimate at its largest "

@@ -1,0 +1,48 @@
+"""deploy/ — content-addressed AOT artifact store for zero-compile cold starts.
+
+The repo compiles once per process and amortizes from there (perf/programs,
+serve/plan's process-wide executable cache, JAX's persistent compilation
+cache).  This package converts that in-process story into the *deployment*
+story: a fleet of replicas rolling on every deploy should not each pay the
+full compile ladder on boot.
+
+- :class:`~.store.ArtifactStore` — packs a fitted model's warmed serving
+  executables (``jax.experimental.serialize_executable`` via
+  ``perf.programs.serialize_compiled``) into a content-addressed on-disk
+  artifact, keyed exactly like the executable cache: plan fingerprint ×
+  bucket × ``mesh_token()`` × kernel-dispatch ``cache_token()``.
+- :class:`~.bundle.DeployBundle` — the ``manifest.json`` contract: model
+  checkpoint, plan fingerprints (environment-qualified AND content-only),
+  per-object sha256 integrity hashes, environment provenance (jax version,
+  platform, device kind, mesh topology, kernel mode), and the PR 7
+  IR-corpus content fingerprints recorded at pack time.
+- **Fail-closed refusal (TM510)** — a stale or tampered artifact (truncated
+  bytes, hash mismatch, content-fingerprint drift, jax-version drift) is
+  *refused*, never loaded; serving falls back to live compilation.  Mere
+  environment drift (mesh topology, device kind, kernel mode) is a *clean
+  miss* back to live compilation with a warning — the executable key simply
+  differs, nothing is suspect.
+- Hydration wires through ``ModelRegistry.register(artifact=...)`` and
+  ``CompiledScoringPlan.adopt_executable``, so a ``FleetServer`` boots N
+  tenants from one artifact dir with ``boot_backend_compiles == 0``; every
+  hydrate/refuse/miss lands a flight-recorder event (obs/flight.py).
+
+CLI: ``python -m transmogrifai_tpu.cli deploy pack|verify|boot``.
+CI: ``tools/deploy_gate.py`` (invoked from ``tools/static_gate.py``)
+verifies a packed artifact dir against the live IR corpus and refuses
+green on an empty or unparseable artifact dir.  See docs/deploy.md.
+"""
+
+from .bundle import (  # noqa: F401
+    BUNDLE_VERSION,
+    DeployBundle,
+    check_bundle,
+    environment_provenance,
+)
+from .store import (  # noqa: F401
+    ArtifactStore,
+    artifact_key,
+    artifact_store_stats,
+    pack_model,
+    reset_artifact_store_stats,
+)

@@ -1,0 +1,246 @@
+"""DeployBundle — the manifest contract of a packed AOT artifact dir.
+
+One bundle = one fitted model + its warmed serving executables:
+
+```
+<root>/
+  manifest.json          # this module's schema (BUNDLE_VERSION)
+  model/                 # the WorkflowModel checkpoint (workflow/serde.py)
+  objects/<dd>/<digest>.aotx   # content-addressed executable payloads
+```
+
+The manifest is the *trust boundary*: every integrity and staleness
+decision reads it first, and no object byte is unpickled before its
+recorded sha256 verifies (a truncated or tampered payload fails the hash,
+never reaches pickle).  :func:`check_bundle` renders the decisions as typed
+TM510 diagnostics — fail-closed, like the TM606 cost-gate rule: an
+artifact that cannot be verified must not be loaded.
+
+Refusal (TM510) vs clean miss:
+
+- **refused** — manifest missing/malformed, newer bundle version, object
+  bytes missing/truncated/hash-mismatched, plan *content* fingerprint
+  drift (the model changed since pack), IR-corpus fingerprint drift at
+  gate time, or a different jax version (the serialized-executable pickle
+  is version-coupled, so bytes from another version are never loaded);
+- **clean miss** — same content but a different environment-qualified
+  fingerprint (mesh topology / device kind / kernel mode drift): the
+  executable cache key legitimately differs, so hydration misses back to
+  live compilation with a warning, not a diagnostic.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..checkers.diagnostics import DiagnosticReport, make_diagnostic
+
+#: bump on any manifest schema change; readers refuse NEWER versions (an
+#: old process must not half-understand a future manifest) and accept older
+BUNDLE_VERSION = 1
+
+MANIFEST_NAME = "manifest.json"
+MODEL_DIR = "model"
+OBJECTS_DIR = "objects"
+
+
+def environment_provenance() -> Dict[str, Any]:
+    """The environment facts an artifact's validity depends on.
+
+    ``meshToken`` and ``kernelToken`` are serialized as canonical JSON
+    strings so equality is plain string comparison on both ends of the
+    pack/hydrate round trip.
+    """
+    import jax
+
+    from ..parallel.mesh import mesh_token
+    from ..perf.kernels.dispatch import cache_token
+
+    devices = jax.devices()
+    return {
+        "jaxVersion": jax.__version__,
+        "platform": jax.default_backend(),
+        "deviceKind": devices[0].device_kind if devices else None,
+        "deviceCount": jax.device_count(),
+        "meshToken": json.dumps(mesh_token()),
+        "kernelToken": cache_token(),
+    }
+
+
+def ir_corpus_fingerprints(goldens_dir: Optional[str] = None
+                           ) -> Optional[Dict[str, Any]]:
+    """The live IR golden corpus' content fingerprints (PR 7), or None when
+    no corpus index is readable.  Packed into the manifest so the deploy
+    gate can prove the artifact predates no program-surface change."""
+    from ..checkers.irsnap import default_goldens_dir
+
+    index_path = os.path.join(goldens_dir or default_goldens_dir(),
+                              "index.json")
+    try:
+        with open(index_path) as fh:
+            index = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    entries = {
+        key: meta.get("contentFingerprint")
+        for key, meta in index.get("entries", {}).items()
+    }
+    return {
+        "jaxVersion": index.get("jaxVersion"),
+        "platform": index.get("platform"),
+        "entries": entries,
+    }
+
+
+@dataclass
+class DeployBundle:
+    """A loaded (not yet verified) artifact dir: root path + manifest."""
+
+    root: str
+    manifest: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, root: str) -> "DeployBundle":
+        """Read the manifest.  Raises ``FileNotFoundError`` / ``ValueError``
+        on a missing or malformed manifest — callers that must not crash
+        (hydration) catch and refuse; the gate treats it as fatal."""
+        path = os.path.join(root, MANIFEST_NAME)
+        with open(path) as fh:
+            manifest = json.load(fh)
+        if not isinstance(manifest, dict):
+            raise ValueError(f"{path}: manifest is not a JSON object")
+        return cls(root=root, manifest=manifest)
+
+    @property
+    def model_path(self) -> str:
+        return os.path.join(self.root,
+                            self.manifest.get("model", {}).get("path",
+                                                               MODEL_DIR))
+
+    @property
+    def plan(self) -> Dict[str, Any]:
+        return self.manifest.get("plan", {})
+
+    @property
+    def environment(self) -> Dict[str, Any]:
+        return self.manifest.get("environment", {})
+
+    def object_path(self, rel: str) -> str:
+        return os.path.join(self.root, rel)
+
+    def load_model(self):
+        """The bundled WorkflowModel checkpoint (``cli deploy boot``)."""
+        from ..workflow.workflow import WorkflowModel
+
+        return WorkflowModel.load(self.model_path)
+
+
+def _sha256_file(path: str) -> Tuple[str, int]:
+    import hashlib
+
+    h = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+            size += len(chunk)
+    return h.hexdigest(), size
+
+
+def check_bundle(bundle: DeployBundle, *,
+                 content_fingerprint: Optional[str] = None,
+                 live_corpus: Optional[Dict[str, Any]] = None
+                 ) -> Tuple[DiagnosticReport, List[str]]:
+    """Verify a bundle: (TM510 refusal report, environment-drift warnings).
+
+    Error-severity findings mean the artifact must be REFUSED (fail-closed);
+    the drift list carries clean-miss explanations (mesh/device/kernel
+    drift) that warrant a warning + live compile, not a refusal.
+
+    - structural: bundle version, plan section, object files present;
+    - integrity: every object's sha256 + size against the manifest;
+    - provenance: jax version (refusal — the payload pickle is
+      version-coupled), mesh/device/kernel tokens (drift);
+    - staleness: ``content_fingerprint`` (the live plan's) against the
+      manifest's, and ``live_corpus`` (the live IR corpus index, as from
+      :func:`ir_corpus_fingerprints`) against the fingerprints recorded at
+      pack time.
+    """
+    report = DiagnosticReport()
+    drift: List[str] = []
+    loc = os.path.join(bundle.root, MANIFEST_NAME)
+
+    def refuse(message: str) -> None:
+        report.diagnostics.append(
+            make_diagnostic("TM510", message, location=loc))
+
+    version = bundle.manifest.get("bundleVersion")
+    if not isinstance(version, int) or version > BUNDLE_VERSION:
+        refuse(f"bundle version {version!r} is newer than this reader's "
+               f"{BUNDLE_VERSION} (or missing); refusing to interpret it")
+        return report, drift
+
+    plan = bundle.plan
+    objects = plan.get("objects", {})
+    if not plan or not objects:
+        refuse("manifest has no plan/objects section — an empty artifact "
+               "cannot be verified, and an unverifiable artifact is refused")
+        return report, drift
+
+    # integrity first: no payload byte is trusted (or unpickled) before its
+    # recorded hash verifies
+    for bucket, meta in sorted(objects.items()):
+        rel = meta.get("file", "")
+        path = bundle.object_path(rel)
+        if not os.path.isfile(path):
+            refuse(f"object for bucket {bucket} missing: {rel}")
+            continue
+        digest, size = _sha256_file(path)
+        if size != meta.get("size") or digest != meta.get("sha256"):
+            refuse(f"object for bucket {bucket} fails integrity: {rel} is "
+                   f"{size}B/sha256:{digest[:12]}…, manifest recorded "
+                   f"{meta.get('size')}B/sha256:"
+                   f"{str(meta.get('sha256'))[:12]}…")
+
+    env = bundle.environment
+    here = environment_provenance()
+    if env.get("jaxVersion") != here["jaxVersion"]:
+        # version drift REFUSES: the payload is a version-coupled pickle,
+        # so bytes written by another jax must never be loaded
+        refuse(f"artifact was packed under jax {env.get('jaxVersion')!r}, "
+               f"this process runs {here['jaxVersion']!r} — the serialized-"
+               "executable payload format is jax-version-coupled")
+    for key, label in (("meshToken", "mesh topology"),
+                       ("deviceKind", "device kind"),
+                       ("platform", "platform"),
+                       ("kernelToken", "kernel dispatch mode")):
+        if env.get(key) != here[key]:
+            drift.append(f"{label} drift: packed under {env.get(key)!r}, "
+                         f"live is {here[key]!r} — executable keys differ, "
+                         "hydration misses back to live compilation")
+
+    if content_fingerprint is not None \
+            and plan.get("contentFingerprint") != content_fingerprint:
+        refuse(f"plan content fingerprint mismatch: manifest recorded "
+               f"{str(plan.get('contentFingerprint'))[:16]}…, the live "
+               f"model's is {content_fingerprint[:16]}… — the model "
+               "changed since pack; re-pack the bundle")
+
+    packed_corpus = bundle.manifest.get("irCorpus")
+    if live_corpus is not None and packed_corpus is not None:
+        packed_entries = packed_corpus.get("entries", {})
+        live_entries = live_corpus.get("entries", {})
+        changed = sorted(
+            key for key, fp in packed_entries.items()
+            if key in live_entries and live_entries[key] != fp)
+        if changed:
+            refuse("IR-corpus fingerprint drift since pack time: "
+                   f"{', '.join(changed[:4])}"
+                   + (f" (+{len(changed) - 4} more)"
+                      if len(changed) > 4 else "")
+                   + " — the program surface changed under the artifact")
+
+    return report, drift

@@ -22,6 +22,16 @@ the newest ``capacity`` events of:
 - ``drift`` — drift evaluations that fired TM801-TM803
   (workflow/continual.py).
 - ``quarantine`` / ``dead_letter`` — poison-record isolation outcomes.
+- ``executable_release`` — a plan dropped its compiled bucket executables
+  (fleet HBM eviction, unregister, or an explicit release): the
+  fingerprint, the released buckets, and whether the process-wide cache
+  entries went too — so an incident dump shows *why* a tenant went cold
+  next to the recompile it later paid (serve/plan.py).
+- ``artifact_packed`` / ``artifact_hydrated`` / ``artifact_miss`` /
+  ``artifact_refused`` — the deploy AOT artifact store's lifecycle
+  (deploy/store.py): which buckets hydrated at zero compiles, which
+  environment drift missed back to live compilation, and every TM510
+  fail-closed refusal with its reasons.
 - ``fault_injected`` — every failure the deterministic
   :class:`~..serve.faults.FaultHarness` injected; when the recorder has a
   ``dump_dir``, each injected fault auto-dumps the ring buffer (bounded

@@ -29,9 +29,11 @@ from .timers import (  # noqa: F401
 from .programs import (  # noqa: F401
     cache_key_fingerprint,
     clear_program_cache,
+    deserialize_compiled,
     enable_persistent_cache,
     program_cache_stats,
     run_cached,
+    serialize_compiled,
 )
 
 enable_persistent_cache()

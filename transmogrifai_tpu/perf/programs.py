@@ -373,3 +373,58 @@ def enable_persistent_cache(cache_dir: Optional[str] = None,
         return None
     _PERSISTENT_DIR = path
     return path
+
+
+# ---------------------------------------------------------------------------
+# AOT executable (de)serialization — the deploy/ artifact payload format
+# ---------------------------------------------------------------------------
+
+def serialize_compiled(compiled) -> bytes:
+    """One AOT-compiled executable -> bytes (the deploy/ artifact payload).
+
+    Wraps ``jax.experimental.serialize_executable``: the XLA executable
+    payload plus the call's arg/result treedefs, pickled together so a cold
+    process can rehydrate a *runnable* compiled object with ZERO backend
+    compiles (``jax.export``'s deserialized form re-compiles on call, which
+    would defeat the whole point).  The pickle is jax-version-coupled —
+    deploy manifests record ``jax.__version__`` so a drifted reader refuses
+    (TM510) instead of unpickling bytes written by another version.
+
+    Raises ``TypeError`` for objects the jax build cannot serialize; callers
+    decide whether that is fatal (pack) or a skip (best-effort export).
+    """
+    import pickle
+
+    import jax
+    from jax.experimental import serialize_executable as _se
+
+    payload, in_tree, out_tree = _se.serialize(compiled)
+    return pickle.dumps({
+        "format": "tmog-aot-v1",
+        "jax": jax.__version__,
+        "payload": payload,
+        "in_tree": in_tree,
+        "out_tree": out_tree,
+    })
+
+
+def deserialize_compiled(blob: bytes):
+    """bytes (from :func:`serialize_compiled`) -> runnable compiled object.
+
+    Zero backend compiles: the deserialized executable dispatches directly.
+    ``ValueError`` on a foreign/garbled blob.  Integrity is the CALLER's
+    job: the deploy store verifies the manifest's content hash BEFORE this
+    unpickle, so truncated or tampered bytes never reach pickle at all.
+    """
+    import pickle
+
+    from jax.experimental import serialize_executable as _se
+
+    try:
+        d = pickle.loads(blob)
+    except Exception as e:
+        raise ValueError(f"unreadable AOT executable blob: {e}") from e
+    if not isinstance(d, dict) or d.get("format") != "tmog-aot-v1":
+        raise ValueError("not a tmog-aot-v1 executable blob")
+    return _se.deserialize_and_load(d["payload"], d["in_tree"],
+                                    d["out_tree"])

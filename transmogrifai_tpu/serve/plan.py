@@ -235,6 +235,31 @@ class CompiledScoringPlan:
         return self._fingerprint
 
     @property
+    def content_fingerprint(self) -> str:
+        """Environment-free twin of :attr:`fingerprint`: hashes the fitted
+        stage content + wiring only (no kernel-dispatch or mesh token), so
+        it is stable across hosts/topologies/kernel modes.  The deploy
+        artifact manifest records it to tell *stale content* (TM510
+        refusal) apart from *environment drift* (clean cache miss)."""
+        return self._content_fingerprint
+
+    @property
+    def entry_specs(self) -> List[Tuple[tuple, str]]:
+        """(trailing shape, dtype name) per fused-program entry operand —
+        with the row bucket prepended, the exact ShapeDtypeStructs the AOT
+        compile uses.  Recorded in deploy artifact manifests."""
+        return list(self._entry_specs)
+
+    def bucket_ladder(self) -> List[int]:
+        """Every power-of-two bucket in [min_bucket, max_bucket] — the full
+        warm()/pack ladder."""
+        out, b = [], self.min_bucket
+        while b <= self.max_bucket:
+            out.append(b)
+            b *= 2
+        return out
+
+    @property
     def device_stage_uids(self) -> List[str]:
         return [s.uid for s in self._prefix]
 
@@ -366,11 +391,14 @@ class CompiledScoringPlan:
         programs, so the process-wide executable cache may share
         compilations; unhashable stage state degrades to a process-unique
         token (no cross-plan sharing, no recycled-id aliasing)."""
-        return stage_content_fingerprint(
-            self._prefix,
-            extra={"entries": [list(k) for k in self._entry_keys],
-                   "specs": [[list(t), d] for t, d in self._entry_specs],
-                   "outs": self._out_uids})
+        extra = {"entries": [list(k) for k in self._entry_keys],
+                 "specs": [[list(t), d] for t, d in self._entry_specs],
+                 "outs": self._out_uids}
+        # the environment-free twin rides along: deploy manifests compare it
+        # to decide refusal (content drift) vs clean miss (environment drift)
+        self._content_fingerprint = stage_content_fingerprint(
+            self._prefix, extra=extra, environment=False)
+        return stage_content_fingerprint(self._prefix, extra=extra)
 
     # -- compilation ---------------------------------------------------------
     def _ensure_compiled(self, bucket: int):
@@ -411,6 +439,36 @@ class CompiledScoringPlan:
         with self._compile_lock:
             return sorted(self._executables)
 
+    def executable(self, bucket: int):
+        """The AOT-compiled executable for ``bucket`` (compiling it on a
+        miss) — the deploy/ pack path's accessor, so the artifact store
+        never reaches into the private executable table."""
+        return self._ensure_compiled(
+            _bucket_for(bucket, self.min_bucket, self.max_bucket))
+
+    def adopt_executable(self, bucket: int, compiled,
+                         shared: bool = True) -> None:
+        """Install a pre-built executable for ``bucket`` — the deploy/
+        artifact hydration hook.  The adopted executable lands in this
+        plan's table and (``shared=True``) in the process-wide cache under
+        the same ``(fingerprint, bucket)`` key a live compile would use, so
+        later tenants of the same fingerprint dedup against it.  Once every
+        ladder bucket is resident the plan counts as warmed: a later
+        compile is a TM901-grade unexpected recompile, exactly as after a
+        live ``warm()``."""
+        bucket = _bucket_for(bucket, self.min_bucket, self.max_bucket)
+        with self._compile_lock:
+            self._executables[bucket] = compiled
+            if shared:
+                with _EXEC_CACHE_LOCK:
+                    _EXEC_CACHE[(self._fingerprint, bucket)] = compiled
+                    while len(_EXEC_CACHE) > _EXEC_CACHE_MAX:
+                        _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
+            if not self._warmed \
+                    and all(b in self._executables
+                            for b in self.bucket_ladder()):
+                self._warmed = True
+
     def release_executables(self, drop_shared: bool = True) -> int:
         """Drop every compiled bucket executable this plan holds — the HBM
         eviction hook of the fleet admission controller (serve/registry.py).
@@ -421,7 +479,13 @@ class CompiledScoringPlan:
         the shared tenant keeps its zero-compile serving.  Resets the warm
         flag (a later on-demand recompile of a cold-evicted tenant is
         legitimate, not a TM901 incident).  Returns the number of buckets
-        released."""
+        released.
+
+        Every release lands an ``executable_release`` flight event
+        (obs/flight.py): the fleet admission controller's LRU evictions
+        were invisible in the recorder next to compile/hydrate events, so
+        an incident dump could show a cold tenant recompiling with no
+        record of *why* it went cold."""
         with self._compile_lock:
             buckets = list(self._executables)
             self._executables.clear()
@@ -430,6 +494,10 @@ class CompiledScoringPlan:
                 with _EXEC_CACHE_LOCK:
                     for b in buckets:
                         _EXEC_CACHE.pop((self._fingerprint, b), None)
+        if buckets:
+            obs_flight.record_event(
+                "executable_release", fingerprint=self._fingerprint,
+                buckets=sorted(buckets), drop_shared=bool(drop_shared))
         return len(buckets)
 
     def warm(self, buckets: Optional[Sequence[int]] = None) -> "CompiledScoringPlan":
@@ -439,10 +507,7 @@ class CompiledScoringPlan:
             return self
         full_ladder = buckets is None
         if buckets is None:
-            buckets, b = [], self.min_bucket
-            while b <= self.max_bucket:
-                buckets.append(b)
-                b *= 2
+            buckets = self.bucket_ladder()
         for b in buckets:
             self._ensure_compiled(_bucket_for(b, self.min_bucket,
                                               self.max_bucket))

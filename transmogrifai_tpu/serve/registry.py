@@ -161,7 +161,7 @@ class ModelRegistry:
 
     # -- registration / lifecycle --------------------------------------------
     def register(self, tenant: str, model, slo: str = "bronze",
-                 warm: bool = True) -> TenantState:
+                 warm: bool = True, artifact=None) -> TenantState:
         """Admit ``model`` for ``tenant`` under SLO class ``slo``.
 
         Builds the tenant's compiled plan + fault-tolerance layer through
@@ -170,6 +170,13 @@ class ModelRegistry:
         over budget; typed TM509 refusal when eviction cannot make room),
         then warms the bucket ladder — at zero new backend compiles when
         another tenant already holds the fingerprint.
+
+        ``artifact`` (a packed artifact dir path or
+        :class:`~..deploy.ArtifactStore`) hydrates the plan's executables
+        from the deploy artifact store BEFORE the warm pass, so a verified
+        artifact boots the tenant at zero backend compiles; a stale or
+        tampered artifact is refused (TM510, flight-recorded) and the warm
+        pass live-compiles exactly as if no artifact existed.
         """
         if slo not in self.slo_classes:
             raise ValueError(f"unknown SLO class {slo!r}; configured: "
@@ -186,6 +193,15 @@ class ModelRegistry:
             entry = self._build_entry(tenant, model, version=1)
             shared = self._is_resident(entry.plan.fingerprint)
             self._admit(tenant, entry.plan)
+            if artifact is not None and not shared:
+                # a shared-fingerprint tenant dedups through the process-
+                # wide executable cache anyway — only the first tenant of a
+                # fingerprint reads the artifact off disk
+                from ..deploy.store import ArtifactStore
+
+                store = artifact if isinstance(artifact, ArtifactStore) \
+                    else ArtifactStore(artifact)
+                store.hydrate(entry.plan, tenant=tenant)
             if warm:
                 entry.plan.warm()
             swapper = SwappableScorer(entry, registry=self.registry,
@@ -496,8 +512,9 @@ class FleetServer:
 
     # -- tenant lifecycle (delegates to the control plane) -------------------
     def register(self, tenant: str, model, slo: str = "bronze",
-                 warm: bool = True) -> "FleetServer":
-        self.models.register(tenant, model, slo=slo, warm=warm)
+                 warm: bool = True, artifact=None) -> "FleetServer":
+        self.models.register(tenant, model, slo=slo, warm=warm,
+                             artifact=artifact)
         return self
 
     def unregister(self, tenant: str) -> None:

@@ -206,7 +206,8 @@ def partition_scoring_stages(runners: Sequence[Any]):
 
 
 def stage_content_fingerprint(stages: Sequence[Any],
-                              extra: Optional[dict] = None) -> str:
+                              extra: Optional[dict] = None, *,
+                              environment: bool = True) -> str:
     """Content hash of a fused program: fitted stage state + wiring extras.
 
     Two plans with equal fingerprints trace to identical XLA programs (stage
@@ -214,6 +215,15 @@ def stage_content_fingerprint(stages: Sequence[Any],
     them.  Unhashable stage state falls back to a process-unique token (a
     counter, NOT id() — recycled ids would let a new plan inherit a dead
     plan's executables).
+
+    ``environment=False`` omits the kernel-dispatch and mesh tokens: the
+    resulting hash names the fitted *content* alone, stable across kernel
+    modes, mesh topologies, and hosts.  The deploy artifact manifest
+    (deploy/bundle.py) records it so a hydrator can distinguish *stale
+    content* (content fingerprints differ → TM510 refusal) from mere
+    *environment drift* (content equal, executable key differs → clean
+    miss back to live compilation).  Executable-cache keys must always use
+    the default environment-qualified form.
     """
     from ..parallel.mesh import mesh_token
     from ..perf.kernels.dispatch import cache_token
@@ -226,16 +236,17 @@ def stage_content_fingerprint(stages: Sequence[Any],
             "stages": [encode_stage(s, enc, full=not isinstance(s, Estimator))
                        for s in stages],
             "extra": extra or {},
+        }
+        if environment:
             # kernel dispatch mode (perf/kernels/dispatch.py): encode/
             # bucketize stages trace to Pallas or XLA kernels depending on
             # it, so plans in different modes must never share executables
-            "kernels": cache_token(),
+            payload["kernels"] = cache_token()
             # ambient mesh + process topology (parallel/mesh.py): the fused
             # prefix bakes its sharding annotations at trace time, so a
             # multi-host plan must never alias a single-host plan of the
             # same fitted content (same rule run_cached keys enforce)
-            "mesh": mesh_token(),
-        }
+            payload["mesh"] = mesh_token()
         h = hashlib.sha256(
             json.dumps(payload, sort_keys=True, default=repr).encode())
         for key in sorted(enc.arrays):
