@@ -623,7 +623,16 @@ def bench_serve(n_records: int):
     Gates: on the clean fixture every failure counter must be zero
     (quarantined / breaker trips / deadline evictions / record failures),
     and degraded-mode serving performs zero new backend compiles.
+
+    Pipelined serving (ISSUE 18): the same replay through an explicit
+    lockstep server (``pipeline_depth=0``) vs the double-buffered donated
+    pipeline (``pipeline_depth=2`` + ``TMOG_SERVE_DONATE``) — speedup,
+    encode/finalize overlap fraction from the shared OverlapStats
+    accounting, donated-variant one-time warm compile count, zero
+    warm-path backend compiles, and full-replay bitwise parity.
     """
+    import jax
+
     from transmogrifai_tpu.perf import measure_compiles
     from transmogrifai_tpu.serve import ScoringServer
 
@@ -667,6 +676,69 @@ def bench_serve(n_records: int):
             res["quarantined"] == 0 and res["breaker"]["opened"] == 0
             and bat["deadline_expired"] == 0 and bat["failed"] == 0),
     }
+
+    # -- pipelined vs lockstep (ISSUE 18) ------------------------------------
+    from transmogrifai_tpu.perf.kernels.dispatch import force_serve_donation
+
+    def replay_scores(server):
+        t0 = time.perf_counter()
+        futs = [server.submit(r) for r in records]
+        scores = [f.result(timeout=120) for f in futs]
+        return len(records) / (time.perf_counter() - t0), scores
+
+    # both servers live at once and replay interleaved best-of-3 under an
+    # identical warm discipline — a sequential comparison hands whichever
+    # server runs second a quieter host and decides the ratio by noise
+    with ScoringServer(model, max_batch=64, max_wait_ms=1.0,
+                       max_queue=len(records) + 1,
+                       pipeline_depth=0) as lockstep:
+        with force_serve_donation(True):
+            # donation is folded into the plan at construction; the ctor
+            # warm compiles the donated bucket ladder — the one-time cost
+            # the zero-warm-compile gate excludes
+            pipelined_cm = ScoringServer(model, max_batch=64, max_wait_ms=1.0,
+                                         max_queue=len(records) + 1,
+                                         pipeline_depth=2)
+        with pipelined_cm as pipelined:
+            donated_compiles = pipelined.plan.compile_count
+            replay_scores(lockstep)   # warm both queue paths
+            replay_scores(pipelined)
+            lockstep_rps = pipelined_rps = 0.0
+            with measure_compiles() as pprobe:
+                for _ in range(3):
+                    r, lockstep_scores = replay_scores(lockstep)
+                    lockstep_rps = max(lockstep_rps, r)
+                    r, pipelined_scores = replay_scores(pipelined)
+                    pipelined_rps = max(pipelined_rps, r)
+            lockstep_p99 = lockstep.batcher.metrics()["latency_p99_ms"]
+            pm = pipelined.batcher.metrics()
+            pipelined_p99 = pm["latency_p99_ms"]
+            pipe = pm["pipeline"]
+
+    speedup = pipelined_rps / lockstep_rps if lockstep_rps else None
+    parity = bool(pipelined_scores == lockstep_scores)
+    on_accel = jax.devices()[0].platform != "cpu"
+    out.update({
+        "lockstep_rps": round(lockstep_rps, 1),
+        "pipelined_rps": round(pipelined_rps, 1),
+        "pipeline_speedup": round(speedup, 3),
+        "lockstep_p99_ms": lockstep_p99,
+        "pipelined_p99_ms": pipelined_p99,
+        "pipeline_depth": pipe["depth"],
+        "overlap_fraction": pipe["overlap_fraction"],
+        "pipeline_stalls": pipe["stalls"],
+        "donated_variant_compiles": donated_compiles,
+        "warm_path_backend_compiles": pprobe.backend_compiles,
+        "gate_pipeline_speedup_2x": bool(speedup and speedup >= 2.0),
+        "gate_pipeline_overlap": bool(pipe["overlap_fraction"] >= 0.5),
+        "gate_zero_warm_compiles_pipelined": pprobe.backend_compiles == 0,
+        "gate_pipeline_parity": parity,
+        # encode and the host remainder are both GIL-bound python, so off
+        # accelerator the pipeline can only hide device time — microseconds
+        # for this fixture on cpu.  The speedup/overlap gates are accelerator
+        # gates; measured values are recorded honestly either way.
+        "pipeline_gates_expected": on_accel,
+    })
     # program identity of the scoring plan the server just replayed through
     # (see the transform section's ir_fingerprint note)
     try:

@@ -479,11 +479,15 @@ class TestSloClasses:
         classes = {"rt": SloClass("rt", 2, deadline_ms=1.0),
                    "batch": SloClass("batch", 0)}
         mb = MicroBatcher(scorer, max_batch=1, max_wait_ms=1, max_queue=8,
-                          slo_classes=classes)
+                          slo_classes=classes, pipeline_depth=2)
         try:
-            mb.submit({"i": 0})
+            # saturate the pipelined in-flight window (depth + 1 claimed
+            # batches: one finalizing, one staged, one blocked in put) so
+            # the deadline request genuinely ages in the submit queue
+            for i in range(3):
+                mb.submit({"i": i})
             time.sleep(0.05)
-            f = mb.submit({"i": 1}, slo="rt")
+            f = mb.submit({"i": 99}, slo="rt")
             with pytest.raises(DeadlineExceededError):
                 f.result(timeout=10)
         finally:

@@ -506,12 +506,22 @@ class TestServeTelemetry:
         names = {e["name"] for e in evs if e.get("ph") == "X"}
         assert {"serve.flush", "serve.encode", "serve.device",
                 "serve.host"} <= names
-        # encode/device/host nest under the flusher thread's flush span
         assert nesting_violations(evs) == []
+        # pipelined serving (ISSUE 18): encode runs on the flusher thread
+        # while the flush span wraps finalize on the finalizer thread, so
+        # the causal chain joins on the batch_seq key, not the tid
         flush = next(e for e in evs if e["name"] == "serve.flush")
-        enc = next(e for e in evs if e["name"] == "serve.encode")
-        assert enc["tid"] == flush["tid"]
-        assert enc["args"].get("parent") == "serve.flush"
+        seq = flush["args"].get("batch_seq")
+        assert seq is not None
+        enc = next(e for e in evs if e["name"] == "serve.encode"
+                   and e["args"].get("batch_seq") == seq)
+        host = next(e for e in evs if e["name"] == "serve.host"
+                    and e["args"].get("batch_seq") == seq)
+        # the host remainder runs inside its batch's finalize/flush span
+        assert host["tid"] == flush["tid"]
+        assert host["args"].get("parent") == "serve.flush"
+        # encode precedes the batch's host remainder (overlap-safe order)
+        assert enc["ts"] <= host["ts"]
 
     def test_warm_serve_records_zero_compile_events(self, base):
         """Acceptance: a WARM serve replay under the recorder logs no

@@ -241,9 +241,21 @@ class TestDeadlines:
             gate.wait(10)
             return list(rs)
 
-        mb = MicroBatcher(scorer, max_batch=1, max_wait_ms=1, max_queue=2)
+        mb = MicroBatcher(scorer, max_batch=1, max_wait_ms=1, max_queue=2,
+                          pipeline_depth=2)
         try:
-            mb.submit({"i": 0})            # flusher takes it, blocks on gate
+            # saturate the pipelined in-flight window (depth + 1 claimed
+            # batches: one finalizing, one staged, one blocked in put) so
+            # later submits genuinely age in the queue; each filler must be
+            # CLAIMED before the next submit or the fillers themselves
+            # overflow the 2-slot queue
+            fillers = []
+            for _ in range(3):
+                fillers.append(mb.submit({"i": 0}))
+                deadline = time.monotonic() + 5
+                while (mb.metrics()["queue_depth"]
+                       and time.monotonic() < deadline):
+                    time.sleep(0.001)
             time.sleep(0.05)
             f1 = mb.submit({"i": 1}, deadline_ms=1)   # queued, will expire
             f2 = mb.submit({"i": 2}, deadline_ms=1)   # queue now full
@@ -255,6 +267,7 @@ class TestDeadlines:
                 f2.result(timeout=10)
             gate.set()
             assert f3.result(timeout=10) == {"i": 3}
+            assert all(f.result(timeout=10) == {"i": 0} for f in fillers)
             m = mb.metrics()
             assert m["deadline_expired"] == 2 and m["rejected"] == 0
         finally:
@@ -498,9 +511,18 @@ class TestBatcherAccounting:
             gate.wait(10)
             return list(rs)
 
-        mb = MicroBatcher(scorer, max_batch=1, max_wait_ms=1, max_queue=3)
+        mb = MicroBatcher(scorer, max_batch=1, max_wait_ms=1, max_queue=3,
+                          pipeline_depth=2)
         try:
-            mb.submit({"i": 0})            # occupies the flusher
+            # saturate the in-flight window (see the queue-side eviction
+            # test) so the reclaim-scan scenarios age in the queue
+            fillers = []
+            for _ in range(3):
+                fillers.append(mb.submit({"i": 0}))
+                deadline = time.monotonic() + 5
+                while (mb.metrics()["queue_depth"]
+                       and time.monotonic() < deadline):
+                    time.sleep(0.001)
             time.sleep(0.05)
             f_exp = mb.submit({"i": 1}, deadline_ms=1, slo="bronze")
             f_cancel = mb.submit({"i": 2}, slo="bronze")
@@ -534,7 +556,7 @@ class TestBatcherAccounting:
             m = mb.metrics()
             assert m["rejected"] == 1 and m["shed"] == 1, m
             gate.set()
-            for f in (f_gold1, f_gold2, f_gold3):
+            for f in (f_gold1, f_gold2, f_gold3, *fillers):
                 assert f.result(timeout=10)
         finally:
             gate.set()

@@ -1,0 +1,563 @@
+"""Double-buffered serving pipeline (ISSUE 18): bitwise parity with
+lockstep, the ``TMOG_SERVE_PIPELINE_DEPTH=0`` escape hatch, submit-storm
+accounting invariants, fault/swap behavior mid-window, the donated-variant
+cache-token split, zero warm-path compiles, and the deploy round-trip of a
+donated plan.
+
+Acceptance criteria proven here:
+- pipelined scoring is bitwise-equal to lockstep on the full replay
+  fixture, and ``pipeline_depth=0`` restores the lockstep loop exactly;
+- under a threaded submit storm every admitted request reaches exactly one
+  terminal outcome (submitted == completed + failed + cancelled +
+  deadline_expired + shed) with deadlines still enforced;
+- a transient device fault, a breaker trip, and a blue/green swap inside
+  an in-flight window leave every surviving record bitwise-equal and
+  nothing dropped or double-scored;
+- the donated serving variant is a distinct executable address
+  (cache token / plan fingerprint / deploy artifact key) with an UNCHANGED
+  content fingerprint, and a donated pack|boot round-trips at zero boot
+  backend compiles.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    FeatureBuilder,
+    Workflow,
+    transmogrify,
+)
+from transmogrifai_tpu.deploy import pack_model
+from transmogrifai_tpu.deploy.store import ArtifactStore, artifact_key
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.obs import Telemetry, reconstruct_request
+from transmogrifai_tpu.obs.reqtrace import request_events
+from transmogrifai_tpu.perf import measure_compiles
+from transmogrifai_tpu.perf.kernels.dispatch import (
+    cache_token,
+    force_serve_donation,
+)
+from transmogrifai_tpu.readers.files import DataReaders
+from transmogrifai_tpu.serve import (
+    FaultHarness,
+    MicroBatcher,
+    ScoringServer,
+    TransientScoringError,
+)
+from transmogrifai_tpu.serve.pipeline import InflightRing, pipeline_depth
+from transmogrifai_tpu.serve.plan import _EXEC_CACHE, _EXEC_CACHE_LOCK
+
+MIN_BUCKET, MAX_BUCKET = 8, 64
+
+
+@pytest.fixture(scope="module")
+def base():
+    """One fitted binary model, its unlabeled replay records, and the
+    direct lockstep plan scores — the bitwise reference."""
+    rng = np.random.default_rng(7)
+    n = 220
+    x1 = rng.normal(0, 1, n)
+    color = rng.choice(["red", "green", "blue"], n)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(1.5 * x1 + (color == "red"))))
+         ).astype(float)
+    records = [{"label": float(y[i]), "x1": float(x1[i]),
+                "color": str(color[i])} for i in range(n)]
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    f_x1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    f_color = FeatureBuilder.PickList("color").extract_field().as_predictor()
+    checked = label.sanity_check(transmogrify([f_x1, f_color]))
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+    pred = label.transform_with(sel, checked)
+
+    import pandas as pd
+
+    model = (Workflow().set_result_features(label, pred)
+             .set_reader(DataReaders.Simple.dataframe(pd.DataFrame(records)))
+             ).train()
+    nolabel = [{k: v for k, v in r.items() if k != "label"}
+               for r in records]
+    plan = model.serving_plan(min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET)
+    ref = plan.score(nolabel)
+    return model, nolabel, ref
+
+
+# ---------------------------------------------------------------------------
+# The ring + the depth knob
+# ---------------------------------------------------------------------------
+
+class TestRingAndKnob:
+    def test_depth_env_knob(self, monkeypatch):
+        monkeypatch.delenv("TMOG_SERVE_PIPELINE_DEPTH", raising=False)
+        assert pipeline_depth() == 2  # double buffering by default
+        monkeypatch.setenv("TMOG_SERVE_PIPELINE_DEPTH", "3")
+        assert pipeline_depth() == 3
+        monkeypatch.setenv("TMOG_SERVE_PIPELINE_DEPTH", "0")
+        assert pipeline_depth() == 0  # the lockstep escape hatch
+        monkeypatch.setenv("TMOG_SERVE_PIPELINE_DEPTH", "junk")
+        assert pipeline_depth() == 2
+        monkeypatch.setenv("TMOG_SERVE_PIPELINE_DEPTH", "-4")
+        assert pipeline_depth() == 0
+
+    def test_ring_rejects_nonpositive_depth(self):
+        with pytest.raises(ValueError):
+            InflightRing(0)
+
+    def test_ring_bounds_inflight_and_preserves_fifo(self):
+        ring = InflightRing(2)
+        ring.put("a")
+        ring.put("b")
+        assert ring.inflight == 2
+        blocked = threading.Event()
+        passed = threading.Event()
+
+        def producer():
+            blocked.set()
+            ring.put("c")  # must block: window full
+            passed.set()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        blocked.wait(5)
+        assert not passed.wait(0.1), "put did not block at depth"
+        assert ring.get() == "a"  # FIFO
+        ring.task_done()  # frees one slot -> producer unblocks
+        assert passed.wait(5)
+        assert ring.get() == "b" and ring.get() == "c"
+        ring.task_done()
+        ring.task_done()
+        t.join(5)
+
+    def test_ring_close_drain_and_sentinel(self):
+        ring = InflightRing(2)
+        ring.put(1)
+        ring.close()
+        ring.put(2)  # allowed after close: shutdown drain stages the tail
+        assert ring.get() == 1 and ring.get() == 2
+        ring.task_done()
+        ring.task_done()
+        assert ring.get() is None  # closed + empty -> consumer exit
+        assert ring.drain(timeout=1)
+
+    def test_ring_drain_times_out_while_inflight(self):
+        ring = InflightRing(1)
+        ring.put("x")
+        assert not ring.drain(timeout=0.05)
+        assert ring.get() == "x"
+        ring.task_done()
+        assert ring.drain(timeout=1)
+
+
+# ---------------------------------------------------------------------------
+# Bitwise parity + the depth-0 escape hatch
+# ---------------------------------------------------------------------------
+
+class TestParity:
+    def _replay(self, model, records, depth):
+        with ScoringServer(model, max_batch=64, max_wait_ms=1.0,
+                           min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET,
+                           pipeline_depth=depth) as srv:
+            futs = [srv.submit(r) for r in records]
+            out = [f.result(timeout=60) for f in futs]
+            metrics = srv.batcher.metrics()
+        return out, metrics
+
+    def test_pipelined_bitwise_equals_lockstep_full_replay(self, base):
+        model, records, ref = base
+        pipelined, pm = self._replay(model, records, depth=2)
+        lockstep, lm = self._replay(model, records, depth=0)
+        # dict equality on floats IS bitwise; ref is the direct plan path
+        assert pipelined == ref
+        assert lockstep == ref
+        assert pm["pipeline"]["depth"] == 2 and pm["pipeline"]["batches"] > 0
+        assert lm["pipeline"]["depth"] == 0 and lm["pipeline"]["batches"] == 0
+
+    def test_depth_zero_restores_lockstep_loop(self):
+        mb = MicroBatcher(lambda rs: list(rs), max_batch=4, max_wait_ms=1,
+                          pipeline_depth=0)
+        try:
+            # no ring, no finalizer thread: the flusher scores in line
+            assert mb._ring is None and mb._fin_thread is None
+            f = mb.submit({"i": 1})
+            assert f.result(timeout=10) == {"i": 1}
+            m = mb.metrics()
+            assert m["pipeline"]["depth"] == 0
+            assert m["pipeline"]["overlap_fraction"] == 1.0  # no load, no wait
+        finally:
+            mb.shutdown(drain=True, timeout=10)
+
+    def test_pipelined_overlap_accounting_populates(self, base):
+        model, records, ref = base
+        out, m = self._replay(model, records, depth=2)
+        pipe = m["pipeline"]
+        assert out == ref
+        assert 0.0 <= pipe["overlap_fraction"] <= 1.0
+        assert pipe["load_seconds"] >= 0.0 and pipe["wait_seconds"] >= 0.0
+        assert pipe["stalls"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# Submit-storm accounting (deadline / backpressure / shutdown invariants)
+# ---------------------------------------------------------------------------
+
+class TestStormAccounting:
+    def test_threaded_storm_every_request_terminal_once(self):
+        """submitted == completed + failed + cancelled + deadline_expired
+        + shed after a drain shutdown — no request dropped or double
+        counted under pipelining."""
+
+        def scorer(rs):
+            time.sleep(0.002)  # makes the window actually fill
+            return [dict(r) for r in rs]
+
+        mb = MicroBatcher(scorer, max_batch=8, max_wait_ms=1, max_queue=64,
+                          pipeline_depth=2)
+        futs, flock = [], threading.Lock()
+        rejected = [0]
+
+        def storm(tid):
+            from transmogrifai_tpu.serve import QueueFullError
+
+            for i in range(60):
+                deadline = 0.5 if i % 7 == 0 else None
+                try:
+                    f = mb.submit({"t": tid, "i": i}, deadline_ms=deadline)
+                except QueueFullError:
+                    with flock:
+                        rejected[0] += 1
+                    continue
+                if i % 13 == 0:
+                    f.cancel()  # client-side cancels must not leak slots
+                with flock:
+                    futs.append(f)
+
+        threads = [threading.Thread(target=storm, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        mb.shutdown(drain=True, timeout=30)
+        for f in futs:
+            assert f.done(), "drain shutdown left an unresolved future"
+        m = mb.metrics()
+        assert m["submitted"] == len(futs)
+        assert m["rejected"] == rejected[0]
+        assert m["submitted"] == (m["completed"] + m["failed"]
+                                  + m["cancelled"] + m["deadline_expired"]
+                                  + m["shed"])
+
+    def test_deadline_enforced_with_saturated_window(self):
+        """A queue-aged deadline still evicts under pipelining once the
+        in-flight window is full (claim-time enforcement unchanged)."""
+        from transmogrifai_tpu.serve import DeadlineExceededError
+
+        gate = threading.Event()
+
+        def scorer(rs):
+            gate.wait(5)
+            return list(rs)
+
+        mb = MicroBatcher(scorer, max_batch=1, max_wait_ms=1, max_queue=16,
+                          pipeline_depth=2)
+        try:
+            for i in range(3):  # depth + 1 claimed batches saturate it
+                mb.submit({"i": i})
+            time.sleep(0.05)
+            f = mb.submit({"i": 99}, deadline_ms=1.0)
+            with pytest.raises(DeadlineExceededError):
+                f.result(timeout=30)
+        finally:
+            gate.set()
+            mb.shutdown(drain=True, timeout=30)
+        assert mb.metrics()["deadline_expired"] == 1
+
+    def test_non_drain_shutdown_still_finalizes_inflight(self):
+        """shutdown(drain=False) cancels the queued tail but batches
+        already in the window ALWAYS finalize — claimed futures resolve."""
+        release = threading.Event()
+
+        def scorer(rs):
+            release.wait(10)
+            return [dict(r) for r in rs]
+
+        mb = MicroBatcher(scorer, max_batch=1, max_wait_ms=1, max_queue=64,
+                          pipeline_depth=2)
+        futs = [mb.submit({"i": i}) for i in range(20)]
+        time.sleep(0.1)  # let the window fill (3 claimed batches)
+        # unblock the scorer only AFTER shutdown has begun evicting the
+        # queued tail, so the claimed window and the tail part ways
+        threading.Timer(0.3, release.set).start()
+        mb.shutdown(drain=False, timeout=30)
+        from transmogrifai_tpu.serve import BatcherClosedError
+
+        ok = [f for f in futs if f.exception(timeout=1) is None]
+        evicted = [f for f in futs
+                   if isinstance(f.exception(timeout=1), BatcherClosedError)]
+        # the claimed window resolved with results; the queued tail was
+        # evicted with BatcherClosedError (counted "cancelled")
+        assert len(ok) >= 1
+        assert len(evicted) >= 1
+        assert len(ok) + len(evicted) == len(futs)
+        for f in ok:
+            assert "i" in f.result(timeout=1)
+        assert mb.metrics()["cancelled"] == len(evicted)
+
+
+# ---------------------------------------------------------------------------
+# Faults / breaker / swap inside an in-flight window
+# ---------------------------------------------------------------------------
+
+class TestFaultsMidWindow:
+    def test_transient_device_fault_mid_window_retries_bitwise(self, base):
+        model, records, ref = base
+        harness = FaultHarness(seed=0).fail_when(
+            "device", lambda ctx: True,
+            lambda: TransientScoringError("RESOURCE_EXHAUSTED"), times=1)
+        with ScoringServer(model, max_batch=32, max_wait_ms=1.0,
+                           min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET,
+                           resilience={"seed": 0, "backoff_base_s": 1e-4},
+                           pipeline_depth=2) as srv:
+            with harness:
+                futs = [srv.submit(r) for r in records[:96]]
+                out = [f.result(timeout=60) for f in futs]
+            m = srv.metrics()
+        assert out == ref[:96]  # retried batch bitwise-equal, none dropped
+        assert m["resilience"]["retries"] >= 1
+        assert m["batcher"]["completed"] == 96
+        assert m["batcher"]["failed"] == 0
+
+    def test_breaker_trip_mid_window_degrades_whole_batches(self, base):
+        """Persistent device faults trip the breaker while batches are in
+        flight: every record still resolves (host fallback), each batch is
+        atomically device-or-host, and outputs stay bitwise-equal (the
+        fixture's host and device paths agree bitwise)."""
+        model, records, ref = base
+        harness = FaultHarness(seed=1).fail_when(
+            "device", lambda ctx: True,
+            lambda: TransientScoringError("RESOURCE_EXHAUSTED"))
+        with ScoringServer(model, max_batch=16, max_wait_ms=1.0,
+                           min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET,
+                           resilience={"seed": 1, "max_retries": 1,
+                                       "backoff_base_s": 1e-4,
+                                       "failure_threshold": 2,
+                                       "recovery_batches": 1000},
+                           pipeline_depth=2) as srv:
+            with harness:
+                futs = [srv.submit(r) for r in records[:128]]
+                out = [f.result(timeout=120) for f in futs]
+            m = srv.metrics()
+        assert out == ref[:128]
+        assert m["resilience"]["breaker"]["state"] == "open"
+        assert m["resilience"]["fallback_records"] >= 16
+        assert m["batcher"]["completed"] == 128
+
+    def test_swap_during_inflight_window(self, base):
+        """A blue/green promote while traffic is in flight drains the
+        window first; every future resolves bitwise-equal and the swap
+        commits exactly once."""
+        model, records, ref = base
+        stop = threading.Event()
+        outs, errs = [], []
+
+        with ScoringServer(model, max_batch=16, max_wait_ms=1.0,
+                           min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET,
+                           pipeline_depth=2) as srv:
+            def traffic():
+                i = 0
+                while not stop.is_set():
+                    f = srv.submit(records[i % len(records)])
+                    try:
+                        outs.append((i % len(records), f.result(timeout=60)))
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+                    i += 1
+
+            t = threading.Thread(target=traffic, daemon=True)
+            t.start()
+            time.sleep(0.1)
+            srv.stage_candidate(model, warm=True)  # same content: bitwise-id
+            swap = srv.promote(probation_batches=0)
+            time.sleep(0.1)
+            stop.set()
+            t.join(30)
+            m = srv.metrics()
+        assert errs == []
+        assert outs, "traffic thread scored nothing"
+        for idx, row in outs:
+            assert row == ref[idx]
+        assert swap["to_version"] == 2 if "to_version" in swap else True
+        assert m["swap"]["swaps"] == 1
+        assert m["batcher"]["completed"] == len(outs)
+
+
+# ---------------------------------------------------------------------------
+# Donated variant: distinct executable address, unchanged content
+# ---------------------------------------------------------------------------
+
+class TestDonationToken:
+    def test_cache_token_and_fingerprint_split(self, base):
+        model, records, ref = base
+        plain = model.serving_plan(min_bucket=MIN_BUCKET,
+                                   max_bucket=MAX_BUCKET)
+        base_token = cache_token()
+        assert "serve-donate" not in base_token
+        with force_serve_donation(True):
+            assert cache_token() == base_token + ":serve-donate"
+            donated = model.serving_plan(min_bucket=MIN_BUCKET,
+                                         max_bucket=MAX_BUCKET)
+        assert not plain.donated and donated.donated
+        # distinct executable-cache address, identical model content
+        assert donated.fingerprint != plain.fingerprint
+        assert donated.content_fingerprint == plain.content_fingerprint
+        # the deploy artifact address splits on the same token
+        k_plain = artifact_key(plain.content_fingerprint, 8,
+                               kernel_token=base_token)
+        k_donated = artifact_key(plain.content_fingerprint, 8,
+                                 kernel_token=base_token + ":serve-donate")
+        assert k_plain != k_donated
+
+    def test_donated_scores_bitwise_equal(self, base):
+        model, records, ref = base
+        with force_serve_donation(True):
+            donated = model.serving_plan(min_bucket=MIN_BUCKET,
+                                         max_bucket=MAX_BUCKET)
+            out = donated.score(records[:48])
+        assert out == ref[:48]
+
+    def test_zero_warm_path_compiles_pipelined_donated(self, base):
+        """The donated-variant warm is one-time; after it, a pipelined
+        replay runs at zero backend compiles (acceptance)."""
+        model, records, ref = base
+        with force_serve_donation(True):
+            with ScoringServer(model, max_batch=64, max_wait_ms=1.0,
+                               min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET,
+                               warm=True, pipeline_depth=2) as srv:
+                warm_compiles = srv.plan.compile_count
+                with measure_compiles() as probe:
+                    futs = [srv.submit(r) for r in records]
+                    out = [f.result(timeout=60) for f in futs]
+        assert out == ref
+        assert probe.backend_compiles == 0  # warm path stays compile-free
+        assert warm_compiles >= 1  # the one-time donated-variant warm
+
+
+# ---------------------------------------------------------------------------
+# Deploy round-trip of a donated, pipelined plan
+# ---------------------------------------------------------------------------
+
+class TestDeployRoundTrip:
+    def test_pack_boot_donated_zero_compiles(self, base, tmp_path):
+        model, records, ref = base
+        root = str(tmp_path / "artifact")
+        with force_serve_donation(True):
+            bundle = pack_model(model, root, min_bucket=MIN_BUCKET,
+                                max_bucket=MAX_BUCKET)
+            assert ":serve-donate" in bundle.manifest["environment"][
+                "kernelToken"]
+            # simulate a fresh process: nothing resident
+            with _EXEC_CACHE_LOCK:
+                _EXEC_CACHE.clear()
+            plan = model.serving_plan(min_bucket=MIN_BUCKET,
+                                      max_bucket=MAX_BUCKET)
+            res = ArtifactStore(root).hydrate(plan)
+            assert not res["refused"] and res["hydrated"] == [8, 16, 32, 64]
+            with measure_compiles() as probe:
+                plan.warm()
+                out = plan.score(records[:40])
+        assert probe.backend_compiles == 0  # boot_backend_compiles == 0
+        assert out == ref[:40]
+
+    def test_donated_pack_does_not_alias_lockstep_artifacts(self, base,
+                                                            tmp_path):
+        model, *_ = base
+        plain_root = str(tmp_path / "plain")
+        donated_root = str(tmp_path / "donated")
+        plain = pack_model(model, plain_root, min_bucket=MIN_BUCKET,
+                           max_bucket=MAX_BUCKET)
+        with force_serve_donation(True):
+            donated = pack_model(model, donated_root, min_bucket=MIN_BUCKET,
+                                 max_bucket=MAX_BUCKET)
+        plain_keys = {meta["keyDigest"] for meta
+                      in plain.manifest["plan"]["objects"].values()}
+        donated_keys = {meta["keyDigest"] for meta
+                        in donated.manifest["plan"]["objects"].values()}
+        assert plain_keys and donated_keys
+        assert plain_keys.isdisjoint(donated_keys)
+
+
+# ---------------------------------------------------------------------------
+# Request-track reconstruction across interleaved batches
+# ---------------------------------------------------------------------------
+
+class TestReqtracePipelined:
+    def test_reconstruct_request_joins_on_batch_seq(self, base):
+        """Phase marks from interleaved batches (encode on the flusher
+        thread, host on the finalizer thread) still rebuild one correct
+        causal chain per request — the batch_seq join key, not tids."""
+        model, records, ref = base
+        tel = Telemetry(detail="requests")
+        with tel:
+            with ScoringServer(model, max_batch=16, max_wait_ms=1.0,
+                               min_bucket=MIN_BUCKET,
+                               max_bucket=MAX_BUCKET,
+                               pipeline_depth=2) as srv:
+                futs = [srv.submit(r) for r in records[:64]]
+                for f in futs:
+                    f.result(timeout=60)
+        trace = tel.tracer.chrome_trace()
+        reqs = request_events(trace)
+        assert len(reqs) == 64
+        seqs = set()
+        for rid, pair in sorted(reqs.items()):
+            assert set(pair) == {"b", "e"}, f"request {rid} unpaired"
+            chain = reconstruct_request(trace, rid)
+            assert chain["outcome"] == "ok"
+            for phase in ("encode", "device", "host"):
+                assert phase in chain["phases"], (rid, chain)
+                assert chain["phases"][phase]["ms"] >= 0.0
+            assert chain["batch"] is not None
+            seqs.add(chain["batch"]["seq"] if "seq" in chain["batch"]
+                     else pair["e"]["args"].get("batch_seq"))
+        assert len(seqs) > 1, "replay flushed a single batch; no interleave"
+
+
+# ---------------------------------------------------------------------------
+# statusz / console surface
+# ---------------------------------------------------------------------------
+
+class TestStatusSurface:
+    def test_statusz_exports_pipeline_fields(self, base):
+        model, records, ref = base
+        with ScoringServer(model, max_batch=32, max_wait_ms=1.0,
+                           min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET,
+                           pipeline_depth=2) as srv:
+            futs = [srv.submit(r) for r in records[:32]]
+            for f in futs:
+                f.result(timeout=60)
+            status = srv.statusz()
+        assert status["pipeline_depth"] == 2
+        assert 0.0 <= status["pipeline_overlap"] <= 1.0
+
+    def test_fleet_statusz_and_top_render_pipeline(self, base):
+        from transmogrifai_tpu.cli.top import format_statusz
+        from transmogrifai_tpu.serve import FleetServer
+
+        model, records, ref = base
+        with FleetServer(max_batch=32, max_wait_ms=1.0,
+                         min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET,
+                         pipeline_depth=2) as fleet:
+            fleet.register("t", model)
+            futs = [fleet.submit("t", r) for r in records[:32]]
+            out = [f.result(timeout=60) for f in futs]
+            status = fleet.statusz()
+        assert out == ref[:32]
+        assert status["fleet"]["pipeline_depth"] == 2
+        assert 0.0 <= status["fleet"]["pipeline_overlap"] <= 1.0
+        assert status["fleet"]["pipeline_stalls"] >= 0
+        frame = format_statusz(status)
+        assert "pipe=2@" in frame

@@ -112,6 +112,8 @@ def format_statusz(status: Dict[str, Any]) -> str:
         f"shed={fleet.get('shed', 0)}  "
         f"evictions={fleet.get('evictions', 0)}  "
         f"device_s={fleet.get('device_seconds', 0.0):.3f}  "
+        f"pipe={fleet.get('pipeline_depth', 0)}"
+        f"@{fleet.get('pipeline_overlap', 0.0):.2f}  "
         f"slo={'armed' if fleet.get('slo_monitor_armed') else 'off'}",
         f"{'TENANT':<12}{'SLO':<8}{'RPS':>8}{'P99ms':>8}{'BUDGET':>7}"
         f"{'BURN':>6}{'BRKR':>10}{'WARM':>5}{'SHED':>6}{'DLEXP':>6}"

@@ -392,6 +392,18 @@ CANONICAL_METRICS: Dict[str, Tuple[str, str, Optional[str], str]] = {
         ("counter", "batcher", "padding_rows", "filler rows dispatched to "
          "pad batches up to their power-of-two padding bucket (padding "
          "waste)"),
+    # -- Pipelined flush path (serve/pipeline.py via serve/batcher.py) ------
+    "tmog_serve_pipeline_depth":
+        ("gauge", "pipeline", None, "configured in-flight window of the "
+         "pipelined flush path (TMOG_SERVE_PIPELINE_DEPTH; 0 = lockstep)"),
+    "tmog_serve_pipeline_overlap_fraction":
+        ("gauge", "pipeline", None, "fraction of flusher encode+dispatch "
+         "time hidden behind finalize (1 - finalizer wait / flusher load; "
+         "same accounting as tmog_reader_prefetch overlap)"),
+    "tmog_serve_pipeline_stalls_total":
+        ("counter", "pipeline", None, "finalizer waits on an empty "
+         "in-flight ring longer than the stall threshold — the encode "
+         "stage failed to stay ahead of the device"),
     # -- ResilientScorer (serve/resilience.py) ------------------------------
     "tmog_serve_resilience_quarantined_total":
         ("counter", "resilience", "quarantined", "poison records isolated"),

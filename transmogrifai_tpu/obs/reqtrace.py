@@ -144,6 +144,26 @@ def mark_phase(phase: str, t0: float, dur_s: float, **args) -> None:
     bt.marks.append(Mark(phase, t0, dur_s, _TENANT.get(), args))
 
 
+class batch_scope:
+    """Re-enter an existing :class:`BatchTrace` on ANOTHER thread — the
+    pipelined batcher's finalizer runs batch N's host remainder off the
+    flusher thread, and the host-phase marks must land on the same trace
+    the flusher's encode/device marks went to.  Contextvar set/reset, so a
+    nested scope (or the flusher's own begin_batch) is unaffected."""
+
+    __slots__ = ("bt", "token")
+
+    def __init__(self, bt: Optional["BatchTrace"]):
+        self.bt = bt
+
+    def __enter__(self) -> "batch_scope":
+        self.token = _BATCH.set(self.bt)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _BATCH.reset(self.token)
+
+
 class tenant_scope:
     """Attribute phase marks + serve spans of the enclosed dispatch to one
     tenant (the fleet's per-tenant sub-batch fan-out)."""
@@ -225,11 +245,14 @@ def reconstruct_request(trace: Dict[str, Any], request_id: int
 
     Joins the request's async end event to its flushed batch
     (``serve.flush`` X span with the matching ``batch_seq``) and that
-    batch's nested phase spans (encode/device/host on the flusher tid,
-    filtered to the request's tenant when the spans carry tenant
-    attribution — a fleet flush dispatches per tenant sub-batch).  Raises
-    KeyError when the request id is absent and ValueError when its batch
-    span fell out of the bounded ring.
+    batch's phase spans, filtered to the request's tenant when the spans
+    carry tenant attribution — a fleet flush dispatches per tenant
+    sub-batch.  Phase spans that carry a ``batch_seq`` arg (ISSUE 18: the
+    pipelined batcher interleaves batch N's host phase with batch N+1's
+    encode/device, across two threads) join on that key directly; legacy
+    spans without one fall back to the flusher-tid + time-window
+    containment join.  Raises KeyError when the request id is absent and
+    ValueError when its batch span fell out of the bounded ring.
     """
     reqs = request_events(trace)
     if request_id not in reqs or "e" not in reqs[request_id]:
@@ -269,11 +292,20 @@ def reconstruct_request(trace: Dict[str, Any], request_id: int
     lo, hi = flush["ts"], flush["ts"] + flush["dur"]
     phases: Dict[str, Dict[str, Any]] = {}
     for ev in trace.get("traceEvents", []):
-        if ev.get("ph") != "X" or ev.get("tid") != flush["tid"] \
-                or ev.get("name") not in _PHASE_SPANS:
+        if ev.get("ph") != "X" or ev.get("name") not in _PHASE_SPANS:
             continue
-        if not (lo - 1.0 <= ev["ts"] and ev["ts"] + ev["dur"] <= hi + 1.0):
-            continue
+        span_seq = ev.get("args", {}).get("batch_seq")
+        if span_seq is not None:
+            # exact join: the span knows its batch — tid and wall-clock
+            # containment are meaningless under pipelining
+            if span_seq != batch_seq:
+                continue
+        else:
+            if ev.get("tid") != flush["tid"]:
+                continue
+            if not (lo - 1.0 <= ev["ts"]
+                    and ev["ts"] + ev["dur"] <= hi + 1.0):
+                continue
         span_tenant = ev.get("args", {}).get("tenant")
         if span_tenant is not None and tenant is not None \
                 and span_tenant != tenant:

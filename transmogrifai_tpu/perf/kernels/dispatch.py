@@ -45,6 +45,10 @@ from typing import Any, Dict, Optional
 #: A scalar rebind (not a mutated container) — single-writer test usage.
 _FORCED: Optional[str] = None
 
+#: test override installed by force_serve_donation(); None = resolve from
+#: env.  Same scalar-rebind discipline as _FORCED.
+_FORCED_DONATION: Optional[bool] = None
+
 #: resolved default VMEM budget for compiled kernels (bytes): leave head
 #: room under the ~16 MB/core for double buffering and the epilogue
 _DEFAULT_VMEM_BUDGET = 10 * 1024 * 1024
@@ -116,17 +120,50 @@ def force_kernel_mode(mode: str):
         _FORCED = prev
 
 
+def serve_donation() -> bool:
+    """Whether the serving prefix compiles with ``donate_argnums`` on its
+    padded input buffers (``TMOG_SERVE_DONATE``; default off).  The donated
+    variant is a DISTINCT executable — resolved here, next to the kernel
+    mode, so the choice rides ``cache_token()`` into every program cache
+    key, plan fingerprint, and deploy artifact key and can never alias the
+    non-donated build (acceptance: ISSUE 18)."""
+    if _FORCED_DONATION is not None:
+        return _FORCED_DONATION
+    raw = os.environ.get("TMOG_SERVE_DONATE", "").strip().lower()
+    return raw in ("1", "on", "true", "yes", "donate")
+
+
+@contextmanager
+def force_serve_donation(flag: bool):
+    """Pin the serve-donation choice for a ``with`` block (parity tests and
+    the bench lockstep-vs-pipelined comparison run both variants in one
+    process).  Not re-entrant across threads — test-only, like
+    ``force_kernel_mode``."""
+    global _FORCED_DONATION
+    prev = _FORCED_DONATION
+    _FORCED_DONATION = bool(flag)
+    try:
+        yield
+    finally:
+        _FORCED_DONATION = prev
+
+
 def cache_token() -> str:
     """Kernel-choice component of every program cache key / plan
     fingerprint.  Distinct per effective mode so executables never alias
     across dispatch modes (acceptance: ISSUE 10).  In compiled-Pallas mode
     the VMEM admission budget rides the token too: the budget decides which
     call sites trace the kernel vs the XLA fallback, so two budgets are two
-    program families even at one mode."""
+    program families even at one mode.  The serve-donation choice rides the
+    token the same way: a donated serving prefix consumes its input buffers,
+    so it must never be served where a caller expects the non-donated
+    build (ISSUE 18)."""
     mode = kernel_mode()
-    if mode == "pallas":
-        return f"kernels:pallas:vmem={vmem_budget()}"
-    return f"kernels:{mode}"
+    token = f"kernels:pallas:vmem={vmem_budget()}" if mode == "pallas" \
+        else f"kernels:{mode}"
+    if serve_donation():
+        token += ":serve-donate"
+    return token
 
 
 def vmem_budget() -> int:
@@ -202,6 +239,7 @@ def kernel_provenance() -> Dict[str, Any]:
         "hist_chunk": tuning_int("TMOG_HIST_CHUNK", HIST_CHUNK_DEFAULT),
         "hist_unroll": tuning_int("TMOG_HIST_UNROLL", HIST_UNROLL_DEFAULT),
         "pallas_vmem_budget": vmem_budget(),
+        "serve_donation": serve_donation(),
     }
     try:
         from ...models import trees as _trees

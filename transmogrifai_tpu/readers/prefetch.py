@@ -26,6 +26,7 @@ import time
 from typing import Any, Callable, Iterator, Optional
 
 from ..obs import flight as obs_flight
+from ..obs.overlap import OverlapStats
 
 _SENTINEL = object()
 
@@ -45,59 +46,17 @@ def prefetch_depth() -> int:
         return 2
 
 
-class PrefetchStats:
+class PrefetchStats(OverlapStats):
     """Counters of one prefetched iteration (bench ``ingest`` section).
 
-    The worker thread accumulates ``load_seconds`` while the consumer thread
+    The shared accumulator lives in :class:`~..obs.overlap.OverlapStats`
+    (the serve pipeline reports the same metric through the same class):
+    the worker thread accumulates ``load_seconds`` while the consumer thread
     accumulates ``wait_seconds``/``stalls``/``chunks``, and ``to_dict`` /
     ``overlap_fraction`` may be read mid-run (the fleet console polls them) —
     so every update goes through a lock-guarded accumulator and the report
     paths snapshot under the same lock (TM312: two threads read-modify-write
     these fields; TM314: the overlap ratio reads two of them together)."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.chunks = 0
-        self.load_seconds = 0.0
-        self.wait_seconds = 0.0
-        self.stalls = 0
-
-    def add_load(self, seconds: float) -> None:
-        """Worker-side: one chunk's produce time."""
-        with self._lock:
-            self.load_seconds += seconds
-
-    def add_wait(self, seconds: float, stalled: bool = False) -> None:
-        """Consumer-side: one ``__next__``'s queue wait (+ stall count)."""
-        with self._lock:
-            self.wait_seconds += seconds
-            if stalled:
-                self.stalls += 1
-
-    def add_chunk(self) -> None:
-        with self._lock:
-            self.chunks += 1
-
-    def _overlap_locked(self) -> float:
-        if self.load_seconds <= 0.0:
-            return 1.0
-        return max(0.0, min(1.0, 1.0 - self.wait_seconds / self.load_seconds))
-
-    @property
-    def overlap_fraction(self) -> float:
-        """Fraction of total load time hidden behind the consumer's work:
-        1.0 = every chunk was already staged when asked for; 0.0 = the
-        consumer waited out every load (no overlap)."""
-        with self._lock:
-            return self._overlap_locked()
-
-    def to_dict(self) -> dict:
-        with self._lock:
-            return {"chunks": self.chunks,
-                    "load_seconds": round(self.load_seconds, 4),
-                    "wait_seconds": round(self.wait_seconds, 4),
-                    "stalls": self.stalls,
-                    "overlap_fraction": round(self._overlap_locked(), 4)}
 
 
 class ChunkPrefetcher:

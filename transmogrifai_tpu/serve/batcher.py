@@ -43,6 +43,20 @@ Per-record fault isolation: a scorer exposing ``score_isolated(records) ->
 routed to per-record futures — a poison record fails only its own future
 instead of co-failing the whole flushed batch.
 
+Pipelined flushing (ISSUE 18): with ``pipeline_depth`` > 0 (default: the
+``TMOG_SERVE_PIPELINE_DEPTH`` env knob, 2) and a scorer exposing the staged
+``begin_*`` protocol, the flush path double-buffers — the flusher thread
+claims batch N+1 and runs its host ENCODE + async device dispatch while a
+dedicated finalizer thread syncs batch N's device outputs, runs its host
+remainder, and routes futures.  The in-flight window is a bounded ring
+(serve/pipeline.py): a full window blocks the flusher, which backs pressure
+into the submit queue's existing shed/reject machinery, so deadline,
+backpressure, shed, and drain-shutdown semantics are preserved per batch.
+Batches finalize in flush order; each batch's full scoring stack runs
+exactly the lockstep code (``score() == begin_score()()`` by construction),
+so results stay bitwise equal.  ``pipeline_depth=0`` restores the lockstep
+loop byte-for-byte — the explicit escape hatch.
+
 Observability: every counter lives in an :class:`~..obs.metrics
 .MetricsRegistry` under the canonical ``tmog_serve_batcher_*`` names
 (docs/observability.md) — ``metrics()`` remains the historical plain-dict
@@ -67,7 +81,10 @@ from typing import (Any, Callable, Dict, List, Mapping, NamedTuple, Optional,
 from ..obs import reqtrace
 from ..obs import trace as obs_trace
 from ..obs.metrics import MetricsRegistry
+from ..obs.overlap import OverlapStats
 from .faults import DeadlineExceededError, LoadShedError, fault_point
+from .pipeline import STALL_THRESHOLD_S, InflightRing
+from .pipeline import pipeline_depth as _env_pipeline_depth
 
 
 class QueueFullError(RuntimeError):
@@ -136,7 +153,8 @@ class MicroBatcher:
                  max_batch: int = 256, max_wait_ms: float = 2.0,
                  max_queue: int = 4096,
                  registry: Optional[MetricsRegistry] = None,
-                 slo_classes: Optional[Mapping[str, SloClass]] = None):
+                 slo_classes: Optional[Mapping[str, SloClass]] = None,
+                 pipeline_depth: Optional[int] = None):
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
         self._score = score_batch
@@ -191,6 +209,29 @@ class MicroBatcher:
         self._h_latency = self.registry.histogram(
             "tmog_serve_batcher_latency_seconds",
             _h("tmog_serve_batcher_latency_seconds"))
+
+        # pipelined flush path (ISSUE 18): depth from the ctor (fleet/server
+        # passthrough) or the TMOG_SERVE_PIPELINE_DEPTH env knob; 0 =
+        # lockstep.  The ring + finalizer thread exist only when pipelining.
+        self.pipeline_depth = _env_pipeline_depth() \
+            if pipeline_depth is None else max(0, int(pipeline_depth))
+        self._pipe_stats = OverlapStats()
+        self._g_pipe_depth = self.registry.gauge(
+            "tmog_serve_pipeline_depth", _h("tmog_serve_pipeline_depth"))
+        self._g_pipe_depth.set(self.pipeline_depth)
+        self._g_pipe_overlap = self.registry.gauge(
+            "tmog_serve_pipeline_overlap_fraction",
+            _h("tmog_serve_pipeline_overlap_fraction"))
+        self._c_pipe_stalls = _c("tmog_serve_pipeline_stalls_total")
+        self._ring: Optional[InflightRing] = \
+            InflightRing(self.pipeline_depth) if self.pipeline_depth > 0 \
+            else None
+        self._fin_thread: Optional[threading.Thread] = None
+        if self._ring is not None:
+            self._fin_thread = threading.Thread(
+                target=self._finalize_loop, daemon=True,
+                name="transmogrifai-microbatcher-finalize")
+            self._fin_thread.start()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="transmogrifai-microbatcher")
         self._thread.start()
@@ -442,6 +483,23 @@ class MicroBatcher:
             req.future.set_exception(BatcherClosedError(
                 "batcher shut down before flush"))
         self._thread.join(timeout)
+        # in-flight pipelined batches ALWAYS finalize (drain or not): a
+        # claimed batch is past admission, exactly like the batch a lockstep
+        # flusher is mid-scoring at shutdown — nothing dropped, nothing
+        # double-scored.  The flusher closed the ring on exit, so the
+        # finalizer exits once the backlog drains.
+        if self._fin_thread is not None:
+            self._fin_thread.join(timeout)
+
+    def drain_pipeline(self, timeout: Optional[float] = None) -> bool:
+        """Wait until no pipelined batch is in flight (no-op True in
+        lockstep mode).  The swap/rollback paths call this before mutating
+        the active model so a promotion never races an in-flight window —
+        batches begun earlier still complete on the entry they captured
+        (serve/swap.py), this just makes the cutover observable-clean."""
+        if self._ring is None:
+            return True
+        return self._ring.drain(timeout)
 
     def __enter__(self) -> "MicroBatcher":
         return self
@@ -484,6 +542,10 @@ class MicroBatcher:
         out["max_batch"] = self.max_batch
         out["max_wait_ms"] = self.max_wait_s * 1e3
         out["max_queue"] = self.max_queue
+        pipe = self._pipe_stats.to_dict()
+        pipe["depth"] = self.pipeline_depth
+        pipe["batches"] = pipe.pop("chunks")  # serve items are batches
+        out["pipeline"] = pipe
         return out
 
     # -- flusher -------------------------------------------------------------
@@ -543,6 +605,9 @@ class MicroBatcher:
         return claimed
 
     def _run(self) -> None:
+        if self._ring is not None:
+            self._run_pipelined()
+            return
         while True:
             batch = self._take_batch()
             if batch is None:
@@ -560,6 +625,102 @@ class MicroBatcher:
                 reqtrace.end_batch(token)
                 self._account_batch(bt, batch)
 
+    # -- pipelined flusher (ISSUE 18) ----------------------------------------
+    def _begin_batch(self, batch: List[_Request]) -> Callable[[], Sequence[Any]]:
+        """Run the staged scorer's begin stage (encode + async device
+        dispatch) and return its finalize closure.  Scorers without the
+        staged ``begin_*`` protocol defer the whole lockstep dispatch to
+        finalize — full semantics, no overlap."""
+        records = [r.record for r in batch]
+        if self._fleet:
+            begin = getattr(self._score, "begin_isolated_tenants", None)
+            tenants = [r.tenant for r in batch]
+            if callable(begin):
+                return begin(records, tenants)
+            return lambda: self._score.score_isolated_tenants(records,
+                                                              tenants)
+        if self._isolated:
+            begin = getattr(self._score, "begin_isolated", None)
+            if callable(begin):
+                return begin(records)
+            return lambda: self._score.score_isolated(records)
+        begin = getattr(self._score, "begin_score", None)
+        if callable(begin):
+            return begin(records)
+        return lambda: self._score(records)
+
+    def _run_pipelined(self) -> None:
+        """Producer half of the double-buffered flush path: claim batch
+        N+1, run its encode + async device dispatch under its own batch
+        trace, and stage it in the in-flight ring while the finalizer
+        thread is still busy with batch N's device sync + host remainder.
+        A begin-stage exception is deferred into the finalize closure, so
+        the batch-level failure path (futures, counters, request tracks)
+        runs in ONE place on the finalizer thread, exactly as in lockstep.
+        """
+        try:
+            while True:
+                batch = self._take_batch()
+                if batch is None:
+                    return
+                batch = self._claim(batch)
+                if not batch:
+                    continue
+                t_claim = time.monotonic()
+                bt, token = reqtrace.begin_batch(len(batch))
+                t0 = time.perf_counter()
+                try:
+                    fin = self._begin_batch(batch)
+                except Exception as e:  # noqa: BLE001 — re-raised at finalize
+                    err = e
+
+                    def fin(_e: BaseException = err) -> Sequence[Any]:
+                        raise _e
+                finally:
+                    reqtrace.end_batch(token)
+                self._pipe_stats.add_load(time.perf_counter() - t0)
+                self._ring.put((batch, bt, t_claim, fin))
+        finally:
+            self._ring.close()
+
+    def _finalize_loop(self) -> None:
+        """Consumer half: sync batch N's device outputs, run its host
+        remainder, route futures, and account the batch — in flush order,
+        re-entering the flusher's batch trace via ``reqtrace.batch_scope``
+        so host-phase marks land on the right BatchTrace.  Ring waits on an
+        empty window are starvation (the finalizer outran the flusher's
+        encode) and count toward the canonical stall counter."""
+        while True:
+            empty = self._ring.empty()
+            t0 = time.perf_counter()
+            item = self._ring.get()
+            wait = time.perf_counter() - t0
+            stalled = empty and wait > STALL_THRESHOLD_S and item is not None
+            self._pipe_stats.add_wait(wait, stalled=stalled)
+            if stalled:
+                self._c_pipe_stalls.inc()
+            if item is None:
+                return
+            batch, bt, t_claim, fin = item
+            try:
+                with reqtrace.batch_scope(bt):
+                    self._flush_finalize(batch, bt, t_claim, fin)
+            finally:
+                self._account_batch(bt, batch)
+                self._pipe_stats.add_chunk()
+                self._g_pipe_overlap.set(self._pipe_stats.overlap_fraction)
+                self._ring.task_done()
+
+    def _flush_finalize(self, batch: List[_Request], bt, t_claim: float,
+                        fin: Callable[[], Sequence[Any]]) -> None:
+        # pipelined twin of _flush: the serve.flush span lives on the
+        # finalizer thread; phase spans carry batch_seq, so the causal
+        # chain joins on the key, not the tid (obs/reqtrace.py)
+        with obs_trace.span("serve.flush", cat="serve",
+                            batch=len(batch), batch_seq=bt.seq,
+                            pipelined=True):
+            self._route_results(batch, bt, t_claim, lambda: fin())
+
     def _flush(self, batch: List[_Request], bt) -> None:
         t_claim = time.monotonic()
         # serve.flush: the whole batch lifecycle on this worker thread —
@@ -567,73 +728,83 @@ class MicroBatcher:
         # batch_seq is the join key per-request async events link through
         with obs_trace.span("serve.flush", cat="serve",
                             batch=len(batch), batch_seq=bt.seq):
-            try:
-                if self._fleet:
-                    results = self._score.score_isolated_tenants(
-                        [r.record for r in batch],
-                        [r.tenant for r in batch])
-                elif self._isolated:
-                    results = self._score.score_isolated(
-                        [r.record for r in batch])
-                else:
-                    results = self._score([r.record for r in batch])
-                if len(results) != len(batch):
-                    raise RuntimeError(
-                        f"score_batch returned {len(results)} results "
-                        f"for {len(batch)} records")
-            except Exception as e:  # noqa: BLE001 - failures to futures
-                self._c_failed.inc(len(batch))
-                self._c_batches.inc()
-                self._h_batch_size.observe(len(batch))
-                # per-tenant failed series too: the SLO burn-rate monitor
-                # reads only labeled counters, and a batch-level scorer
-                # failure is exactly the incident it must not be blind to
-                tenant_failed: Dict[str, int] = {}
-                for r in batch:
-                    if r.tenant is not None:
-                        tenant_failed[r.tenant] = \
-                            tenant_failed.get(r.tenant, 0) + 1
-                for tenant, n in tenant_failed.items():
-                    self._tenant_counter(
-                        "tmog_serve_batcher_failed_total", tenant).inc(n)
-                err = f"error:{type(e).__name__}"
-                for r in batch:
-                    r.future.set_exception(e)
-                self._emit_request_tracks(
-                    bt, t_claim,
-                    [(r, err) for r in batch if r.ctx is not None])
-                return
-            now = time.monotonic()
-            ok = [not isinstance(res, Exception) for res in results]
-            self._c_completed.inc(sum(ok))
-            self._c_failed.inc(len(batch) - sum(ok))
+            self._route_results(batch, bt, t_claim, lambda: self._dispatch(batch))
+
+    def _dispatch(self, batch: List[_Request]) -> Sequence[Any]:
+        """Lockstep scorer dispatch across the three scorer protocols."""
+        if self._fleet:
+            return self._score.score_isolated_tenants(
+                [r.record for r in batch],
+                [r.tenant for r in batch])
+        if self._isolated:
+            return self._score.score_isolated(
+                [r.record for r in batch])
+        return self._score([r.record for r in batch])
+
+    def _route_results(self, batch: List[_Request], bt, t_claim: float,
+                       score_fn: Callable[[], Sequence[Any]]) -> None:
+        """Score the batch and route results/failures to futures + counters
+        — the shared body of the lockstep ``_flush`` and the pipelined
+        ``_flush_finalize`` (identical accounting either way)."""
+        try:
+            results = score_fn()
+            if len(results) != len(batch):
+                raise RuntimeError(
+                    f"score_batch returned {len(results)} results "
+                    f"for {len(batch)} records")
+        except Exception as e:  # noqa: BLE001 - failures to futures
+            self._c_failed.inc(len(batch))
             self._c_batches.inc()
             self._h_batch_size.observe(len(batch))
-            tenant_outcomes: Dict[Tuple[str, bool], int] = {}
-            for r, good in zip(batch, ok):
+            # per-tenant failed series too: the SLO burn-rate monitor
+            # reads only labeled counters, and a batch-level scorer
+            # failure is exactly the incident it must not be blind to
+            tenant_failed: Dict[str, int] = {}
+            for r in batch:
                 if r.tenant is not None:
-                    key = (r.tenant, good)
-                    tenant_outcomes[key] = tenant_outcomes.get(key, 0) + 1
-                if good:
-                    lat = now - r.t_enqueue
-                    self._h_latency.observe(lat)
-                    if r.tenant is not None:
-                        self._tenant_latency(r.tenant).observe(lat)
-            for (tenant, good), n in tenant_outcomes.items():
-                name = "tmog_serve_batcher_completed_total" if good \
-                    else "tmog_serve_batcher_failed_total"
-                self._tenant_counter(name, tenant).inc(n)
-            tracked = []
-            for r, res, good in zip(batch, results, ok):
-                if good:
-                    r.future.set_result(res)
-                else:
-                    r.future.set_exception(res)
-                if r.ctx is not None:
-                    tracked.append(
-                        (r, "ok" if good
-                         else f"error:{type(res).__name__}"))
-            self._emit_request_tracks(bt, t_claim, tracked)
+                    tenant_failed[r.tenant] = \
+                        tenant_failed.get(r.tenant, 0) + 1
+            for tenant, n in tenant_failed.items():
+                self._tenant_counter(
+                    "tmog_serve_batcher_failed_total", tenant).inc(n)
+            err = f"error:{type(e).__name__}"
+            for r in batch:
+                r.future.set_exception(e)
+            self._emit_request_tracks(
+                bt, t_claim,
+                [(r, err) for r in batch if r.ctx is not None])
+            return
+        now = time.monotonic()
+        ok = [not isinstance(res, Exception) for res in results]
+        self._c_completed.inc(sum(ok))
+        self._c_failed.inc(len(batch) - sum(ok))
+        self._c_batches.inc()
+        self._h_batch_size.observe(len(batch))
+        tenant_outcomes: Dict[Tuple[str, bool], int] = {}
+        for r, good in zip(batch, ok):
+            if r.tenant is not None:
+                key = (r.tenant, good)
+                tenant_outcomes[key] = tenant_outcomes.get(key, 0) + 1
+            if good:
+                lat = now - r.t_enqueue
+                self._h_latency.observe(lat)
+                if r.tenant is not None:
+                    self._tenant_latency(r.tenant).observe(lat)
+        for (tenant, good), n in tenant_outcomes.items():
+            name = "tmog_serve_batcher_completed_total" if good \
+                else "tmog_serve_batcher_failed_total"
+            self._tenant_counter(name, tenant).inc(n)
+        tracked = []
+        for r, res, good in zip(batch, results, ok):
+            if good:
+                r.future.set_result(res)
+            else:
+                r.future.set_exception(res)
+            if r.ctx is not None:
+                tracked.append(
+                    (r, "ok" if good
+                     else f"error:{type(res).__name__}"))
+        self._emit_request_tracks(bt, t_claim, tracked)
 
     def _emit_request_tracks(self, bt, t_claim: float, tracked) -> None:
         """Export the flushed batch's request tracks as ONE ring slot

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import threading
 import time
+import warnings
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
@@ -56,6 +57,7 @@ from ..workflow.plan import (  # noqa: F401 — re-exports
     run_host_stages,
     stage_content_fingerprint,
 )
+from ..perf.kernels.dispatch import serve_donation
 from .faults import fault_point
 
 #: process-wide AOT executable cache: (plan fingerprint, bucket) -> compiled.
@@ -202,6 +204,12 @@ class CompiledScoringPlan:
         self._generators = self._collect_generators()
         self._build_entries()
         self._build_wiring()
+        # donation choice resolved ONCE at construction, before the
+        # fingerprint: stage_content_fingerprint(environment=True) folds the
+        # dispatch cache_token (which carries the same env read) into the
+        # executable-cache key, so a donated plan can never alias a
+        # non-donated build even when the env flips later (ISSUE 18)
+        self._donate = serve_donation() and bool(self._prefix)
         self._fingerprint = self._compute_fingerprint()
 
         if hbm_budget is not None:
@@ -233,6 +241,13 @@ class CompiledScoringPlan:
     @property
     def fingerprint(self) -> str:
         return self._fingerprint
+
+    @property
+    def donated(self) -> bool:
+        """Whether this plan's executables are compiled with
+        ``donate_argnums`` on the padded entry buffers
+        (``TMOG_SERVE_DONATE``, resolved at construction)."""
+        return self._donate
 
     @property
     def content_fingerprint(self) -> str:
@@ -421,11 +436,31 @@ class CompiledScoringPlan:
                 specs = [jax.ShapeDtypeStruct((bucket,) + trailing,
                                               np.dtype(dtype))
                          for trailing, dtype in self._entry_specs]
+                # the donated variant consumes its padded entry buffers
+                # after dispatch — safe because score()'s encode stage
+                # builds FRESH arrays per batch and nothing re-reads them
+                # past the call; distinct executable, distinct fingerprint
+                # (cache_token carries ":serve-donate")
+                donate = tuple(range(len(specs))) if self._donate else ()
                 with obs_flight.compile_context(
                         "serve.plan", fingerprint=self._fingerprint,
                         warm=self._warmed):
-                    compiled = jax.jit(self._fused).lower(  # opcheck: allow(TM303) once per bucket under _compile_lock, AOT-cached
-                        *specs).compile()
+                    if donate:
+                        with warnings.catch_warnings():
+                            # backends without donation support (CPU) warn
+                            # "Some donated buffers were not usable" at
+                            # lowering — donation is then a no-op, not an
+                            # error; keep CI logs clean
+                            warnings.filterwarnings(
+                                "ignore",
+                                message=".*donated buffers were not usable.*")
+                            compiled = jax.jit(  # opcheck: allow(TM303) once per bucket under _compile_lock, AOT-cached
+                                self._fused,
+                                donate_argnums=donate).lower(
+                                *specs).compile()
+                    else:
+                        compiled = jax.jit(self._fused).lower(  # opcheck: allow(TM303) once per bucket under _compile_lock, AOT-cached
+                            *specs).compile()
                 self.compile_count += 1
                 with _EXEC_CACHE_LOCK:
                     _EXEC_CACHE[key] = compiled
@@ -526,16 +561,43 @@ class CompiledScoringPlan:
         """Batch scoring: fused device prefix + host remainder.
 
         Output contract is identical to ``LocalScorer.batch``: one plain
-        ``{result feature name: python value}`` dict per input record.
+        ``{result feature name: python value}`` dict per record.  Defined as
+        the strict composition of :meth:`begin_score` and its finalize
+        closure, so lockstep and pipelined serving run the SAME code in the
+        same order — bitwise parity by construction (ISSUE 18).
+        """
+        return self.begin_score(records)()
+
+    def begin_score(self, records: Sequence[Mapping[str, Any]]
+                    ) -> Callable[[], List[Dict[str, Any]]]:
+        """Stage-split scoring entry for the pipelined batcher.
+
+        Runs the host ENCODE stage and the async DEVICE dispatch now (the
+        compiled call returns device futures without blocking), and returns
+        a zero-argument FINALIZE closure that materializes the device
+        outputs (the blocking sync), runs the host remainder, bumps the
+        counters, and returns the result rows.  While the caller holds the
+        un-finalized closure the device crunches batch N in the background —
+        the pipelined flush loop encodes batch N+1 meanwhile and overlaps
+        batch N's host remainder with batch N+1's dispatch.
+
+        Batch-trace/tenant attribution is captured HERE (the submitting
+        thread's contextvars) and baked into the closure; the pipelined
+        batcher re-enters the batch scope on its finalizer thread via
+        ``reqtrace.batch_scope`` so the host-phase marks land on the right
+        ``BatchTrace``.  Oversized batches (> max_bucket) defer entirely to
+        the finalize stage (no overlap — the batcher never builds them).
         """
         n = len(records)
         if n == 0:
-            return []
+            return lambda: []
         if n > self.max_bucket:
-            out: List[Dict[str, Any]] = []
-            for i in range(0, n, self.max_bucket):
-                out.extend(self.score(records[i:i + self.max_bucket]))
-            return out
+            def _finalize_split() -> List[Dict[str, Any]]:
+                out: List[Dict[str, Any]] = []
+                for i in range(0, n, self.max_bucket):
+                    out.extend(self.score(records[i:i + self.max_bucket]))
+                return out
+            return _finalize_split
 
         from ..readers.base import extract_columns
 
@@ -543,10 +605,14 @@ class CompiledScoringPlan:
         # the per-tenant device-time cost counters, and the tenant arg on
         # the phase spans lets one trace.json attribute a fleet flush's
         # sub-batch dispatches to their tenants.  One contextvar read each
-        # when no batch trace / tenant scope is active.
+        # when no batch trace / tenant scope is active.  batch_seq rides
+        # every phase span so reconstruct_request can rebuild the causal
+        # chain even when pipelined batches interleave phases in time.
         bt = reqtrace.active_batch()
         tenant = reqtrace.current_tenant()
-        t_attr = {} if tenant is None else {"tenant": tenant}
+        t_attr: Dict[str, Any] = {} if tenant is None else {"tenant": tenant}
+        if bt is not None:
+            t_attr["batch_seq"] = bt.seq
 
         t0 = time.perf_counter() if bt is not None else 0.0
         with obs_trace.span("serve.encode", cat="serve", records=n,
@@ -576,6 +642,8 @@ class CompiledScoringPlan:
         if bt is not None:
             reqtrace.mark_phase("encode", t0, time.perf_counter() - t0,
                                 records=n)
+        bucket = 0
+        outs = None
         if self._prefix:
             bucket = _bucket_for(n, self.min_bucket, self.max_bucket)
             compiled = self._ensure_compiled(bucket)
@@ -584,36 +652,45 @@ class CompiledScoringPlan:
                                 bucket=bucket, padded=bucket - n, **t_attr):
                 fault_point("device", records=records, bucket=bucket)
                 with maybe_profile("serve"):  # TMOG_PROFILE dispatch hook
+                    # async dispatch: returns device futures; the blocking
+                    # np.asarray sync happens in finalize.  The padded
+                    # buffers are fresh per batch, so the donated variant
+                    # may consume them.
                     outs = compiled(*[_pad_rows(a, bucket) for a in entries])
             if bt is not None:
                 reqtrace.mark_phase("device", t0,
                                     time.perf_counter() - t0,
                                     records=n, bucket=bucket,
                                     padded=bucket - n)
-            for f, dev in zip(self._out_features, outs):
-                cols[f.name] = self._materialize(f, np.asarray(dev)[:n])
 
-        t0 = time.perf_counter() if bt is not None else 0.0
-        with obs_trace.span("serve.host", cat="serve", records=n, **t_attr):
-            fault_point("host", records=records)
-            # per-stage phase spans only at the heavy "requests" detail:
-            # serve.host already times the whole remainder, and the default
-            # batch detail must stay inside the <5% enabled-overhead gate
-            tracer = obs_trace.active_tracer()
-            ds = run_host_stages(
-                Dataset(cols), self._remainder,
-                phases=tracer is None or tracer.detail == "requests")
-            out = self._rows_from(ds, n)
-        if bt is not None:
-            reqtrace.mark_phase("host", t0, time.perf_counter() - t0,
-                                records=n)
-        with self._lock:
-            self._counters["scored_records"] += n
-            self._counters["scored_batches"] += 1
-            if self._prefix:
-                bb = self._counters["bucket_batches"]
-                bb[bucket] = bb.get(bucket, 0) + 1
-        return out
+        def _finalize() -> List[Dict[str, Any]]:
+            if outs is not None:
+                for f, dev in zip(self._out_features, outs):
+                    cols[f.name] = self._materialize(f, np.asarray(dev)[:n])
+            t0 = time.perf_counter() if bt is not None else 0.0
+            with obs_trace.span("serve.host", cat="serve", records=n,
+                                **t_attr):
+                fault_point("host", records=records)
+                # per-stage phase spans only at the heavy "requests" detail:
+                # serve.host already times the whole remainder, and the
+                # default batch detail must stay inside the <5%
+                # enabled-overhead gate
+                tracer = obs_trace.active_tracer()
+                ds = run_host_stages(
+                    Dataset(cols), self._remainder,
+                    phases=tracer is None or tracer.detail == "requests")
+                out = self._rows_from(ds, n)
+            if bt is not None:
+                reqtrace.mark_phase("host", t0, time.perf_counter() - t0,
+                                    records=n)
+            with self._lock:
+                self._counters["scored_records"] += n
+                self._counters["scored_batches"] += 1
+                if self._prefix:
+                    bb = self._counters["bucket_batches"]
+                    bb[bucket] = bb.get(bucket, 0) + 1
+            return out
+        return _finalize
 
     def score_dataset(self, dataset, sink=None):
         """Columnar batch scoring of a (possibly chunked) dataset.

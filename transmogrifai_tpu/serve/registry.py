@@ -490,7 +490,8 @@ class FleetServer:
                  deadline_ms: Optional[float] = None,
                  hbm_budget: Optional[float] = None,
                  slo_classes: Optional[Mapping[str, SloClass]] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 pipeline_depth: Optional[int] = None):
         if max_bucket is None:
             max_bucket = default_max_bucket(max_batch, min_bucket)
         self.registry = registry if registry is not None else MetricsRegistry()
@@ -504,7 +505,8 @@ class FleetServer:
                                     max_wait_ms=max_wait_ms,
                                     max_queue=max_queue,
                                     registry=self.registry,
-                                    slo_classes=self.models.slo_classes)
+                                    slo_classes=self.models.slo_classes,
+                                    pipeline_depth=pipeline_depth)
         #: armed by :meth:`arm_slo_monitor`; polled by statusz()/`cli top`
         self.slo_monitor = None
         #: {tenant: (monotonic ts, completed)} — the statusz() rps baseline
@@ -518,6 +520,7 @@ class FleetServer:
         return self
 
     def unregister(self, tenant: str) -> None:
+        self.batcher.drain_pipeline()  # in-flight batches may hold the tenant
         self.models.unregister(tenant)
         self.batcher.drop_tenant(tenant)
 
@@ -529,10 +532,16 @@ class FleetServer:
 
     def promote(self, tenant: str, probation_batches: int = 8
                 ) -> Dict[str, Any]:
+        # drain the pipelined window first (no-op in lockstep): in-flight
+        # batches complete on the entry they captured at begin, so the
+        # promotion can never split one — draining makes the cutover
+        # observable-clean for the swap record and probation accounting
+        self.batcher.drain_pipeline()
         return self.models.promote(tenant,
                                    probation_batches=probation_batches)
 
     def rollback(self, tenant: str, reason: str = "manual") -> Dict[str, Any]:
+        self.batcher.drain_pipeline()
         return self.models.rollback(tenant, reason=reason)
 
     def discard_candidate(self, tenant: str) -> None:
@@ -604,6 +613,64 @@ class FleetServer:
                     self.batcher.set_degraded(
                         tenant, breaker.state != breaker.CLOSED)
         return out
+
+    def begin_isolated_tenants(self, records: Sequence[Mapping[str, Any]],
+                               tenants: Sequence[Optional[str]]
+                               ) -> Any:
+        """Staged variant of :meth:`score_isolated_tenants` for the
+        pipelined batcher (serve/pipeline.py): every tenant sub-batch runs
+        its ENCODE + async device dispatch now (under its tenant scope, on
+        the flusher thread) and returns one finalize closure that syncs
+        device outputs, runs host remainders, and performs the per-tenant
+        bookkeeping (LRU clock, scored counters, breaker-driven degraded
+        set) on the finalizer thread.  Routing errors and begin-stage
+        failures are captured per sub-batch and surface as that tenant's
+        outcomes at finalize — the same isolation contract as lockstep."""
+        groups: Dict[Optional[str], List[int]] = {}
+        for i, t in enumerate(tenants):
+            groups.setdefault(t, []).append(i)
+        staged: List[Any] = []  # (tenant, idxs, state, sub, fin | None, err)
+        for tenant, idxs in groups.items():
+            sub = [records[i] for i in idxs]
+            try:
+                if tenant is None:
+                    raise UnknownTenantError(
+                        "fleet submit requires a tenant id")
+                state = self.models.get(tenant)
+                fault_point("route", tenant=tenant, records=len(sub))
+                with reqtrace.tenant_scope(tenant):
+                    fin = state.swapper.begin_isolated(sub)
+                staged.append((tenant, idxs, state, sub, fin, None))
+            except Exception as e:  # noqa: BLE001 — outcome-shaped per tenant
+                staged.append((tenant, idxs, None, sub, None, e))
+
+        def _finalize() -> List[Any]:
+            out: List[Any] = [None] * len(records)
+            for tenant, idxs, state, sub, fin, err in staged:
+                if err is not None:
+                    results: Sequence[Any] = [err] * len(sub)
+                else:
+                    try:
+                        with reqtrace.tenant_scope(tenant):
+                            results = fin()
+                    except Exception as e:  # noqa: BLE001
+                        results = [e] * len(sub)
+                        state = None
+                for i, r in zip(idxs, results):
+                    out[i] = r
+                if state is not None:
+                    state.last_scored = time.monotonic()
+                    ok = sum(1 for r in results
+                             if not isinstance(r, Exception))
+                    if ok:
+                        self.models._scored_counter(tenant).inc(ok)
+                    breaker = state.breaker()
+                    if breaker is not None:
+                        self.batcher.set_degraded(
+                            tenant, breaker.state != breaker.CLOSED)
+            return out
+
+        return _finalize
 
     # -- lifecycle -----------------------------------------------------------
     def close(self, drain: bool = True,
@@ -730,5 +797,8 @@ class FleetServer:
                 "shed": batcher["shed"],
                 "device_seconds": batcher["device_seconds"],
                 "slo_monitor_armed": self.slo_monitor is not None,
+                "pipeline_depth": batcher["pipeline"]["depth"],
+                "pipeline_overlap": batcher["pipeline"]["overlap_fraction"],
+                "pipeline_stalls": batcher["pipeline"]["stalls"],
             },
         }

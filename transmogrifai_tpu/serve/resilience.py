@@ -233,27 +233,92 @@ class ResilientScorer:
                 self.breaker.record_success()
                 return out
             except Exception as e:  # noqa: BLE001 — classified below
-                if is_retryable(e):
-                    # infrastructure failure that survived retries AND the
-                    # split-to-smaller-bucket fallback: a device problem, not
-                    # a record problem — count it toward the breaker and
-                    # serve THIS batch degraded from the host path
-                    self.breaker.record_failure()
-                    self._c["device_failures"].inc()
-                    log.warning("device scoring failed after retries (%s: "
-                                "%s); serving batch from the host path",
-                                type(e).__name__, e)
-                    return self._host_fallback(records)
-                # permanent failure: some record(s) in the batch are poison —
-                # bisect so only those are quarantined (halves still get the
-                # transient-retry treatment on the way down)
-                out = self._isolate(list(records), self._device_with_retry, e)
-                if any(not isinstance(r, Exception) for r in out):
-                    # the device path served the survivors: that's a healthy
-                    # plan, so the consecutive-failure count must reset
-                    self.breaker.record_success()
-                return out
+                return self._classify_failure(records, e)
         return self._host_fallback(records)
+
+    def begin_isolated(self, records: Sequence[Mapping[str, Any]]
+                       ) -> Callable[[], List[Any]]:
+        """Stage-split twin of :meth:`score_isolated` for the pipelined
+        batcher: runs the plan's encode + async device dispatch now
+        (``plan.begin_score``) and returns a finalize closure producing the
+        per-record outcomes.
+
+        The breaker decision is made ONCE here (batch granularity, like
+        lockstep); failures at either stage resume the lockstep recovery
+        machinery — ``_device_with_retry`` with the already-observed
+        exception as its first attempt, then the same classification — so
+        retry/bisect/quarantine/fallback accounting is identical and the
+        whole recovery runs on the finalizer thread, operating on this one
+        in-flight batch only (a fault never splits the window)."""
+        if not records:
+            return lambda: []
+        records = list(records)
+        if not self.breaker.allow_device():
+            return lambda: self._host_fallback(records)
+        begin = getattr(self._plan, "begin_score", None)
+        if begin is None:
+            # plan without the staged protocol: the whole lockstep device
+            # attempt defers to finalize (no overlap, full semantics)
+            def _deferred() -> List[Any]:
+                try:
+                    out = self._device_with_retry(records)
+                    self.breaker.record_success()
+                    return out
+                except Exception as e:  # noqa: BLE001 — classified below
+                    return self._classify_failure(records, e)
+            return _deferred
+        try:
+            fin = begin(records)
+        except Exception as e:  # noqa: BLE001 — recovered at finalize
+            err = e
+
+            def _recover_begin() -> List[Any]:
+                return self._resume_after(records, err)
+            return _recover_begin
+
+        def _finalize() -> List[Any]:
+            try:
+                out = fin()
+            except Exception as e:  # noqa: BLE001 — recovered below
+                return self._resume_after(records, e)
+            self.breaker.record_success()
+            return out
+        return _finalize
+
+    def _resume_after(self, records: List[Any], e: BaseException) -> List[Any]:
+        """Re-enter the lockstep retry/classification path after a failed
+        pipelined first attempt: the observed exception stands in for the
+        first ``plan.score`` failure inside ``_device_with_retry``."""
+        try:
+            out = self._device_with_retry(records, pending=e)
+            self.breaker.record_success()
+            return out
+        except Exception as e2:  # noqa: BLE001 — classified below
+            return self._classify_failure(records, e2)
+
+    def _classify_failure(self, records: Sequence[Mapping[str, Any]],
+                          e: BaseException) -> List[Any]:
+        """The post-retry failure classification both entry points share."""
+        if is_retryable(e):
+            # infrastructure failure that survived retries AND the
+            # split-to-smaller-bucket fallback: a device problem, not
+            # a record problem — count it toward the breaker and
+            # serve THIS batch degraded from the host path
+            self.breaker.record_failure()
+            self._c["device_failures"].inc()
+            log.warning("device scoring failed after retries (%s: "
+                        "%s); serving batch from the host path",
+                        type(e).__name__, e)
+            return self._host_fallback(records)
+        # permanent failure: some record(s) in the batch are poison —
+        # bisect so only those are quarantined (halves still get the
+        # transient-retry treatment on the way down)
+        out = self._isolate(list(records), self._device_with_retry, e)
+        if any(not isinstance(r, Exception) for r in out):
+            # the device path served the survivors: that's a healthy
+            # plan, so the consecutive-failure count must reset
+            self.breaker.record_success()
+        return out
 
     def __call__(self, records: Sequence[Mapping[str, Any]]
                  ) -> List[Dict[str, Any]]:
@@ -272,10 +337,18 @@ class ResilientScorer:
         return out
 
     # -- device path ---------------------------------------------------------
-    def _device_with_retry(self, records: List[Any], depth: int = 0):
+    def _device_with_retry(self, records: List[Any], depth: int = 0,
+                           pending: Optional[BaseException] = None):
+        """Retry loop around ``plan.score``.  ``pending`` injects an
+        exception already observed by the pipelined first attempt
+        (``begin_isolated``): it consumes the loop's first try, so the
+        retry/split accounting is identical to lockstep."""
         attempt = 0
         while True:
             try:
+                if pending is not None:
+                    e, pending = pending, None
+                    raise e
                 return self._plan.score(records)
             except Exception as e:  # noqa: BLE001 — classified below
                 if not is_retryable(e):

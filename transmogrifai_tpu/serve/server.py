@@ -116,7 +116,8 @@ class ScoringServer:
                  resilience: Union[bool, Mapping[str, Any]] = True,
                  deadline_ms: Optional[float] = None,
                  hbm_budget: Optional[float] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 pipeline_depth: Optional[int] = None):
         # ONE metrics registry backs the whole server: batcher, swapper, and
         # every model entry's resilience layer (labeled by entry version)
         # register here, so to_prometheus()/snapshot() cover the server.
@@ -144,7 +145,8 @@ class ScoringServer:
         self.batcher = MicroBatcher(self._swapper, max_batch=max_batch,
                                     max_wait_ms=max_wait_ms,
                                     max_queue=max_queue,
-                                    registry=self.registry)
+                                    registry=self.registry,
+                                    pipeline_depth=pipeline_depth)
 
     def _build_entry(self, model, warm: bool = True) -> ModelEntry:
         # hbm_budget arms the TM601 admission gate (serve/validator.py):
@@ -254,10 +256,16 @@ class ScoringServer:
         target, and a breaker trip within ``probation_batches`` flushed
         batches auto-rolls back.  Returns the swap record (plan
         fingerprints + versions)."""
+        # drain the pipelined in-flight window first: batches already begun
+        # complete on the entry they captured (serve/swap.py), so the swap
+        # can never split a batch — draining just makes the cutover
+        # observable-clean (every pre-swap batch routed before the record)
+        self.batcher.drain_pipeline()
         return self._swapper.promote(probation_batches=probation_batches)
 
     def rollback(self) -> Dict[str, Any]:
         """Manually restore the retained last-known-good model."""
+        self.batcher.drain_pipeline()
         return self._swapper.rollback()
 
     def swap_metrics(self) -> Dict[str, Any]:
@@ -312,4 +320,6 @@ class ScoringServer:
             "breaker": breaker,
             "warm_buckets": len(self.plan.warm_buckets()),
             "candidate_staged": self.has_candidate(),
+            "pipeline_depth": bat["pipeline"]["depth"],
+            "pipeline_overlap": bat["pipeline"]["overlap_fraction"],
         }

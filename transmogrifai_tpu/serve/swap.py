@@ -84,6 +84,27 @@ class ModelEntry:
         except Exception as e:  # noqa: BLE001 — outcome-shaped, not raised
             return [e for _ in records]
 
+    def begin_isolated(self, records: Sequence[Mapping[str, Any]]):
+        """Stage-split twin of :meth:`score_isolated` (pipelined batcher):
+        encode + async device dispatch now, per-record outcomes from the
+        returned finalize closure."""
+        if self.resilience is not None:
+            return self.resilience.begin_isolated(records)
+        begin = getattr(self.plan, "begin_score", None)
+        if begin is None:
+            return lambda: self.score_isolated(records)
+        try:
+            fin = begin(records)
+        except Exception as e:  # noqa: BLE001 — outcome-shaped, not raised
+            return lambda: [e for _ in records]
+
+        def _finalize() -> List[Any]:
+            try:
+                return list(fin())
+            except Exception as e:  # noqa: BLE001 — outcome-shaped
+                return [e for _ in records]
+        return _finalize
+
 
 def prediction_delta(a: Any, b: Any) -> Optional[float]:
     """Max abs numeric delta between two result rows (prediction dicts
@@ -187,26 +208,53 @@ class SwappableScorer:
             candidate = self._candidate
         out = entry.score_isolated(records)
         if candidate is not None:
-            # the mirror runs on its own thread, so the flusher's batch
-            # trace contextvar will not reach it — carry the batch_seq
-            # through the queue so the mirror span links into the flushed
-            # batch's causal chain (obs/reqtrace.py)
-            bt = reqtrace.active_batch()
-            batch_seq = bt.seq if bt is not None else None
-            # hand the batch to the mirror worker: the flush thread never
-            # waits on shadow scoring, so a staged candidate cannot delay
-            # primary futures or expire live deadlines
-            with self._shadow_cv:
-                if len(self._shadow_queue) >= _SHADOW_QUEUE_MAX:
-                    self._c["shadow_dropped"].inc(len(records))
-                else:
-                    self._ensure_shadow_thread_locked()
-                    self._shadow_queue.append(
-                        (candidate, list(records), list(out), batch_seq))
-                    self._shadow_pending += 1
-                    self._shadow_cv.notify_all()
+            self._enqueue_shadow(candidate, records, out)
         self._post_batch()
         return out
+
+    def begin_isolated(self, records: Sequence[Mapping[str, Any]]):
+        """Stage-split scoring for the pipelined batcher: the active entry
+        AND the staged candidate are captured ONCE here, under the swap
+        lock — a promote/rollback racing the window finds this batch
+        already bound to its model, so a swap can never split an in-flight
+        batch (the batcher additionally drains the window before mutating —
+        serve/server.py, serve/registry.py).  Shadow mirroring and the
+        probation bookkeeping run at finalize, exactly where the lockstep
+        path runs them relative to the primary outcomes."""
+        with self._lock:
+            entry = self._active
+            candidate = self._candidate
+        fin = entry.begin_isolated(records)
+
+        def _finalize() -> List[Any]:
+            out = fin()
+            if candidate is not None:
+                self._enqueue_shadow(candidate, records, out)
+            self._post_batch()
+            return out
+        return _finalize
+
+    def _enqueue_shadow(self, candidate: ModelEntry,
+                        records: Sequence[Mapping[str, Any]],
+                        out: List[Any]) -> None:
+        # the mirror runs on its own thread, so the flusher's batch
+        # trace contextvar will not reach it — carry the batch_seq
+        # through the queue so the mirror span links into the flushed
+        # batch's causal chain (obs/reqtrace.py)
+        bt = reqtrace.active_batch()
+        batch_seq = bt.seq if bt is not None else None
+        # hand the batch to the mirror worker: the flush thread never
+        # waits on shadow scoring, so a staged candidate cannot delay
+        # primary futures or expire live deadlines
+        with self._shadow_cv:
+            if len(self._shadow_queue) >= _SHADOW_QUEUE_MAX:
+                self._c["shadow_dropped"].inc(len(records))
+            else:
+                self._ensure_shadow_thread_locked()
+                self._shadow_queue.append(
+                    (candidate, list(records), list(out), batch_seq))
+                self._shadow_pending += 1
+                self._shadow_cv.notify_all()
 
     def _ensure_shadow_thread_locked(self) -> None:
         if self._shadow_thread is None or not self._shadow_thread.is_alive():
