@@ -495,6 +495,27 @@ class TestBenchSmoke:
         tuning = parsed["tuning"]
         assert tuning["kernel_mode"] in ("xla", "pallas", "interpret")
         assert tuning["hist_chunk"] >= 1 and tuning["hist_unroll"] >= 1
+        # persistent kernel autotuner (ISSUE 19): every family sweeps ONCE
+        # into the bench-local store, every candidate that won is verified,
+        # and a fresh adoption state re-answers entirely from the warm
+        # store at zero further sweeps
+        assert secs["autotune"]["status"] == "ok", secs["autotune"]
+        at = parsed["autotune"]
+        assert at["gate_sweep_once_then_cached"] is True, at
+        assert at["gate_all_verified"] is True, at
+        assert at["sweeps_warm_store"] == 0, at
+        assert set(at["families"]) == {"hist", "split", "encode", "route"}
+        for fam, rec in at["families"].items():
+            assert rec["verified"] is True, (fam, rec)
+            assert rec["candidates"] >= 1, (fam, rec)
+        # reduced-precision scoring classes (ISSUE 19): the serve section's
+        # bf16 twin scores the same records within the TM511 class bound
+        # and forks the fingerprint (no executable/artifact aliasing)
+        assert sv["gate_bf16_within_bound"] is True, sv
+        assert sv["gate_precision_forks_fingerprint"] is True, sv
+        assert sv["bf16_plan_rps"] > 0 and sv["f32_plan_rps"] > 0
+        assert sv["bf16_max_prediction_delta"] is not None
+        assert sv["bf16_max_prediction_delta"] <= 1e-2, sv
 
     def test_bench_emits_json_under_sigterm_mid_section(self):
         """Regression for the PR 3 signal handlers (the BENCH_r05 rc=124 run

@@ -1,0 +1,256 @@
+"""Reduced-precision scoring-prefix classes (serve/plan.py, ISSUE 19).
+
+Pins the precision-class contracts end to end: class normalization
+(fail-closed on unknown names), fingerprint forking (a reduced class must
+never share executables or deploy artifacts with f32 — while ``f32`` itself
+stays byte-identical to the pre-precision fingerprint), per-class
+determinism, the TM511 calibration parity gate at registry admission
+(including its fail-closed refusals), the TM507 precision-class swap
+refusal, NaN missing-value safety through the int8 quantizer, and the
+fleet surfaces (metrics / statusz / ``cli top``) naming each tenant's
+class.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import (
+    BinaryClassificationModelSelector,
+    FeatureBuilder,
+    Workflow,
+    transmogrify,
+)
+from transmogrifai_tpu.checkers.diagnostics import OpCheckError
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.readers.files import DataReaders
+from transmogrifai_tpu.serve import (
+    TM511_BOUNDS,
+    Precision,
+    check_precision_parity,
+    compile_plan,
+)
+from transmogrifai_tpu.serve.registry import ModelRegistry
+from transmogrifai_tpu.types import Prediction
+
+MIN_BUCKET, MAX_BUCKET = 8, 64
+
+
+@pytest.fixture(scope="module")
+def model_and_records():
+    rng = np.random.default_rng(7)
+    n = 400
+    x1 = rng.normal(0, 1, n)
+    color = rng.choice(["red", "green", "blue"], n)
+    age = np.where(rng.random(n) < 0.15, None, rng.normal(40, 10, n))
+    y = (rng.random(n) < 1 / (1 + np.exp(-(1.5 * x1 + (color == "red"))))
+         ).astype(float)
+    records = [
+        {"label": float(y[i]), "x1": float(x1[i]), "color": str(color[i]),
+         "age": None if age[i] is None else float(age[i])}
+        for i in range(n)
+    ]
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    f_x1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    f_color = FeatureBuilder.PickList("color").extract_field().as_predictor()
+    f_age = FeatureBuilder.Real("age").extract_field().as_predictor()
+    checked = label.sanity_check(transmogrify([f_x1, f_color, f_age]))
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+    pred = label.transform_with(sel, checked)
+    model = (Workflow().set_result_features(label, pred)
+             .set_reader(DataReaders.Simple.dataframe(pd.DataFrame(records)))
+             ).train()
+    return model, records
+
+
+def _plan(model, precision=None):
+    return compile_plan(model, min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET,
+                        strict=False, precision=precision)
+
+
+class TestPrecisionClass:
+    def test_normalize_aliases(self):
+        assert Precision.normalize(None) == Precision.F32
+        assert Precision.normalize("f32") == Precision.F32
+        assert Precision.normalize("float32") == Precision.F32
+        assert Precision.normalize("FP32") == Precision.F32
+        assert Precision.normalize("bf16") == Precision.BF16
+        assert Precision.normalize("BFloat16") == Precision.BF16
+        assert Precision.normalize("int8") == Precision.INT8
+        assert Precision.normalize("i8") == Precision.INT8
+
+    def test_unknown_class_refused_fail_closed(self):
+        with pytest.raises(ValueError, match="precision"):
+            Precision.normalize("fp8")
+
+    def test_every_reduced_class_has_a_documented_bound(self):
+        assert TM511_BOUNDS[Precision.BF16] == 1e-2
+        assert TM511_BOUNDS[Precision.INT8] == 5e-2
+        assert Precision.F32 not in TM511_BOUNDS  # f32 needs no gate
+
+
+class TestFingerprints:
+    def test_f32_fingerprint_does_not_move(self, model_and_records):
+        """The precision feature must not perturb pre-existing f32
+        fingerprints: f32 tenants keep sharing executables and deploy
+        artifacts fleet-wide across this change."""
+        model = model_and_records[0]
+        assert _plan(model).fingerprint == \
+            _plan(model, precision="float32").fingerprint
+
+    def test_reduced_classes_fork_the_fingerprint(self, model_and_records):
+        model = model_and_records[0]
+        fps = {p: _plan(model, precision=p).fingerprint
+               for p in (None, "bf16", "int8")}
+        assert len(set(fps.values())) == 3, fps
+
+    def test_precision_property(self, model_and_records):
+        model = model_and_records[0]
+        assert _plan(model).precision == "f32"
+        assert _plan(model, precision="bf16").precision == "bf16"
+        assert _plan(model, precision="i8").precision == "int8"
+
+
+class TestParity:
+    @pytest.mark.parametrize("precision", ["bf16", "int8"])
+    def test_deterministic_and_within_bound(self, model_and_records,
+                                            precision):
+        model, records = model_and_records
+        f32 = _plan(model)
+        reduced = _plan(model, precision=precision)
+        batch = [{k: v for k, v in r.items() if k != "label"}
+                 for r in records[:128]]
+        # deterministic per input: two plans of the same class agree bitwise
+        assert reduced.score(batch) == \
+            _plan(model, precision=precision).score(batch)
+        report = check_precision_parity(f32, reduced, records=batch)
+        assert not report.errors(), report.pretty()
+        delta = report.max_precision_delta
+        assert delta is not None
+        assert 0.0 < delta <= TM511_BOUNDS[Precision.normalize(precision)]
+
+    def test_synthetic_gate_runs_without_records(self, model_and_records):
+        model = model_and_records[0]
+        report = check_precision_parity(_plan(model),
+                                        _plan(model, precision="bf16"))
+        assert not report.errors(), report.pretty()
+        assert report.max_precision_delta is not None
+
+    def test_continuous_scores_bounded_not_argmax(self, model_and_records):
+        """The gate bounds probability/raw-margin deltas; the argmax class
+        label is a step function a boundary record may legitimately flip,
+        so it is excluded from the measured delta."""
+        model, records = model_and_records
+        batch = [{k: v for k, v in r.items() if k != "label"}
+                 for r in records[:128]]
+        rows = _plan(model, precision="int8").score(batch)
+        pred_name = next(n for n, v in rows[0].items()
+                         if isinstance(v, dict))
+        assert Prediction.PredictionName in rows[0][pred_name]
+
+    def test_int8_quantizer_is_nan_safe(self, model_and_records):
+        """NaN is the canonical missing-value lift: it must pass through
+        the int8 class untouched AND not poison the finite values' scale."""
+        import jax.numpy as jnp
+
+        plan = _plan(model_and_records[0], precision="int8")
+        x = jnp.asarray([1.0, -3.5, jnp.nan, 0.25, jnp.inf, 0.0],
+                        jnp.float32)
+        out = np.asarray(plan._lower_entry(x))
+        assert np.isnan(out[2]) and np.isinf(out[4])
+        finite = np.isfinite(x)
+        assert np.allclose(out[finite], np.asarray(x)[finite],
+                           atol=3.5 / 127 + 1e-6)
+        # all-zero tensors are exact (scale floor, no 0/0)
+        zeros = plan._lower_entry(jnp.zeros(8, jnp.float32))
+        assert np.array_equal(np.asarray(zeros), np.zeros(8))
+
+
+class TestRegistryGate:
+    def test_reduced_class_admitted_with_calibration(self, model_and_records):
+        model, records = model_and_records
+        reg = ModelRegistry(min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET)
+        state = reg.register("quant", model, precision="bf16",
+                             calibration=records[:64], warm=False)
+        assert state.swapper.active.plan.precision == "bf16"
+        assert reg.metrics()["tenants"]["quant"]["precision"] == "bf16"
+        reg.unregister("quant")
+
+    def test_tightened_bound_refuses_fail_closed(self, model_and_records,
+                                                 monkeypatch):
+        model, records = model_and_records
+        monkeypatch.setitem(TM511_BOUNDS, Precision.BF16, 1e-12)
+        reg = ModelRegistry(min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET)
+        with pytest.raises(OpCheckError, match="TM511"):
+            reg.register("quant", model, precision="bf16",
+                         calibration=records[:64], warm=False)
+        assert "quant" not in reg  # refusal admitted NOTHING
+
+    def test_undocumented_bound_refuses_fail_closed(self, model_and_records,
+                                                    monkeypatch):
+        model, records = model_and_records
+        monkeypatch.delitem(TM511_BOUNDS, Precision.INT8)
+        reg = ModelRegistry(min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET)
+        with pytest.raises(OpCheckError, match="TM511"):
+            reg.register("quant", model, precision="int8",
+                         calibration=records[:64], warm=False)
+
+    def test_swap_to_other_precision_refused_tm507(self, model_and_records):
+        model, records = model_and_records
+        reg = ModelRegistry(min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET)
+        reg.register("t", model, warm=False)
+        with pytest.raises(OpCheckError, match="TM507"):
+            reg.stage_candidate("t", model, precision="bf16", warm=False,
+                                calibration=records[:64])
+        reg.unregister("t")
+
+    def test_same_precision_swap_stages(self, model_and_records):
+        model, records = model_and_records
+        reg = ModelRegistry(min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET)
+        reg.register("t", model, precision="bf16",
+                     calibration=records[:64], warm=False)
+        fp = reg.stage_candidate("t", model, precision="bf16", warm=False,
+                                 calibration=records[:64])
+        assert fp
+        reg.unregister("t")
+
+    def test_f32_coresident_with_reduced_class(self, model_and_records):
+        """An f32 tenant and a bf16 tenant of the SAME model coexist with
+        distinct fingerprints (no executable aliasing) while the f32
+        tenant's fingerprint equals a standalone f32 plan's."""
+        model, records = model_and_records
+        reg = ModelRegistry(min_bucket=MIN_BUCKET, max_bucket=MAX_BUCKET)
+        a = reg.register("full", model, warm=False)
+        b = reg.register("quant", model, precision="bf16",
+                         calibration=records[:64], warm=False)
+        assert a.swapper.active.fingerprint != b.swapper.active.fingerprint
+        assert a.swapper.active.fingerprint == _plan(model).fingerprint
+        m = reg.metrics()["tenants"]
+        assert m["full"]["precision"] == "f32"
+        assert m["quant"]["precision"] == "bf16"
+        reg.unregister("full")
+        reg.unregister("quant")
+
+
+class TestConsoleRendering:
+    def test_top_renders_precision_column(self):
+        from transmogrifai_tpu.cli.top import format_statusz
+
+        frame = format_statusz({
+            "ts": 0, "fleet": {"tenants": 2},
+            "tenants": {
+                "full": {"slo": "gold", "precision": "f32", "rps": 10.0,
+                         "device_seconds": 0.0},
+                "quant": {"slo": "bronze", "precision": "bf16", "rps": 9.0,
+                          "device_seconds": 0.0},
+            }})
+        header, full_row, quant_row = \
+            [ln for ln in frame.splitlines()[1:4]]
+        assert "PREC" in header
+        assert "f32" in full_row and "bf16" in quant_row
+        # a pre-precision statusz stream still renders (defaults to f32)
+        legacy = format_statusz({
+            "ts": 0, "fleet": {},
+            "tenants": {"old": {"slo": "gold", "device_seconds": 0.0}}})
+        assert "f32" in legacy.splitlines()[2]

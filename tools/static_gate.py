@@ -50,8 +50,10 @@ import lint_gate  # noqa: E402
 #: hand edit) that drops every family carrying one of these would silently
 #: un-pin a whole program surface.  ``@mesh4x2`` is the pod-scale sharded
 #: lowering (ISSUE 15): losing it would let the sharded sweep/transform
-#: forms (and their TM705-absence proof) drift unreviewed.
-REQUIRED_FAMILY_MARKERS = ("@mesh4x2", "@interpret", "@chunk")
+#: forms (and their TM705-absence proof) drift unreviewed.  ``@bf16`` is
+#: the reduced-precision scoring prefix (ISSUE 19): losing it would let
+#: the boundary-cast lowering drift (or vanish) unreviewed.
+REQUIRED_FAMILY_MARKERS = ("@mesh4x2", "@interpret", "@chunk", "@bf16")
 
 #: the threaded serving surface the ``--threads`` gate lints (ISSUE 16):
 #: every module that owns a lock, a background thread, or state those reach

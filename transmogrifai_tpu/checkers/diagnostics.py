@@ -198,6 +198,13 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "TM606); serving falls back to live compilation, so re-pack "
               "the bundle (`cli deploy pack`) from the current model and "
               "environment"),
+    "TM511": (Severity.ERROR, "reduced-precision plan fails calibration parity",
+              "the bf16/int8 scoring-prefix plan's max prediction delta vs "
+              "the same model's f32 plan over the calibration batch exceeds "
+              "the precision class's documented bound (serve/plan.py "
+              "TM511_BOUNDS); the registry refuses the plan fail-closed — "
+              "serve the model at f32, pick the wider class, or fix the "
+              "numerically unstable stage the delta points at"),
     # -- plan cost (jaxpr-level static analysis, checkers/plancheck.py) -----
     "TM601": (Severity.ERROR, "plan exceeds the HBM budget",
               "the fused program's peak live-buffer estimate at its largest "

@@ -933,6 +933,22 @@ def _plan_entries() -> List[CorpusEntry]:
                                    max_bucket=64, strict=False)
         return snapshot_scoring_plan(plan, bucket=64)
 
+    def scoring_prefix_bf16():
+        # the reduced-precision scoring class (ISSUE 19): the same fused
+        # prefix with bf16 boundary casts folded in.  Pinned as its own
+        # family so a jax bump that changes how the casts lower (or
+        # silently drops them) diffs against THIS golden instead of
+        # perturbing the f32 family — whose bit-identity with production
+        # f32 plans is itself a pinned invariant.
+        from ..serve.plan import CompiledScoringPlan
+
+        features, _runners = _plan_fixture_runners()
+        plan = CompiledScoringPlan(_Shim(features, {}), min_bucket=8,
+                                   max_bucket=64, strict=False,
+                                   precision="bf16")
+        return snapshot_scoring_plan(
+            plan, bucket=64, key="serve.plan.scoring_prefix@bf16")
+
     def transform_prefix_meshed():
         """The dp x mp SHARDED transform prefix (ISSUE 15): every entry row
         block constrained to the data axis — pinned so the pod-scale
@@ -957,6 +973,7 @@ def _plan_entries() -> List[CorpusEntry]:
         CorpusEntry("workflow.plan.transform_prefix@mesh4x2",
                     transform_prefix_meshed, min_devices=8),
         CorpusEntry("serve.plan.scoring_prefix", scoring_prefix),
+        CorpusEntry("serve.plan.scoring_prefix@bf16", scoring_prefix_bf16),
     ]
 
 

@@ -115,9 +115,9 @@ def format_statusz(status: Dict[str, Any]) -> str:
         f"pipe={fleet.get('pipeline_depth', 0)}"
         f"@{fleet.get('pipeline_overlap', 0.0):.2f}  "
         f"slo={'armed' if fleet.get('slo_monitor_armed') else 'off'}",
-        f"{'TENANT':<12}{'SLO':<8}{'RPS':>8}{'P99ms':>8}{'BUDGET':>7}"
-        f"{'BURN':>6}{'BRKR':>10}{'WARM':>5}{'SHED':>6}{'DLEXP':>6}"
-        f"{'FAIL':>6}{'DEV_s':>8}",
+        f"{'TENANT':<12}{'SLO':<8}{'PREC':<6}{'RPS':>8}{'P99ms':>8}"
+        f"{'BUDGET':>7}{'BURN':>6}{'BRKR':>10}{'WARM':>5}{'SHED':>6}"
+        f"{'DLEXP':>6}{'FAIL':>6}{'DEV_s':>8}",
     ]
     for tenant in sorted(status.get("tenants", {})):
         row = status["tenants"][tenant]
@@ -125,6 +125,7 @@ def format_statusz(status: Dict[str, Any]) -> str:
         breaker = row.get("breaker") or "-"
         lines.append(
             f"{tenant[:11]:<12}{str(row.get('slo', '-'))[:7]:<8}"
+            f"{str(row.get('precision') or 'f32')[:5]:<6}"
             f"{_fmt(row.get('rps'), 8)}"
             f"{_fmt(row.get('p99_ms'), 8)}"
             f"{_budget_cell(row)}"

@@ -116,9 +116,17 @@ class ArtifactStore:
     # -- pack ----------------------------------------------------------------
     def pack(self, model, *, min_bucket: int = 8, max_bucket: int = 1024,
              buckets: Optional[Sequence[int]] = None,
-             goldens_dir: Optional[str] = None) -> DeployBundle:
+             goldens_dir: Optional[str] = None,
+             precision: Optional[str] = None) -> DeployBundle:
         """Compile + warm ``model``'s serving plan and pack it: model
         checkpoint, per-bucket serialized executables, manifest.
+
+        ``precision`` packs the plan at a reduced numeric class
+        (serve/plan.py Precision); the class joins the plan fingerprint,
+        hence every ``artifact_key``, so a bf16/int8 artifact can never
+        hydrate an f32 tenant (or vice versa), and it is recorded in the
+        manifest so ``verify(model)`` recomputes the live content
+        fingerprint at the SAME class.
 
         Raises ``ValueError`` for a host-only model (no device prefix means
         no executables — an empty artifact would be refused by every
@@ -129,7 +137,8 @@ class ArtifactStore:
         from ..serve.plan import CompiledScoringPlan
 
         plan = CompiledScoringPlan(model, min_bucket=min_bucket,
-                                   max_bucket=max_bucket)
+                                   max_bucket=max_bucket,
+                                   precision=precision)
         if not plan.device_stage_uids:
             raise ValueError(
                 "model has no device prefix — there are no executables to "
@@ -166,6 +175,7 @@ class ArtifactStore:
                 "plan": {
                     "fingerprint": plan.fingerprint,
                     "contentFingerprint": plan.content_fingerprint,
+                    "precision": plan.precision,
                     "minBucket": plan.min_bucket,
                     "maxBucket": plan.max_bucket,
                     "buckets": [int(b) for b in ladder],
@@ -219,7 +229,8 @@ class ArtifactStore:
             xb = bundle.plan.get("maxBucket", 1024) if max_bucket is None \
                 else max_bucket
             content_fp = CompiledScoringPlan(
-                model, min_bucket=mb, max_bucket=xb).content_fingerprint
+                model, min_bucket=mb, max_bucket=xb,
+                precision=bundle.plan.get("precision")).content_fingerprint
         return check_bundle(bundle, content_fingerprint=content_fp,
                             live_corpus=live_corpus)
 

@@ -25,6 +25,13 @@ Modules:
 - :mod:`.encode` — fused serving-prefix encode kernels: level-code one-hot
   (``ops/onehot.py``) and right-inclusive bucketize one-hot
   (``ops/bucketizers.py``).
+- :mod:`.routing` — fused row-routing compare-reduce (``_row_select``).
+
+Tuning: every kernel resolves its schedule parameters as explicit arg >
+env knob > the persistent autotuner's verified winner for the shape class
+(:mod:`transmogrifai_tpu.perf.autotune`) > module default.  Adopted winners
+ride ``cache_token()`` so tuned and untuned processes never alias
+executables or deploy artifacts.
 
 Parity discipline (docs/performance.md "Pallas fused tree kernels"):
 interpret-mode kernels are pinned bitwise-equal to the exact-int8 GEMM

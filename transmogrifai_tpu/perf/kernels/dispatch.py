@@ -37,13 +37,22 @@ formulation — with the decision itself made observable and cacheable:
 
 from __future__ import annotations
 
+import logging
 import os
 from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
+log = logging.getLogger(__name__)
+
 #: test override installed by force_kernel_mode(); None = resolve from env.
 #: A scalar rebind (not a mutated container) — single-writer test usage.
 _FORCED: Optional[str] = None
+
+#: the autotuner's cache-token component ("" = untuned/defaults), installed
+#: by perf/autotune.py under its own guard whenever a non-default winner is
+#: adopted.  A scalar rebind read lock-free here — same discipline as
+#: _FORCED; the writer holds autotune._GUARD.
+_TUNING_TOKEN: str = ""
 
 #: test override installed by force_serve_donation(); None = resolve from
 #: env.  Same scalar-rebind discipline as _FORCED.
@@ -60,8 +69,11 @@ HIST_UNROLL_DEFAULT = 1
 
 
 def tuning_int(name: str, default: int, minimum: int = 1) -> int:
-    """THE env-knob reader: ``int(os.environ[name])`` clamped below by
-    ``minimum``, ``default`` when unset or unparseable.  Every tuning knob
+    """THE env-knob reader: ``int(os.environ[name])``, ``default`` when the
+    variable is unset, non-integer, or below ``minimum`` — with a logged
+    warning on the malformed cases so a typo'd ``TMOG_HIST_CHUNK`` degrades
+    a serve boot to the default instead of crashing it or silently running
+    a clamped value nobody asked for.  Every tuning knob
     (``TMOG_HIST_CHUNK``, ``TMOG_HIST_UNROLL``, ``TMOG_PALLAS_VMEM_BUDGET``)
     funnels through here so provenance reporting cannot drift from the
     values actually used."""
@@ -69,9 +81,16 @@ def tuning_int(name: str, default: int, minimum: int = 1) -> int:
     if raw is None:
         return int(default)
     try:
-        return max(int(minimum), int(raw))
+        value = int(raw)
     except ValueError:
+        log.warning("%s=%r is not an integer — using default %d",
+                    name, raw, int(default))
         return int(default)
+    if value < int(minimum):
+        log.warning("%s=%d is below the minimum %d — using default %d",
+                    name, value, int(minimum), int(default))
+        return int(default)
+    return value
 
 
 def _env_mode() -> str:
@@ -163,7 +182,35 @@ def cache_token() -> str:
         else f"kernels:{mode}"
     if serve_donation():
         token += ":serve-donate"
+    tune = _load_tuning_token()
+    if tune:
+        token += f":{tune}"
     return token
+
+
+def _set_tuning_token(token: str) -> None:
+    """Installed by perf/autotune.py (holding its guard) when winners are
+    adopted; "" returns the token to the untuned form so default runs stay
+    byte-identical to pre-autotuner fingerprints."""
+    global _TUNING_TOKEN
+    _TUNING_TOKEN = str(token)
+
+
+def _tuning_token() -> str:
+    return _TUNING_TOKEN
+
+
+def _load_tuning_token() -> str:
+    """The autotuner component for :func:`cache_token`: loading the winner
+    store happens HERE, eagerly at key-computation time, so a program key
+    always reflects every winner its trace could observe — a winner adopted
+    mid-trace can never alias the untuned executable."""
+    try:
+        from .. import autotune as _autotune
+
+        return _autotune.tuning_token()
+    except Exception:  # pragma: no cover — autotune import failure
+        return _TUNING_TOKEN
 
 
 def vmem_budget() -> int:
@@ -248,4 +295,11 @@ def kernel_provenance() -> Dict[str, Any]:
         prov["hist_unroll"] = int(_trees._HIST_UNROLL)
     except Exception:  # pragma: no cover — trees not importable
         pass
+    try:
+        from .. import autotune as _autotune
+
+        prov["tuning"] = _autotune.provenance()
+    except Exception:  # pragma: no cover — autotune import failure
+        prov["tuning"] = {"token": _TUNING_TOKEN, "winners": {},
+                          "store": None, "sweeps_this_process": 0}
     return prov

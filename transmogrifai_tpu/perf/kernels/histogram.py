@@ -30,6 +30,7 @@ growth-loop-specific operand pre-chunking), used by the parity tests and
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -43,6 +44,25 @@ def _default_chunk() -> int:
     env knob (and shared default) models/trees.py reads
     (TMOG_HIST_CHUNK)."""
     return tuning_int("TMOG_HIST_CHUNK", HIST_CHUNK_DEFAULT)
+
+
+def _tuned(mode: str, n: int, d: int, n_bins: int, L: int, nn: int,
+           two_k: int, name: str, fallback):
+    """The autotuner's winner for one hist parameter at this shape class,
+    else ``fallback``.  Consulted only when the caller pinned NOTHING
+    (explicit args and the env knob both outrank the store — winner params
+    were chosen jointly and must not be mixed with pinned ones); reads the
+    in-process memo the cache token already loaded, so resolution at trace
+    time can never alias executables (perf/autotune.py)."""
+    try:
+        from .. import autotune as _autotune
+
+        cls = _autotune.shape_class(
+            "hist", mode, rows=n, features=d, bins=n_bins, lanes=L,
+            nodes=nn, classes=max(1, two_k // 2))
+        return _autotune.kernel_param("hist", cls, name, fallback)
+    except Exception:  # pragma: no cover — autotune unavailable
+        return fallback
 
 
 def _pad_rows(local, ghT, binned, chunk: int):
@@ -61,7 +81,8 @@ def hist_level_pallas(local: jnp.ndarray, ghT: jnp.ndarray,
                       binned: jnp.ndarray, nn: int, n_bins: int, *,
                       int_exact: bool = False, mxu_dtype=None,
                       interpret: bool = False,
-                      chunk: Optional[int] = None) -> jnp.ndarray:
+                      chunk: Optional[int] = None,
+                      variant: Optional[str] = None) -> jnp.ndarray:
     """(L*nn*2K, B*d) per-(node, class, feature, bin) histograms, fused.
 
     local: (L, n) int32 per-lane local node index (negative = inactive row —
@@ -76,6 +97,17 @@ def hist_level_pallas(local: jnp.ndarray, ghT: jnp.ndarray,
     in int32 (exact); float operands go through the MXU in ``mxu_dtype``
     (bf16 on TPU, f32 in CPU parity runs — trees' ``_hist_dtype`` contract)
     and accumulate in f32.
+
+    ``variant`` selects the kernel schedule (autotune family ``hist``):
+    ``"stream"`` (default) is the chunk grid above — block DMA per step,
+    double-buffered by the Pallas pipeline on TPU; ``"resident"`` holds
+    every operand VMEM-resident for the whole pass and loops the chunks
+    inside ONE kernel invocation (no per-step DMA — wins when the working
+    set fits VMEM outright).  Both share the identical per-chunk math and
+    sequential accumulation order, so the exact-int8 path is bitwise-equal
+    across variants.  When the caller pins neither ``chunk`` nor
+    ``variant``, the persistent autotuner's verified winner for this shape
+    class applies (perf/autotune.py).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -87,28 +119,65 @@ def hist_level_pallas(local: jnp.ndarray, ghT: jnp.ndarray,
     M = L * nn * two_k
     hdt = jnp.int8 if int_exact else jnp.dtype(mxu_dtype or ghT.dtype)
     acc_t = jnp.int32 if int_exact else jnp.float32
+    mode = "interpret" if interpret else "pallas"
+    if chunk is None and variant is None \
+            and os.environ.get("TMOG_HIST_CHUNK") is None:
+        chunk = int(_tuned(mode, n, d, n_bins, L, nn, two_k, "chunk",
+                           HIST_CHUNK_DEFAULT))
+        variant = str(_tuned(mode, n, d, n_bins, L, nn, two_k, "variant",
+                             "stream"))
     chunk = int(chunk or _default_chunk())
+    variant = variant or "stream"
+    if variant not in ("stream", "resident"):
+        raise ValueError(f"unknown hist kernel variant {variant!r}")
     local, ghT, binned, n_p = _pad_rows(local, ghT, binned, chunk)
     grid = n_p // chunk
+
+    def _chunk_update(lb, gh, bb):
+        """The shared per-chunk math: node one-hot x gh contraction against
+        the joint (feature, bin) one-hot — identical across variants."""
+        node_ids = jax.lax.broadcasted_iota(jnp.int32, (1, nn, 1), 1)
+        node_oh = (lb[:, None, :] == node_ids).astype(hdt)
+        acc = (node_oh[:, :, None, :] * gh.astype(hdt)[:, None, :, :]
+               ).reshape(M, chunk)
+        bin_ids = jax.lax.broadcasted_iota(jnp.int32, (1, B, 1), 1)
+        # (chunk, B, d) layout, matching the reference: the innermost axis
+        # stays the 128-lane-aligned feature dim
+        bin_oh = (bb[:, None, :] == bin_ids).astype(hdt) \
+            .reshape(chunk, B * d)
+        return jax.lax.dot_general(
+            acc, bin_oh, (((1,), (0,)), ((), ())),
+            preferred_element_type=acc_t)
+
+    if variant == "resident":
+        def kernel(local_ref, gh_ref, binned_ref, out_ref):
+            def body(c, acc):
+                sl = pl.dslice(c * chunk, chunk)
+                return acc + _chunk_update(local_ref[:, sl],
+                                           gh_ref[:, :, sl],
+                                           binned_ref[sl, :])
+
+            out_ref[:] = jax.lax.fori_loop(
+                0, grid, body, jnp.zeros((M, B * d), acc_t))
+
+        return pl.pallas_call(
+            kernel,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((M, B * d), acc_t),
+            interpret=bool(interpret),
+        )(local, ghT, binned)
 
     def kernel(local_ref, gh_ref, binned_ref, out_ref):
         @pl.when(pl.program_id(0) == 0)
         def _init():
             out_ref[:] = jnp.zeros_like(out_ref)
 
-        node_ids = jax.lax.broadcasted_iota(jnp.int32, (1, nn, 1), 1)
-        node_oh = (local_ref[:][:, None, :] == node_ids).astype(hdt)
-        gh = gh_ref[:].astype(hdt)
-        acc = (node_oh[:, :, None, :] * gh[:, None, :, :]
-               ).reshape(M, chunk)
-        bin_ids = jax.lax.broadcasted_iota(jnp.int32, (1, B, 1), 1)
-        # (chunk, B, d) layout, matching the reference: the innermost axis
-        # stays the 128-lane-aligned feature dim
-        bin_oh = (binned_ref[:][:, None, :] == bin_ids).astype(hdt) \
-            .reshape(chunk, B * d)
-        out_ref[:] += jax.lax.dot_general(
-            acc, bin_oh, (((1,), (0,)), ((), ())),
-            preferred_element_type=acc_t)
+        out_ref[:] += _chunk_update(local_ref[:], gh_ref[:], binned_ref[:])
 
     return pl.pallas_call(
         kernel,
@@ -142,6 +211,12 @@ def hist_level_xla(local: jnp.ndarray, ghT: jnp.ndarray, binned: jnp.ndarray,
     M = L * nn * two_k
     hdt = jnp.int8 if int_exact else jnp.dtype(mxu_dtype or ghT.dtype)
     acc_t = jnp.int32 if int_exact else jnp.float32
+    if chunk is None and os.environ.get("TMOG_HIST_CHUNK") is None \
+            and os.environ.get("TMOG_HIST_UNROLL") is None:
+        chunk = int(_tuned("xla", n, d, n_bins, L, nn, two_k, "chunk",
+                           HIST_CHUNK_DEFAULT))
+        unroll = int(_tuned("xla", n, d, n_bins, L, nn, two_k, "unroll",
+                            unroll))
     chunk = int(chunk or _default_chunk())
     local, ghT, binned, n_p = _pad_rows(local, ghT, binned, chunk)
     n_chunks = n_p // chunk

@@ -30,14 +30,38 @@ identical across paths and reduction orders — pinned in tier-1
 
 from __future__ import annotations
 
+import os
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
 from . import dispatch as _dispatch
 
 #: row-block size for the routing grid: the (block, d, L) one-hot product is
-#: the VMEM resident — the admission guard scales against it
+#: the VMEM resident — the admission guard scales against it.
+#: Env-overridable (``TMOG_ROUTE_BLOCK``) and autotunable per shape class
+#: (perf/autotune.py family ``route``).
 _ROUTE_BLOCK = 256
+
+
+def _resolve_block(block: Optional[int], n: int, d: int, L: int,
+                   mode: str) -> int:
+    """Row-block resolution: explicit arg > ``TMOG_ROUTE_BLOCK`` > the
+    autotuner's verified winner for this shape class > module default."""
+    if block is not None:
+        return int(block)
+    if os.environ.get("TMOG_ROUTE_BLOCK") is not None:
+        return _dispatch.tuning_int("TMOG_ROUTE_BLOCK", _ROUTE_BLOCK)
+    try:
+        from .. import autotune as _autotune
+
+        cls = _autotune.shape_class("route", mode, rows=n, features=d,
+                                    lanes=L)
+        return int(_autotune.kernel_param("route", cls, "block",
+                                          _ROUTE_BLOCK))
+    except Exception:  # pragma: no cover — autotune unavailable
+        return _ROUTE_BLOCK
 
 
 def row_select_xla(binned: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
@@ -61,7 +85,7 @@ def row_select_lanes_xla(binned: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
 
 def row_select_lanes_pallas(binned: jnp.ndarray, idx: jnp.ndarray, *,
                             interpret: bool = False,
-                            block: int = _ROUTE_BLOCK) -> jnp.ndarray:
+                            block: Optional[int] = None) -> jnp.ndarray:
     """Fused per-row-block routing; same contract as
     :func:`row_select_lanes_xla`.
 
@@ -74,6 +98,8 @@ def row_select_lanes_pallas(binned: jnp.ndarray, idx: jnp.ndarray, *,
 
     n, d = binned.shape
     L = idx.shape[0]
+    block = _resolve_block(block, int(n), int(d), int(L),
+                           "interpret" if interpret else "pallas")
     pad = (-n) % block
     if pad:
         # padded rows select feature 0 of zero-rows and are sliced off
@@ -113,8 +139,12 @@ def row_select_lanes(binned: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     program; ``cache_token()`` keys every executable on it."""
     n, d = int(binned.shape[0]), int(binned.shape[1])
     L = int(idx.shape[0])
-    mode = _dispatch.route_mode(d, L) if (d > 0 and L > 0 and n > 0) else None
+    mode = _dispatch.kernel_mode()
+    block = _resolve_block(None, n, d, L, mode)
+    mode = _dispatch.route_mode(d, L, block_rows=block) \
+        if (d > 0 and L > 0 and n > 0) else None
     if mode is None:
         return row_select_lanes_xla(binned, idx)
     return row_select_lanes_pallas(binned, idx,
-                                   interpret=mode == "interpret")
+                                   interpret=mode == "interpret",
+                                   block=block)

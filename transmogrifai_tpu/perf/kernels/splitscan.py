@@ -92,16 +92,54 @@ def split_scan_xla(hist_g, hist_h, G, H, level_mask, n_bins: int,
     return best, best_gain, bml
 
 
+def _resolve_lane_block(lane_block, L: int, nn: int, K: int, d: int,
+                        n_bins: int, mode: str) -> int:
+    """Lane-block resolution: explicit arg > ``TMOG_SPLIT_LANE_BLOCK`` >
+    the autotuner's verified winner for this shape class > 1 (the original
+    one-lane-per-step grid)."""
+    import os
+
+    if lane_block is not None:
+        return int(lane_block)
+    if os.environ.get("TMOG_SPLIT_LANE_BLOCK") is not None:
+        return _dispatch.tuning_int("TMOG_SPLIT_LANE_BLOCK", 1)
+    try:
+        from .. import autotune as _autotune
+
+        cls = _autotune.shape_class("split", mode, lanes=L, nodes=nn,
+                                    classes=K, features=d, bins=n_bins)
+        return int(_autotune.kernel_param("split", cls, "lane_block", 1))
+    except Exception:  # pragma: no cover — autotune unavailable
+        return 1
+
+
 def split_scan_pallas(hist_g, hist_h, G, H, level_mask, n_bins: int,
                       reg_lambda, alpha, gamma, min_child_weight, *,
-                      interpret: bool = False
+                      interpret: bool = False, lane_block=None
                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Fused per-lane split scan; same contract as :func:`split_scan_xla`."""
+    """Fused per-lane split scan; same contract as :func:`split_scan_xla`.
+
+    ``lane_block`` lanes share one grid step (autotune family ``split``;
+    default 1 = the original schedule).  Lanes padded up to the block
+    multiple carry all-zero histograms, score ``-inf`` everywhere (the
+    min-child-weight guard), and are sliced off — per-lane results are
+    bitwise-independent of the blocking."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     L, nn, K, d, B = hist_g.shape
     F = d * (n_bins - 1)
+    lb = max(1, _resolve_lane_block(
+        lane_block, L, nn, K, d, n_bins,
+        "interpret" if interpret else "pallas"))
+    pad = (-L) % lb
+    if pad:
+        hist_g = jnp.pad(hist_g, ((0, pad),) + ((0, 0),) * 4)
+        hist_h = jnp.pad(hist_h, ((0, pad),) + ((0, 0),) * 4)
+        G = jnp.pad(G, ((0, pad), (0, 0), (0, 0)))
+        H = jnp.pad(H, ((0, pad), (0, 0), (0, 0)))
+        level_mask = jnp.pad(level_mask, ((0, pad), (0, 0)))
+    L_p = L + pad
     params = jnp.stack([
         jnp.asarray(reg_lambda, jnp.float32),
         jnp.asarray(alpha, jnp.float32),
@@ -110,72 +148,77 @@ def split_scan_pallas(hist_g, hist_h, G, H, level_mask, n_bins: int,
 
     def kernel(hg_ref, hh_ref, g_ref, h_ref, mask_ref, p_ref,
                best_ref, gain_ref, bml_ref):
-        hg = hg_ref[0]                                      # (nn, K, d, B)
-        hh = hh_ref[0]
+        hg = hg_ref[:]                                  # (lb, nn, K, d, B)
+        hh = hh_ref[:]
         reg_l, alph = p_ref[0, 0], p_ref[0, 1]
         gam, mcw = p_ref[0, 2], p_ref[0, 3]
         gl = jnp.cumsum(hg[..., :n_bins], axis=-1)[..., :-1]
         hl = jnp.cumsum(hh[..., :n_bins], axis=-1)[..., :-1]
         g_miss = hg[..., n_bins][..., None]
         h_miss = hh[..., n_bins][..., None]
-        Gt = g_ref[0][..., None, None]                      # (nn, K, 1, 1)
-        Ht = h_ref[0][..., None, None]
+        Gt = g_ref[:][..., None, None]                  # (lb, nn, K, 1, 1)
+        Ht = h_ref[:][..., None, None]
         args = (reg_l, alph, gam, mcw)
-        gain_mr = _gain_terms(gl, hl, Gt, Ht, *args, class_axis=1)
+        gain_mr = _gain_terms(gl, hl, Gt, Ht, *args, class_axis=2)
         gain_ml = _gain_terms(gl + g_miss, hl + h_miss, Gt, Ht, *args,
-                              class_axis=1)
+                              class_axis=2)
         gain = jnp.maximum(gain_mr, gain_ml)
-        gain = jnp.where(mask_ref[0][None, :, None] > 0, gain, -jnp.inf)
+        gain = jnp.where(mask_ref[:][:, None, :, None] > 0, gain, -jnp.inf)
 
-        flat = gain.reshape(nn, F)
+        flat = gain.reshape(lb, nn, F)
         best = flat.argmax(axis=-1).astype(jnp.int32)
         # gather-free selection: the masked max picks the exact element
-        col = jax.lax.broadcasted_iota(jnp.int32, (nn, F), 1)
-        sel = col == best[:, None]
-        gain_ref[0] = jnp.max(jnp.where(sel, flat, -jnp.inf), axis=-1)
-        sel_ml = jnp.max(jnp.where(sel, gain_ml.reshape(nn, F), -jnp.inf),
-                         axis=-1)
-        sel_mr = jnp.max(jnp.where(sel, gain_mr.reshape(nn, F), -jnp.inf),
-                         axis=-1)
-        best_ref[0] = best
-        bml_ref[0] = (sel_ml >= sel_mr).astype(jnp.int8)
+        col = jax.lax.broadcasted_iota(jnp.int32, (lb, nn, F), 2)
+        sel = col == best[..., None]
+        gain_ref[:] = jnp.max(jnp.where(sel, flat, -jnp.inf), axis=-1)
+        sel_ml = jnp.max(jnp.where(sel, gain_ml.reshape(lb, nn, F),
+                                   -jnp.inf), axis=-1)
+        sel_mr = jnp.max(jnp.where(sel, gain_mr.reshape(lb, nn, F),
+                                   -jnp.inf), axis=-1)
+        best_ref[:] = best
+        bml_ref[:] = (sel_ml >= sel_mr).astype(jnp.int8)
 
-    hist_spec = pl.BlockSpec((1, nn, K, d, B), lambda l: (l, 0, 0, 0, 0),
+    hist_spec = pl.BlockSpec((lb, nn, K, d, B), lambda l: (l, 0, 0, 0, 0),
                              memory_space=pltpu.VMEM)
-    gh_spec = pl.BlockSpec((1, nn, K), lambda l: (l, 0, 0),
+    gh_spec = pl.BlockSpec((lb, nn, K), lambda l: (l, 0, 0),
                            memory_space=pltpu.VMEM)
-    out_spec = pl.BlockSpec((1, nn), lambda l: (l, 0),
+    out_spec = pl.BlockSpec((lb, nn), lambda l: (l, 0),
                             memory_space=pltpu.VMEM)
     best, best_gain, bml = pl.pallas_call(
         kernel,
-        grid=(L,),
+        grid=(L_p // lb,),
         in_specs=[
             hist_spec, hist_spec, gh_spec, gh_spec,
-            pl.BlockSpec((1, d), lambda l: (l, 0),
+            pl.BlockSpec((lb, d), lambda l: (l, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 4), lambda l: (0, 0),
                          memory_space=pltpu.SMEM),
         ],
         out_specs=(out_spec, out_spec, out_spec),
         out_shape=(
-            jax.ShapeDtypeStruct((L, nn), jnp.int32),
-            jax.ShapeDtypeStruct((L, nn), jnp.float32),
-            jax.ShapeDtypeStruct((L, nn), jnp.int8),
+            jax.ShapeDtypeStruct((L_p, nn), jnp.int32),
+            jax.ShapeDtypeStruct((L_p, nn), jnp.float32),
+            jax.ShapeDtypeStruct((L_p, nn), jnp.int8),
         ),
         interpret=bool(interpret),
     )(hist_g, hist_h, G, H, level_mask, params)
-    return best, best_gain, bml != 0
+    return best[:L], best_gain[:L], bml[:L] != 0
 
 
 def split_scan(hist_g, hist_h, G, H, level_mask, n_bins: int,
                reg_lambda, alpha, gamma, min_child_weight
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Dispatching split scan (the entry ``models/trees.py`` calls)."""
-    per_lane = int(hist_g.size // hist_g.shape[0]) * 8  # g+h blocks, f32
+    L, nn, K, d, _B = hist_g.shape
+    mode0 = _dispatch.kernel_mode()
+    lb = _resolve_lane_block(None, int(L), int(nn), int(K), int(d),
+                             n_bins, mode0)
+    per_lane = int(hist_g.size // hist_g.shape[0]) * 8 * max(1, lb)
     mode = _dispatch.split_mode(per_lane)
     if mode is not None:
         return split_scan_pallas(
             hist_g, hist_h, G, H, level_mask, n_bins, reg_lambda, alpha,
-            gamma, min_child_weight, interpret=mode == "interpret")
+            gamma, min_child_weight, interpret=mode == "interpret",
+            lane_block=lb)
     return split_scan_xla(hist_g, hist_h, G, H, level_mask, n_bins,
                           reg_lambda, alpha, gamma, min_child_weight)

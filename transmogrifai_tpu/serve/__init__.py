@@ -29,7 +29,7 @@ from .faults import (
     TransientScoringError,
     is_retryable,
 )
-from .plan import CompiledScoringPlan, compile_plan
+from .plan import TM511_BOUNDS, CompiledScoringPlan, Precision, compile_plan
 from .registry import FleetServer, ModelRegistry, TenantState, UnknownTenantError
 from .resilience import CircuitBreaker, ResilientScorer
 from .server import ScoringServer
@@ -37,6 +37,7 @@ from .swap import ModelEntry, SwappableScorer, prediction_delta
 from .validator import (
     check_fleet_admission,
     check_plan_admission,
+    check_precision_parity,
     check_resilience_config,
     check_servability,
     check_swap_compatibility,
@@ -56,6 +57,8 @@ __all__ = [
     "ModelEntry",
     "ModelRegistry",
     "PoisonRecordError",
+    "Precision",
+    "TM511_BOUNDS",
     "QueueFullError",
     "ResilientScorer",
     "ScoringServer",
@@ -66,6 +69,7 @@ __all__ = [
     "UnknownTenantError",
     "check_fleet_admission",
     "check_plan_admission",
+    "check_precision_parity",
     "check_resilience_config",
     "check_servability",
     "check_swap_compatibility",

@@ -67,6 +67,60 @@ _EXEC_CACHE_MAX = 64
 _EXEC_CACHE_LOCK = threading.Lock()
 
 
+class Precision:
+    """Numeric class the fused scoring prefix is lowered at.
+
+    - ``f32`` — the default: every float operand stays float32; the plan is
+      bitwise-identical to every release before precision classes existed
+      (its fingerprint carries NO precision tag, so f32 tenants share
+      executables and deploy artifacts with pure-f32 fleets at zero extra
+      compiles).
+    - ``bf16`` — float entry operands cast to bfloat16 at the prefix
+      boundary; the fused program computes in bf16 and casts float outputs
+      back to float32 before they leave the device.  Deterministic: the
+      cast is a pure function of the input bits, so repeated scores of the
+      same batch are bitwise-equal.
+    - ``int8`` — dynamic per-tensor symmetric quantization simulated
+      in-graph: each float entry is scaled by ``max|x|/127``, rounded to
+      [-127, 127], and dequantized back to float32 (the rest of the graph
+      runs f32 over the coarsened values).  Also deterministic per input.
+
+    Reduced-precision plans must pass the TM511 calibration parity gate
+    (serve/validator.py) before a registry admits them: the max prediction
+    delta vs the same model's f32 plan over a calibration batch must sit
+    within the class bound (``TM511_BOUNDS``), fail-closed.
+    """
+
+    F32 = "f32"
+    BF16 = "bf16"
+    INT8 = "int8"
+    ALL = (F32, BF16, INT8)
+
+    _ALIASES = {"f32": F32, "float32": F32, "fp32": F32,
+                "bf16": BF16, "bfloat16": BF16,
+                "int8": INT8, "i8": INT8}
+
+    @staticmethod
+    def normalize(value) -> str:
+        """Canonical precision name; ValueError on anything unknown (the
+        fail-closed half of the contract — an unrecognized class must never
+        silently serve as f32)."""
+        if value is None:
+            return Precision.F32
+        key = str(value).strip().lower()
+        try:
+            return Precision._ALIASES[key]
+        except KeyError:
+            raise ValueError(
+                f"unknown precision {value!r}; expected one of "
+                f"{Precision.ALL}") from None
+
+
+#: TM511 parity bounds: max |prediction delta| vs f32 over the calibration
+#: batch, per precision class (docs/serving.md "Precision classes").
+TM511_BOUNDS = {Precision.BF16: 1e-2, Precision.INT8: 5e-2}
+
+
 
 def resolve_scoring_stages(result_features: Sequence[Feature],
                            fitted: Mapping[str, Any]):
@@ -178,9 +232,16 @@ class CompiledScoringPlan:
     """
 
     def __init__(self, model, min_bucket: int = 8, max_bucket: int = 1024,
-                 strict: bool = True, hbm_budget: Optional[float] = None):
+                 strict: bool = True, hbm_budget: Optional[float] = None,
+                 precision: Optional[str] = None):
         if max_bucket < min_bucket or min_bucket < 1:
             raise ValueError(f"bad bucket range [{min_bucket}, {max_bucket}]")
+        # precision class resolved ONCE at construction, before the
+        # fingerprint (same discipline as _donate): reduced-precision plans
+        # get distinct fingerprints, distinct _EXEC_CACHE keys, and distinct
+        # deploy artifact keys; f32 plans keep the tag OUT of the hash so
+        # their fingerprints stay byte-identical to pre-precision releases
+        self._precision = Precision.normalize(precision)
         # round both ends up to powers of two: every bucket score() can pick
         # must be one warm() compiles, or the compile-once guarantee breaks
         self.min_bucket = 1 << (int(min_bucket) - 1).bit_length()
@@ -241,6 +302,13 @@ class CompiledScoringPlan:
     @property
     def fingerprint(self) -> str:
         return self._fingerprint
+
+    @property
+    def precision(self) -> str:
+        """The plan's numeric class (:class:`Precision`): ``f32`` (default),
+        ``bf16``, or ``int8`` — resolved at construction and part of the
+        fingerprint whenever it is not f32."""
+        return self._precision
 
     @property
     def donated(self) -> bool:
@@ -392,13 +460,69 @@ class CompiledScoringPlan:
                 self._encoder_light[raw_name] = next(
                     g for g in self._generators if g.raw_name == raw_name)
 
+    def _lower_entry(self, x):
+        """Precision-class lowering of ONE float32 entry operand at the
+        prefix boundary (non-float operands — level codes etc. — pass
+        through untouched on every class)."""
+        import jax.numpy as jnp
+
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        if self._precision == Precision.BF16:
+            return x.astype(jnp.bfloat16)
+        # int8: dynamic per-tensor symmetric quant-dequant.  The scale must
+        # ignore non-finite values — NaN is the canonical missing-value lift
+        # and would otherwise poison the whole tensor's scale — and missing
+        # stays missing through the class (stages test isnan on it).  The
+        # scale floor keeps all-zero tensors exact; round-half-even matches
+        # XLA's default rounding so the class is deterministic per input.
+        finite = jnp.isfinite(x)
+        mag = jnp.max(jnp.where(finite, jnp.abs(x), 0.0))
+        scale = jnp.maximum(mag, jnp.float32(1e-12)) / 127.0
+        q = jnp.clip(jnp.round(x / scale), -127.0, 127.0).astype(jnp.int8)
+        return jnp.where(finite, q.astype(jnp.float32) * scale, x)
+
+    def _unify_float_dtypes(self, ops):
+        """Under a reduced-precision class a runner can legitimately see
+        mixed float dtypes — a still-bf16 entry next to a sibling a stage
+        already promoted back to f32 — and strict-dtype runners (the
+        VectorsCombiner lax.concatenate) refuse that statically.  Promote
+        every float operand to the widest float dtype present; f32 plans
+        never reach here, so their strictness (and lowering) is untouched."""
+        import jax.numpy as jnp
+
+        floats = [o.dtype for o in ops
+                  if hasattr(o, "dtype")
+                  and jnp.issubdtype(o.dtype, jnp.floating)]
+        if len(set(floats)) <= 1:
+            return ops
+        widest = jnp.result_type(*floats)
+        return [o.astype(widest)
+                if hasattr(o, "dtype") and jnp.issubdtype(o.dtype,
+                                                          jnp.floating)
+                else o for o in ops]
+
     def _fused(self, *entries):
+        if self._precision != Precision.F32:
+            entries = tuple(self._lower_entry(e) for e in entries)
         env: Dict[str, Any] = {}
         for runner, srcs, out_uid in self._wiring:
             ops = [env[key] if tag == "env" else entries[key]
                    for tag, key in srcs]
+            if self._precision != Precision.F32:
+                ops = self._unify_float_dtypes(ops)
             env[out_uid] = runner.device_transform(*ops)
-        return tuple(env[u] for u in self._out_uids)
+        outs = tuple(env[u] for u in self._out_uids)
+        if self._precision == Precision.BF16:
+            import jax.numpy as jnp
+
+            # float outputs leave the device as f32 regardless of class, so
+            # downstream host stages and the materialize contract see one
+            # dtype across the fleet
+            outs = tuple(o.astype(jnp.float32)
+                         if jnp.issubdtype(o.dtype, jnp.floating) else o
+                         for o in outs)
+        return outs
 
     def _compute_fingerprint(self) -> str:
         """Content hash of the fused program (shared planner helper): prefix
@@ -409,6 +533,10 @@ class CompiledScoringPlan:
         extra = {"entries": [list(k) for k in self._entry_keys],
                  "specs": [[list(t), d] for t, d in self._entry_specs],
                  "outs": self._out_uids}
+        if self._precision != Precision.F32:
+            # absent for f32 on purpose: pre-precision fingerprints must not
+            # move, so f32 tenants keep sharing artifacts fleet-wide
+            extra["precision"] = self._precision
         # the environment-free twin rides along: deploy manifests compare it
         # to decide refusal (content drift) vs clean miss (environment drift)
         self._content_fingerprint = stage_content_fingerprint(
@@ -851,10 +979,12 @@ class CompiledScoringPlan:
 
 
 def compile_plan(model, min_bucket: int = 8, max_bucket: int = 1024,
-                 strict: bool = True,
-                 hbm_budget: Optional[float] = None) -> CompiledScoringPlan:
+                 strict: bool = True, hbm_budget: Optional[float] = None,
+                 precision: Optional[str] = None) -> CompiledScoringPlan:
     """Compile a fitted WorkflowModel for online serving.  ``hbm_budget``
-    (bytes) arms the TM601 admission gate (serve/validator.py)."""
+    (bytes) arms the TM601 admission gate; ``precision`` picks the numeric
+    class (:class:`Precision`; reduced classes face the TM511 parity gate
+    at registry admission — serve/validator.py)."""
     return CompiledScoringPlan(model, min_bucket=min_bucket,
                                max_bucket=max_bucket, strict=strict,
-                               hbm_budget=hbm_budget)
+                               hbm_budget=hbm_budget, precision=precision)

@@ -161,7 +161,10 @@ class ModelRegistry:
 
     # -- registration / lifecycle --------------------------------------------
     def register(self, tenant: str, model, slo: str = "bronze",
-                 warm: bool = True, artifact=None) -> TenantState:
+                 warm: bool = True, artifact=None,
+                 precision: Optional[str] = None,
+                 calibration: Optional[Sequence[Mapping[str, Any]]] = None
+                 ) -> TenantState:
         """Admit ``model`` for ``tenant`` under SLO class ``slo``.
 
         Builds the tenant's compiled plan + fault-tolerance layer through
@@ -170,6 +173,18 @@ class ModelRegistry:
         over budget; typed TM509 refusal when eviction cannot make room),
         then warms the bucket ladder — at zero new backend compiles when
         another tenant already holds the fingerprint.
+
+        ``precision`` picks the plan's numeric class
+        (:class:`~.plan.Precision`: ``f32``/``bf16``/``int8``).  A reduced
+        class faces the TM511 calibration parity gate BEFORE admission:
+        the plan's max prediction delta vs the same model's f32 plan over
+        the calibration batch must sit within the class bound or
+        registration raises fail-closed.  ``calibration`` supplies real
+        records for that batch (the true prediction-delta gate); without
+        it a deterministic synthetic batch runs through the fused prefix
+        with magnitude-normalized deltas (conservative).  Reduced-precision
+        plans carry the class in their fingerprint, so they never share
+        executables or deploy artifacts with f32 tenants.
 
         ``artifact`` (a packed artifact dir path or
         :class:`~..deploy.ArtifactStore`) hydrates the plan's executables
@@ -190,7 +205,10 @@ class ModelRegistry:
             # the fault point fires BEFORE any state mutates: an injected
             # register fault leaves the fleet exactly as it was
             fault_point("register", tenant=tenant, slo=slo)
-            entry = self._build_entry(tenant, model, version=1)
+            entry = self._build_entry(tenant, model, version=1,
+                                      precision=precision)
+            self._check_precision(tenant, model, entry.plan,
+                                  calibration=calibration)
             shared = self._is_resident(entry.plan.fingerprint)
             self._admit(tenant, entry.plan)
             if artifact is not None and not shared:
@@ -242,9 +260,11 @@ class ModelRegistry:
         obs_flight.record_event("fleet_unregister", tenant=tenant)
 
     def _build_entry(self, tenant: str, model, version: int,
-                     warm: bool = False) -> ModelEntry:
+                     warm: bool = False,
+                     precision: Optional[str] = None) -> ModelEntry:
         plan = CompiledScoringPlan(model, min_bucket=self.min_bucket,
-                                   max_bucket=self.max_bucket)
+                                   max_bucket=self.max_bucket,
+                                   precision=precision)
         if warm:
             plan.warm()
         res = None
@@ -255,22 +275,60 @@ class ModelRegistry:
                 tenant=tenant, **self._resilience_params)
         return ModelEntry(model, plan, res, version)
 
+    def _check_precision(self, tenant: str, model,
+                         plan: CompiledScoringPlan,
+                         calibration: Optional[
+                             Sequence[Mapping[str, Any]]] = None) -> None:
+        """TM511 admission gate: a reduced-precision plan must match the
+        same model's f32 plan within its class bound over the calibration
+        batch, or the registry refuses it fail-closed.  Not run for f32;
+        without ``calibration`` records the synthetic-prefix variant runs
+        eagerly (no plan executables compile)."""
+        from .plan import Precision
+        from .validator import check_precision_parity
+
+        if plan.precision == Precision.F32:
+            return
+        # strict servability already ran on the candidate plan; the f32
+        # twin exists only to produce reference outputs for the gate
+        f32_plan = CompiledScoringPlan(model, min_bucket=self.min_bucket,
+                                       max_bucket=self.max_bucket,
+                                       strict=False)
+        report = check_precision_parity(f32_plan, plan, records=calibration)
+        delta = report.max_precision_delta
+        if report.errors():
+            obs_flight.record_event(
+                "fleet_precision_refused", tenant=tenant,
+                precision=plan.precision, max_delta=delta)
+            raise OpCheckError(report)
+        obs_flight.record_event(
+            "fleet_precision_admitted", tenant=tenant,
+            precision=plan.precision, max_delta=delta)
+
     # -- blue/green lifecycle, per tenant ------------------------------------
-    def stage_candidate(self, tenant: str, model, warm: bool = True) -> str:
+    def stage_candidate(self, tenant: str, model, warm: bool = True,
+                        precision: Optional[str] = None,
+                        calibration: Optional[
+                            Sequence[Mapping[str, Any]]] = None) -> str:
         """Build + stage a candidate for ``tenant``'s shadow scoring —
-        TM507 swap-compatibility checked and fleet HBM admission re-run
-        (the candidate's executables are resident until promote/discard)
-        BEFORE any bucket compiles.  Returns the candidate fingerprint."""
+        TM507 swap-compatibility (result schema AND precision class),
+        TM511 calibration parity for reduced-precision candidates, and
+        fleet HBM admission all re-run (the candidate's executables are
+        resident until promote/discard) BEFORE any bucket compiles.
+        Returns the candidate fingerprint."""
         from .validator import check_swap_compatibility
 
         with self._admission_lock:
             state = self.get(tenant)
             entry = self._build_entry(tenant, model,
-                                      version=next(state.versions))
+                                      version=next(state.versions),
+                                      precision=precision)
             report = check_swap_compatibility(state.swapper.active.plan,
                                               entry.plan)
             if report.errors():
                 raise OpCheckError(report)
+            self._check_precision(tenant, model, entry.plan,
+                                  calibration=calibration)
             for d in report:
                 log.info("%s", d.pretty())
             self._admit(tenant, entry.plan)
@@ -447,6 +505,7 @@ class ModelRegistry:
             tenants[t] = {
                 "slo": s.slo,
                 "fingerprint": active.fingerprint,
+                "precision": active.plan.precision,
                 "warm_buckets": active.plan.warm_buckets(),
                 "plan": active.plan.metrics(),
                 "swap": s.swapper.metrics(),
@@ -514,9 +573,13 @@ class FleetServer:
 
     # -- tenant lifecycle (delegates to the control plane) -------------------
     def register(self, tenant: str, model, slo: str = "bronze",
-                 warm: bool = True, artifact=None) -> "FleetServer":
+                 warm: bool = True, artifact=None,
+                 precision: Optional[str] = None,
+                 calibration: Optional[Sequence[Mapping[str, Any]]] = None
+                 ) -> "FleetServer":
         self.models.register(tenant, model, slo=slo, warm=warm,
-                             artifact=artifact)
+                             artifact=artifact, precision=precision,
+                             calibration=calibration)
         return self
 
     def unregister(self, tenant: str) -> None:
@@ -527,8 +590,13 @@ class FleetServer:
     def tenants(self) -> List[str]:
         return self.models.tenants()
 
-    def stage_candidate(self, tenant: str, model, warm: bool = True) -> str:
-        return self.models.stage_candidate(tenant, model, warm=warm)
+    def stage_candidate(self, tenant: str, model, warm: bool = True,
+                        precision: Optional[str] = None,
+                        calibration: Optional[
+                            Sequence[Mapping[str, Any]]] = None) -> str:
+        return self.models.stage_candidate(tenant, model, warm=warm,
+                                           precision=precision,
+                                           calibration=calibration)
 
     def promote(self, tenant: str, probation_batches: int = 8
                 ) -> Dict[str, Any]:
@@ -765,6 +833,7 @@ class FleetServer:
             breaker = state.breaker()
             row: Dict[str, Any] = {
                 "slo": state.slo,
+                "precision": active.plan.precision,
                 "rps": rps,
                 "completed": completed,
                 "failed": bt.get("failed", 0),

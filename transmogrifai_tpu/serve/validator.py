@@ -22,8 +22,14 @@ hazards surface from ``workflow.validate(serving=True)``, ``cli lint
   :class:`~.server.ScoringServer` before any request is accepted.
 - **TM507** (error) / **TM508** (info): blue/green swap admission
   (:func:`check_swap_compatibility`) — a staged candidate must serve the
-  same result feature names as the active model, and a fingerprint-changing
-  swap (candidate cannot share the cached prefix executables) is called out.
+  same result feature names AND the same precision class as the active
+  model, and a fingerprint-changing swap (candidate cannot share the
+  cached prefix executables) is called out.
+- **TM511** (error): reduced-precision calibration parity
+  (:func:`check_precision_parity`) — a bf16/int8 plan whose max prediction
+  delta vs the same model's f32 plan over the calibration batch exceeds
+  the class bound (``serve.plan.TM511_BOUNDS``) is refused fail-closed at
+  registry admission.
 - **TM509** (error): fleet HBM admission (:func:`check_fleet_admission`) —
   the multi-tenant registry (serve/registry.py) sums TM601-style static
   peak-HBM estimates across every resident warm executable; a candidate
@@ -167,6 +173,19 @@ def check_swap_compatibility(active_plan, candidate_plan) -> DiagnosticReport:
             f"candidate serves result features {cand_names} but the active "
             f"model serves {active_names}; refusing a schema-changing swap")])
         return report
+    active_prec = getattr(active_plan, "precision", "f32")
+    cand_prec = getattr(candidate_plan, "precision", "f32")
+    if active_prec != cand_prec:
+        # a precision flip changes prediction numerics under live clients
+        # exactly like a schema change — stage it as a NEW tenant (or
+        # re-register) so the TM511 calibration gate and the operators see
+        # it, instead of sliding it through a blue/green swap
+        report.extend([make_diagnostic(
+            "TM507",
+            f"candidate precision class {cand_prec!r} differs from the "
+            f"active plan's {active_prec!r}; refusing a numerics-changing "
+            "swap")])
+        return report
     if candidate_plan.fingerprint != active_plan.fingerprint:
         report.extend([make_diagnostic(
             "TM508",
@@ -174,6 +193,123 @@ def check_swap_compatibility(active_plan, candidate_plan) -> DiagnosticReport:
             f"{candidate_plan.fingerprint[:12]} differs from the active "
             f"plan's {active_plan.fingerprint[:12]}; the swap compiles a "
             "fresh prefix instead of sharing the executable cache")])
+    return report
+
+
+def _calibration_entries(plan, n_rows: int):
+    """Deterministic synthetic calibration batch for ``plan``'s fused-program
+    entry operands, built from ``entry_specs`` alone: float lifts draw from a
+    seeded standard normal (plus a NaN row so the missing path is exercised),
+    integer encodings draw small non-negative codes (out-of-range codes are
+    in-contract — they encode the untracked-null row)."""
+    import numpy as np
+
+    rng = np.random.default_rng(511)
+    ops = []
+    for trailing, dtype in plan.entry_specs:
+        dt = np.dtype(dtype)
+        shape = (n_rows,) + tuple(trailing)
+        if np.issubdtype(dt, np.floating):
+            arr = rng.standard_normal(shape).astype(dt) * 3.0
+            if n_rows > 1 and arr.ndim == 1:
+                arr[-1] = np.nan
+        else:
+            arr = rng.integers(0, 8, size=shape).astype(dt)
+        ops.append(arr)
+    return ops
+
+
+def check_precision_parity(f32_plan, candidate_plan, *,
+                           records: Optional[Sequence[Mapping[str, Any]]]
+                           = None,
+                           n_rows: int = 64) -> DiagnosticReport:
+    """Calibration parity gate for reduced-precision plans (TM511).
+
+    Scores the candidate and the same model's f32 plan over a calibration
+    batch and reports TM511 when the measured delta exceeds the candidate
+    class's bound (``serve.plan.TM511_BOUNDS``).  With ``records`` the gate
+    is the real thing: both plans score the records end to end and the
+    delta is the max absolute difference over the prediction outputs.
+    Without records a deterministic synthetic batch built from the plan's
+    entry specs runs through the fused PREFIX only; since prefix outputs
+    are feature-space (arbitrary magnitude, unlike O(1) predictions) the
+    delta is normalized by each output's max |f32| magnitude (floor 1.0) —
+    a conservative stand-in that still catches a one-hot bucket flip as a
+    full-magnitude violation.  The registry runs this at
+    ``register()``/``stage_candidate()`` admission and refuses on error,
+    fail-closed: a class whose bound is unknown is refused too.  The
+    measured delta lands on the report (``max_precision_delta``) so
+    statusz/bench can surface it.
+    """
+    import numpy as np
+
+    from .plan import Precision, TM511_BOUNDS
+
+    report = DiagnosticReport()
+    report.max_precision_delta = None
+    precision = getattr(candidate_plan, "precision", Precision.F32)
+    if precision == Precision.F32:
+        return report
+    bound = TM511_BOUNDS.get(precision)
+    if bound is None:
+        report.extend([make_diagnostic(
+            "TM511",
+            f"precision class {precision!r} has no documented parity bound "
+            "(serve.plan.TM511_BOUNDS); refusing fail-closed")])
+        return report
+    if not candidate_plan.device_stage_uids:
+        return report  # all-host plan: precision lowering never runs
+
+    if records is not None:
+        from ..types import Prediction
+
+        ref_rows = f32_plan.score(list(records))
+        got_rows = candidate_plan.score(list(records))
+        delta = 0.0
+        for ref, got in zip(ref_rows, got_rows):
+            for name, rv in ref.items():
+                gv = got.get(name)
+                if isinstance(rv, Mapping):
+                    # the argmax class decision is a step function — a
+                    # boundary record legitimately flips under ANY numeric
+                    # perturbation; the gate bounds the continuous scores
+                    # (probability/raw margin) the decision derives from
+                    delta = max(delta, *(abs(float(rv[k]) - float(gv[k]))
+                                         for k in rv
+                                         if k != Prediction.PredictionName),
+                                0.0)
+                elif isinstance(rv, (int, float)) \
+                        and not isinstance(rv, bool):
+                    delta = max(delta, abs(float(rv) - float(gv)))
+                elif isinstance(rv, (list, tuple, np.ndarray)):
+                    delta = max(delta, float(np.max(np.abs(
+                        np.asarray(rv, dtype=np.float64)
+                        - np.asarray(gv, dtype=np.float64)), initial=0.0)))
+    else:
+        ops = _calibration_entries(candidate_plan, n_rows)
+        ref_outs = f32_plan._fused(*ops)
+        got_outs = candidate_plan._fused(*ops)
+        delta = 0.0
+        for ref, got in zip(ref_outs, got_outs):
+            r = np.asarray(ref)
+            if not np.issubdtype(r.dtype, np.floating):
+                continue
+            d = np.abs(r.astype(np.float64)
+                       - np.asarray(got).astype(np.float64))
+            # feature-space outputs: normalize by the f32 magnitude so the
+            # prediction-space bounds stay meaningful (see docstring)
+            norm = max(1.0, float(np.max(np.nan_to_num(np.abs(r)),
+                                         initial=0.0)))
+            delta = max(delta,
+                        float(np.max(np.nan_to_num(d), initial=0.0)) / norm)
+
+    report.max_precision_delta = delta
+    if delta > bound:
+        report.extend([make_diagnostic(
+            "TM511",
+            f"{precision} plan's max prediction delta {delta:.3e} vs the "
+            f"f32 plan over the calibration batch exceeds the class bound "
+            f"{bound:.0e}; refusing the reduced-precision plan")])
     return report
 
 
