@@ -474,6 +474,99 @@ class TestCrashAndResume:
             out1[checked.name].materialize().data,
             out2[checked.name].materialize().data)
 
+    def test_sigkill_mid_epoch_resumes_in_fresh_process(self, tmp_path):
+        """PR 20 satellite: a REAL SIGKILL (no atexit, no finally, no
+        in-process monkeypatch) lands at the start of chunk 2; a FRESH
+        process reopens the spill store (same data token), skips the two
+        committed chunks, and completes bitwise-equal to the in-memory
+        reference."""
+        import json
+        import signal
+        import subprocess
+        import sys
+        import textwrap
+
+        script = tmp_path / "chunk_e2e.py"
+        script.write_text(textwrap.dedent("""\
+            import json, os, signal, sys
+
+            import numpy as np
+
+            mode, spill, offsets, out = sys.argv[1:5]
+
+            from test_chunked_ingest import _features, _fixture
+            from transmogrifai_tpu import Workflow
+            from transmogrifai_tpu.data.chunked import ChunkedDataset
+            from transmogrifai_tpu.readers import OffsetCheckpoint
+            from transmogrifai_tpu.workflow.dag import compute_dag
+            from transmogrifai_tpu.workflow.fit import transform_dag
+            from transmogrifai_tpu.workflow.ooc import (
+                EpochStats, chunked_transform_epoch)
+
+            ds = _fixture(700, seed=21)
+            label, checked = _features()
+            m = (Workflow().set_input_dataset(ds)
+                 .set_result_features(label, checked)).train()
+            runners = [m.fitted.get(s.uid, s)
+                       for layer in compute_dag(m.result_features)
+                       for s in layer]
+            ckpt = OffsetCheckpoint(offsets)
+
+            if mode == "kill":
+                from transmogrifai_tpu.serve.faults import FaultHarness
+
+                cds = ChunkedDataset.from_dataset(
+                    ds, chunk_rows=256, spill_dir=spill)
+                h = FaultHarness()
+                # chunks 0 and 1 process + commit; the kill fires at the
+                # ingest_chunk fault point as chunk 2 begins
+                h.script("ingest_chunk", [None, None, lambda ctx: os.kill(
+                    os.getpid(), signal.SIGKILL)])
+                with h:
+                    chunked_transform_epoch(cds, runners, checkpoint=ckpt)
+                raise SystemExit("unreachable: SIGKILL should have landed")
+
+            cds = ChunkedDataset.open(spill)  # same data token -> resumable
+            stats = EpochStats()
+            out_ds = chunked_transform_epoch(cds, runners, checkpoint=ckpt,
+                                             stats=stats)
+            ref = transform_dag(ds, m.result_features, m.fitted)
+            bitwise = bool(np.array_equal(
+                ref[checked.name].data,
+                out_ds[checked.name].materialize().data))
+            with open(out, "w") as fh:
+                json.dump({"skipped": stats.chunks_skipped,
+                           "processed": stats.chunks_processed,
+                           "n_chunks": cds.n_chunks,
+                           "bitwise": bitwise}, fh)
+        """))
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": os.pathsep.join(
+                   [repo, os.path.join(repo, "tests"),
+                    os.environ.get("PYTHONPATH", "")])}
+        spill = tmp_path / "store"
+        offsets = tmp_path / "offsets.json"
+        out = tmp_path / "resume.json"
+
+        killed = subprocess.run(
+            [sys.executable, str(script), "kill", str(spill), str(offsets),
+             str(out)],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert killed.returncode == -signal.SIGKILL, killed.stderr
+        # the fsync'd offset commit survived the kill: chunks 0 and 1 landed
+        assert os.path.exists(offsets)
+
+        resumed = subprocess.run(
+            [sys.executable, str(script), "run", str(spill), str(offsets),
+             str(out)],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert resumed.returncode == 0, resumed.stderr
+        report = json.loads(out.read_text())
+        assert report["skipped"] == 2, report
+        assert report["processed"] == report["n_chunks"] - 2
+        assert report["bitwise"] is True
+
 
 class TestHostResidencyGate:
     def test_static_tm607_over_and_under_budget(self):

@@ -508,6 +508,20 @@ class TestBenchSmoke:
         for fam, rec in at["families"].items():
             assert rec["verified"] is True, (fam, rec)
             assert rec["candidates"] >= 1, (fam, rec)
+        # training resilience (PR 20): journaling must be ~free (attributed
+        # durable-write time under 3% of the fit), an injected mid-sweep
+        # failure must leave a journal block behind, and the resumed fit
+        # must replay it (journal hit) at ZERO additional backend compiles
+        assert secs["trainres"]["status"] == "ok", secs["trainres"]
+        tr = parsed["trainres"]
+        assert tr["gate_overhead_lt_3pct"] is True, tr
+        assert tr["gate_zero_resume_compiles"] is True, tr
+        assert tr["gate_journal_hit_on_resume"] is True, tr
+        assert tr["failed_as_expected"] is True, tr
+        assert tr["journal_blocks_after_kill"] >= 1, tr
+        assert tr["resume_extra_backend_compiles"] == 0, tr
+        assert tr["resume_journal_hits"] >= 1, tr
+        assert tr["recovery_seconds"] > 0, tr
         # reduced-precision scoring classes (ISSUE 19): the serve section's
         # bf16 twin scores the same records within the TM511 class bound
         # and forks the fingerprint (no executable/artifact aliasing)

@@ -1,0 +1,598 @@
+"""Training resilience (PR 20): durable sweep journal, bounded retries,
+graceful-degradation ladders, SIGKILL resume.
+
+The acceptance story: a training run killed mid-sweep and re-invoked with
+the same resume dir skips every committed fold-block (journal hit counters
+prove it), produces a bitwise-identical final model (winner, weights, CV
+metrics), and performs zero extra backend compiles on the warm resume.  A
+persistent device fault under a mesh completes on the dp-halved mesh; an
+injected OOM completes at the next-smaller row bucket; a non-retryable
+error fails fast with the journal intact.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, Workflow
+from transmogrifai_tpu.data.dataset import Column, Dataset
+from transmogrifai_tpu.evaluators.base import BinaryClassificationEvaluator
+from transmogrifai_tpu.models.logistic import LogisticRegression
+from transmogrifai_tpu.models.selector import (
+    BinaryClassificationModelSelector,
+    ModelSelector,
+)
+from transmogrifai_tpu.models.tuning import CrossValidator
+from transmogrifai_tpu.obs import flight as obs_flight
+from transmogrifai_tpu.perf import measure_compiles
+from transmogrifai_tpu.serve.faults import FaultHarness, TransientScoringError
+from transmogrifai_tpu.types import OPVector, RealNN
+from transmogrifai_tpu.workflow import resilience
+from transmogrifai_tpu.workflow.resilience import (
+    RetryableTrainingError,
+    RetryPolicy,
+    SweepJournal,
+    resilient_training,
+    retry_call,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: no-sleep policy: every retry/backoff unit here asserts on the retry
+#: LOGIC, not the wall clock
+FAST = dict(policy=RetryPolicy(sleep=lambda s: None))
+
+
+def _binary_ds(n=400, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(x @ rng.normal(size=d))))) \
+        .astype(np.float64)
+    label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+    vec = FeatureBuilder.of("v", OPVector).extract_field().as_predictor()
+    ds = Dataset({"label": Column.from_values(RealNN, y.tolist()),
+                  "v": Column.vector(x)})
+    return ds, label, vec
+
+
+def _two_family_selector():
+    return BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2,
+        models=[(LogisticRegression(),
+                 [{"reg_param": 0.001}, {"reg_param": 0.01}]),
+                (LogisticRegression(), [{"reg_param": 0.1}])])
+
+
+def _fit_selector(selector, ds, label, vec):
+    label.transform_with(selector, vec)
+    return selector.fit(ds)
+
+
+# ---------------------------------------------------------------------------
+# SweepJournal durability
+# ---------------------------------------------------------------------------
+
+class TestSweepJournal:
+    def test_roundtrip_is_bitwise_with_dtype(self, tmp_path):
+        j = SweepJournal(str(tmp_path / "j.json"))
+        key = resilience.sweep_block_key(
+            "Fam", [{"a": 1}], (3, 42, True), "auPR", "digest", ("mesh",))
+        assert j.load(key) is None and j.misses == 1
+        for dtype in (np.float32, np.float64):
+            scores = np.array([[0.1, 1 / 3], [np.nan, -0.0]], dtype=dtype)
+            j.commit(key, scores, family="Fam")
+            back = j.load(key)
+            assert back.dtype == dtype
+            np.testing.assert_array_equal(back, scores)  # NaN/−0.0 exact
+        assert j.hits == 2 and j.commits == 2
+
+    def test_zero_byte_garbage_and_non_dict_read_as_empty(self, tmp_path):
+        path = tmp_path / "j.json"
+        j = SweepJournal(str(path))
+        for content in ("", "{truncated", "[1, 2, 3]", "null"):
+            path.write_text(content)
+            assert j.load("anything") is None
+            assert j.keys() == []
+        # and a commit over the garbage heals the store
+        j.commit("k", np.ones((1, 1)))
+        assert j.load("k") is not None
+
+    def test_stale_tmp_is_dropped_not_adopted(self, tmp_path):
+        path = tmp_path / "j.json"
+        j = SweepJournal(str(path))
+        j.commit("k", np.ones((1, 1)))
+        (tmp_path / "j.json.tmp").write_text('{"k2": "torn"}')
+        assert j.load("k2") is None           # the torn commit never landed
+        assert not (tmp_path / "j.json.tmp").exists()
+        assert j.load("k") is not None        # the real store is untouched
+
+    def test_key_covers_full_block_identity(self):
+        base = dict(family="F", grids=[{"a": 1}], fold_spec=(3, 42, True),
+                    metric="auPR", digest="d", mesh_token=None, block="all")
+
+        def key(**over):
+            kw = {**base, **over}
+            return resilience.sweep_block_key(
+                kw["family"], kw["grids"], kw["fold_spec"], kw["metric"],
+                kw["digest"], kw["mesh_token"], block=kw["block"])
+
+        ref = key()
+        assert key() == ref  # deterministic
+        for over in (dict(family="G"), dict(grids=[{"a": 2}]),
+                     dict(fold_spec=(5, 42, True)), dict(metric="logLoss"),
+                     dict(digest="other"), dict(mesh_token=("m", 4)),
+                     dict(block="fold0")):
+            assert key(**over) != ref, over
+
+    def test_data_digest_distinguishes_dtype_shape_content(self):
+        a = np.arange(6, dtype=np.float32)
+        assert resilience.data_digest(a) == resilience.data_digest(a.copy())
+        assert resilience.data_digest(a) != resilience.data_digest(
+            a.astype(np.float64))
+        assert resilience.data_digest(a) != resilience.data_digest(
+            a.reshape(2, 3))
+        assert resilience.data_digest(a, None) != resilience.data_digest(a)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: zero-byte / torn state is "no checkpoint", not a decode error
+# ---------------------------------------------------------------------------
+
+class TestCheckpointHardening:
+    def test_offset_checkpoint_zero_byte_is_no_checkpoint(self, tmp_path):
+        from transmogrifai_tpu.readers import OffsetCheckpoint
+
+        path = tmp_path / "offsets.json"
+        ckpt = OffsetCheckpoint(str(path))
+        for content in ("", "{torn", "[]", '"str"'):
+            path.write_text(content)
+            assert ckpt.load("src") == 0
+            assert ckpt.load("src", default=7) == 7
+            assert ckpt.load_meta("src") is None
+        # commit over the corrupt state starts fresh instead of raising
+        path.write_text("[1,2]")
+        ckpt.commit("src", 3)
+        assert ckpt.load("src") == 3
+
+    def test_empty_current_pointer_is_no_promoted_checkpoint(self, tmp_path):
+        from transmogrifai_tpu.workflow.continual import RefitController
+
+        d = tmp_path / "ckpt"
+        d.mkdir()
+        for content in ("", "   \n"):
+            (d / "CURRENT").write_text(content)
+            assert RefitController.load_checkpoint(str(d)) is None
+
+
+# ---------------------------------------------------------------------------
+# retry_call: bounded backoff, typed classification, fail-fast
+# ---------------------------------------------------------------------------
+
+class TestRetryCall:
+    def test_passthrough_without_active_context(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) == 1:
+                raise RetryableTrainingError("transient")
+            return "ok"
+
+        # inactive: even a retryable error propagates (old behavior)
+        with pytest.raises(RetryableTrainingError):
+            retry_call(fn, "stage_fit")
+
+    def test_retries_then_succeeds_with_backoff_and_diagnostics(self):
+        delays = []
+        policy = RetryPolicy(backoff_base_s=0.05, backoff_cap_s=2.0,
+                             jitter=0.0, sleep=delays.append)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RetryableTrainingError("transient")
+            return "ok"
+
+        with resilient_training(policy=policy) as res:
+            assert retry_call(fn, "ingest_chunk", chunk=4) == "ok"
+        assert len(calls) == 3 and res.retries == 2
+        assert delays == [0.05, 0.1]  # min(cap, base * 2**(attempt-1))
+        assert [d.code for d in res.diagnostics] == ["TM820", "TM820"]
+
+    def test_exhaustion_raises_the_last_error(self):
+        with resilient_training(**FAST) as res:
+            with pytest.raises(RetryableTrainingError, match="always"):
+                retry_call(lambda: (_ for _ in ()).throw(
+                    RetryableTrainingError("always")), "prefetch")
+        assert res.retries == res.policy.max_retries
+
+    def test_non_retryable_fails_fast_with_tm823(self):
+        with resilient_training(**FAST) as res:
+            with pytest.raises(ValueError, match="corrupt"):
+                retry_call(lambda: (_ for _ in ()).throw(
+                    ValueError("corrupt")), "stage_fit")
+        assert res.retries == 0
+        assert [d.code for d in res.diagnostics] == ["TM823"]
+
+    def test_fail_fast_reported_once_across_nested_wrappers(self):
+        """The same non-retryable exception propagates through every
+        enclosing retry_call (device_sync -> stage_fit in a real train);
+        TM823 must fire once, at the innermost point."""
+        with resilient_training(**FAST) as res:
+            def inner():
+                raise ValueError("corrupt")
+
+            with pytest.raises(ValueError, match="corrupt"):
+                retry_call(lambda: retry_call(inner, "device_sync"),
+                           "stage_fit")
+        assert [d.code for d in res.diagnostics] == ["TM823"]
+        assert "device_sync" in res.diagnostics[0].message
+
+    def test_context_stack_is_nested_lifo_and_last_survives(self):
+        assert resilience.active() is None
+        with resilient_training() as outer:
+            assert resilience.active() is outer
+            with resilient_training() as inner:
+                assert resilience.active() is inner
+            assert resilience.active() is outer
+            assert resilience.last() is inner
+        assert resilience.active() is None
+        assert resilience.last() is outer
+
+
+# ---------------------------------------------------------------------------
+# Graceful degradation ladders
+# ---------------------------------------------------------------------------
+
+class TestDegradationLadders:
+    def test_persistent_mesh_fault_completes_on_shrunk_mesh(self):
+        """ISSUE acceptance: a transient device failure that persists on the
+        dp=4 mesh exhausts in-place retries, degrades to the dp=2 twin
+        (predicate no longer matches), and the sweep completes with finite
+        metrics, a TM821 diagnostic, and a degrade_mesh_shrink event."""
+        from transmogrifai_tpu.parallel.mesh import make_mesh, use_mesh
+
+        ds, label, vec = _binary_ds(n=512, seed=3)
+        selector = ModelSelector(
+            models=[(LogisticRegression(),
+                     [{"reg_param": 0.001}, {"reg_param": 0.01}])],
+            validator=CrossValidator(BinaryClassificationEvaluator(),
+                                     num_folds=2))
+        harness = FaultHarness().fail_when(
+            "sweep_dispatch", lambda ctx: ctx.get("dp") == 4,
+            lambda: TransientScoringError("unavailable: injected device "
+                                          "fault"))
+        rec = obs_flight.install_recorder(obs_flight.FlightRecorder())
+        try:
+            with use_mesh(make_mesh(4, 2)), harness, \
+                    resilient_training(**FAST) as res:
+                model = _fit_selector(selector, ds, label, vec)
+        finally:
+            obs_flight.uninstall_recorder()
+        assert res.degradations == [{
+            "kind": "mesh_shrink", "family": "LogisticRegression",
+            "dp_from": 4, "dp_to": 2}]
+        assert "TM821" in [d.code for d in res.diagnostics]
+        events = rec.events("degrade_mesh_shrink")
+        assert len(events) == 1
+        assert events[0]["data"]["dp_from"] == 4
+        assert events[0]["data"]["dp_to"] == 2
+        vals = [v for ev in model.summary.validation_results
+                for v in ev.metric_values]
+        assert vals and np.isfinite(vals).all()
+
+    def test_repeated_oom_completes_at_next_smaller_bucket(self):
+        """ISSUE acceptance: resource exhaustion at 1000 rows skips straight
+        to the 512-row bucket (retrying the same shape cannot help), the
+        predicate stops matching, and the sweep completes with TM822 + a
+        degrade_bucket_shrink event."""
+        ds, label, vec = _binary_ds(n=1000, seed=4)
+        selector = ModelSelector(
+            models=[(LogisticRegression(), [{"reg_param": 0.01}])],
+            validator=CrossValidator(BinaryClassificationEvaluator(),
+                                     num_folds=2))
+        harness = FaultHarness().fail_when(
+            "sweep_dispatch", lambda ctx: ctx.get("rows", 0) > 512,
+            lambda: TransientScoringError("RESOURCE_EXHAUSTED: out of "
+                                          "memory"))
+        rec = obs_flight.install_recorder(obs_flight.FlightRecorder())
+        try:
+            with harness, resilient_training(**FAST) as res:
+                model = _fit_selector(selector, ds, label, vec)
+        finally:
+            obs_flight.uninstall_recorder()
+        assert res.degradations == [{
+            "kind": "bucket_shrink", "family": "LogisticRegression",
+            "rows_from": 1000, "row_cap": 512}]
+        assert "TM822" in [d.code for d in res.diagnostics]
+        assert len(rec.events("degrade_bucket_shrink")) == 1
+        vals = [v for ev in model.summary.validation_results
+                for v in ev.metric_values]
+        assert vals and np.isfinite(vals).all()
+
+    def test_degraded_scores_never_commit_under_full_fidelity_key(
+            self, tmp_path):
+        """A block that completed on capped rows must NOT journal — a
+        resumed healthy run has to re-run it at full fidelity."""
+        ds, label, vec = _binary_ds(n=1000, seed=4)
+        selector = ModelSelector(
+            models=[(LogisticRegression(), [{"reg_param": 0.01}])],
+            validator=CrossValidator(BinaryClassificationEvaluator(),
+                                     num_folds=2))
+        journal = SweepJournal(str(tmp_path / "j.json"))
+        harness = FaultHarness().fail_when(
+            "sweep_dispatch", lambda ctx: ctx.get("rows", 0) > 512,
+            lambda: TransientScoringError("resource exhausted"))
+        with harness, resilient_training(journal=journal, **FAST) as res:
+            _fit_selector(selector, ds, label, vec)
+        assert res.degradations  # the ladder did fire
+        assert journal.keys() == []
+
+    def test_non_retryable_fails_fast_with_journal_intact(self, tmp_path):
+        """ISSUE acceptance: family 1 gathers and commits; family 2's device
+        sync raises a NON-retryable error — the fit raises immediately
+        (TM823), no ladder, and the journal keeps the completed block."""
+        ds, label, vec = _binary_ds(n=300, seed=5)
+        selector = _two_family_selector()
+        journal = SweepJournal(str(tmp_path / "j.json"))
+        harness = FaultHarness().script(
+            "device_sync", [None, ValueError("corrupt gather")])
+        with harness, resilient_training(journal=journal, **FAST) as res:
+            with pytest.raises(ValueError, match="corrupt gather"):
+                _fit_selector(selector, ds, label, vec)
+        assert [d.code for d in res.diagnostics] == ["TM823"]
+        assert res.degradations == []
+        assert len(journal.keys()) == 1  # family 1's block survived the fail
+
+
+# ---------------------------------------------------------------------------
+# Durable sweep resume: bitwise-identical, zero warm compiles
+# ---------------------------------------------------------------------------
+
+class TestSweepResume:
+    def test_killed_sweep_resumes_bitwise_at_zero_compiles(self, tmp_path):
+        """The in-process acceptance core: run 1 dies after family 1's block
+        committed; run 2 with the same resume dir replays it (journal hit),
+        dispatches only the rest, performs ZERO backend compiles, and the
+        final model scores bitwise-identically to an uninterrupted run."""
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(300, 4)).astype(np.float32)
+        y = (rng.random(300) < 0.5).astype(np.float64)
+
+        def build():
+            sel = _two_family_selector()
+            label = FeatureBuilder.of("label", RealNN).extract_field() \
+                .as_response()
+            vec = FeatureBuilder.of("v", OPVector).extract_field() \
+                .as_predictor()
+            pred = label.transform_with(sel, vec)
+            ds = Dataset({"label": Column.from_values(RealNN, y.tolist()),
+                          "v": Column.vector(x)})
+            wf = Workflow().set_result_features(label, pred) \
+                .set_input_dataset(ds)
+            return wf, ds, pred
+
+        wf_ref, ds_ref, pred_ref = build()
+        model_ref = wf_ref.train()
+        ref = np.asarray(model_ref.score(ds_ref)[pred_ref.name].prob)
+
+        resume = str(tmp_path / "ckpt")
+        harness = FaultHarness().script(
+            "device_sync", [None, RuntimeError("injected mid-sweep kill")])
+        wf1, _, _ = build()
+        with harness:
+            with pytest.raises(RuntimeError, match="mid-sweep kill"):
+                wf1.train(resume=resume)
+        journal_after_kill = SweepJournal(
+            os.path.join(resume, "sweep_journal.json"))
+        assert len(journal_after_kill.keys()) == 1
+
+        rec = obs_flight.install_recorder(obs_flight.FlightRecorder())
+        try:
+            wf2, ds2, pred2 = build()
+            with measure_compiles() as mc:
+                model = wf2.train(resume=resume)
+        finally:
+            obs_flight.uninstall_recorder()
+        res = resilience.last()
+        assert res.journal.hits >= 1           # the committed block replayed
+        assert mc.backend_compiles == 0        # warm resume compiles nothing
+        assert len(rec.events("sweep_block_resume")) >= 1
+        out = np.asarray(model.score(ds2)[pred2.name].prob)
+        np.testing.assert_array_equal(out, ref)  # bitwise, not approx
+        s_ref, s_resumed = model_ref.summary(), model.summary()
+        assert s_resumed.best_model_name == s_ref.best_model_name
+        assert [e.metric_values for e in s_resumed.validation_results] == \
+            [e.metric_values for e in s_ref.validation_results]
+
+    def test_identical_rerun_replays_every_block(self, tmp_path):
+        """Same data + same grids + same resume dir: the second run's sweep
+        is 100% journal hits and zero commits beyond the first run's."""
+        ds, _, _ = _binary_ds(n=300, seed=6)
+        resume = str(tmp_path / "ckpt")
+        journal_path = os.path.join(resume, "sweep_journal.json")
+        os.makedirs(resume)
+
+        def sweep_once():
+            _, label, vec = _binary_ds(n=300, seed=6)
+            sel = _two_family_selector()
+            with resilient_training(journal=SweepJournal(journal_path)):
+                _fit_selector(sel, ds, label, vec)
+            return resilience.last().journal
+
+        j1 = sweep_once()
+        assert j1.commits == 2 and j1.hits == 0
+        j2 = sweep_once()
+        assert j2.hits == 2 and j2.commits == 0
+
+    def test_workflow_cv_blocks_journal_per_fold(self, tmp_path):
+        """The workflow-level CV path journals per (fold, family): k folds x
+        one family = k block commits, all replayed on a re-run."""
+        ds, _, _ = _binary_ds(n=240, seed=7)
+        journal_path = str(tmp_path / "j.json")
+
+        def run_cv():
+            _, label, vec = _binary_ds(n=240, seed=7)
+            sel = BinaryClassificationModelSelector.with_cross_validation(
+                num_folds=3,
+                models=[(LogisticRegression(), [{"reg_param": 0.01}])])
+            pred = label.transform_with(sel, vec)
+            wf = Workflow().with_workflow_cv() \
+                .set_result_features(label, pred).set_input_dataset(ds)
+            with resilient_training(journal=SweepJournal(journal_path)):
+                wf.train()
+            return resilience.last().journal
+
+        j1 = run_cv()
+        assert j1.commits == 3 and j1.hits == 0, (j1.hits, j1.commits)
+        j2 = run_cv()
+        assert j2.hits == 3 and j2.commits == 0, (j2.hits, j2.commits)
+
+
+# ---------------------------------------------------------------------------
+# Subprocess SIGKILL end-to-end (the real thing: no atexit, no finally)
+# ---------------------------------------------------------------------------
+
+_SIGKILL_SCRIPT = textwrap.dedent("""\
+    import json, os, signal, sys
+
+    import numpy as np
+
+    mode, out_dir, resume = sys.argv[1], sys.argv[2], sys.argv[3]
+
+    from transmogrifai_tpu import FeatureBuilder, Workflow
+    from transmogrifai_tpu.data.dataset import Column, Dataset
+    from transmogrifai_tpu.models.logistic import LogisticRegression
+    from transmogrifai_tpu.models.selector import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.types import OPVector, RealNN
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(240, 4)).astype(np.float32)
+    y = (rng.random(240) < 0.5).astype(np.float64)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2,
+        models=[(LogisticRegression(),
+                 [{"reg_param": 0.001}, {"reg_param": 0.01}]),
+                (LogisticRegression(), [{"reg_param": 0.1}])])
+    label = FeatureBuilder.of("label", RealNN).extract_field().as_response()
+    vec = FeatureBuilder.of("v", OPVector).extract_field().as_predictor()
+    pred = label.transform_with(sel, vec)
+    ds = Dataset({"label": Column.from_values(RealNN, y.tolist()),
+                  "v": Column.vector(x)})
+    wf = Workflow().set_result_features(label, pred).set_input_dataset(ds)
+
+    if mode == "kill":
+        from transmogrifai_tpu.serve.faults import FaultHarness
+
+        h = FaultHarness()
+        # family 1 gathers + commits, then SIGKILL mid family 2: no atexit,
+        # no finally, the journal's fsync'd commit is all that survives
+        h.script("device_sync",
+                 [None, lambda ctx: os.kill(os.getpid(), signal.SIGKILL)])
+        with h:
+            wf.train(resume=resume)
+        raise SystemExit("unreachable: the harness should have killed us")
+
+    model = wf.train(resume=resume) if resume else wf.train()
+    probs = np.asarray(model.score(ds)[pred.name].prob)
+    np.save(os.path.join(out_dir, "probs.npy"), probs)
+    s = model.summary()
+    hits = 0
+    if resume:
+        from transmogrifai_tpu.workflow import resilience
+
+        res = resilience.last()
+        hits = res.journal.hits if res and res.journal else 0
+    with open(os.path.join(out_dir, "summary.json"), "w") as fh:
+        json.dump({
+            "winner": s.best_model_name,
+            "metrics": [[e.model_name, sorted(e.grid.items()),
+                         e.metric_values]
+                        for e in s.validation_results],
+            "journal_hits": hits,
+        }, fh, sort_keys=True)
+""")
+
+
+def _run_sub(script_path, *args, check_rc=None):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO_ROOT + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    proc = subprocess.run(
+        [sys.executable, str(script_path), *map(str, args)],
+        capture_output=True, text=True, env=env, timeout=300)
+    if check_rc is not None:
+        assert proc.returncode == check_rc, (proc.returncode, proc.stderr)
+    return proc
+
+
+class TestSigkillResume:
+    def test_sigkill_mid_sweep_resume_is_bitwise_with_journal_hits(
+            self, tmp_path):
+        """Full acceptance e2e: a REAL SIGKILL lands mid-sweep after one
+        fold-block committed; a fresh process with the same resume dir
+        replays the block and the final model is bitwise-identical to an
+        uninterrupted run's (winner, CV metrics, scored probabilities)."""
+        script = tmp_path / "sweep_e2e.py"
+        script.write_text(_SIGKILL_SCRIPT)
+        resume = tmp_path / "ckpt"
+        ref_out = tmp_path / "ref"
+        res_out = tmp_path / "resumed"
+        ref_out.mkdir(), res_out.mkdir()
+
+        killed = _run_sub(script, "kill", res_out, resume)
+        assert killed.returncode == -signal.SIGKILL, killed.stderr
+        journal = SweepJournal(str(resume / "sweep_journal.json"))
+        assert len(journal.keys()) == 1  # the fsync'd commit survived SIGKILL
+
+        _run_sub(script, "run", res_out, resume, check_rc=0)
+        _run_sub(script, "run", ref_out, "", check_rc=0)
+
+        resumed = json.loads((res_out / "summary.json").read_text())
+        ref = json.loads((ref_out / "summary.json").read_text())
+        assert resumed["journal_hits"] >= 1
+        assert resumed["winner"] == ref["winner"]
+        assert resumed["metrics"] == ref["metrics"]  # CV metrics, bitwise
+        np.testing.assert_array_equal(
+            np.load(res_out / "probs.npy"), np.load(ref_out / "probs.npy"))
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m transmogrifai_tpu.cli train --resume
+# ---------------------------------------------------------------------------
+
+class TestCliTrain:
+    def test_cli_train_resume_reports_journal_counters(self, tmp_path):
+        import pandas as pd
+
+        rng = np.random.default_rng(0)
+        n = 200
+        x = rng.normal(0, 1, n)
+        y = (rng.random(n) < 1 / (1 + np.exp(-2 * x))).astype(float)
+        csv = tmp_path / "data.csv"
+        pd.DataFrame({"label": y, "x": x}).to_csv(csv, index=False)
+
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", "")}
+        proc = subprocess.run(
+            [sys.executable, "-m", "transmogrifai_tpu.cli", "train",
+             "--input", str(csv), "--response", "label",
+             "--model-location", str(tmp_path / "model"),
+             "--resume", str(tmp_path / "ckpt"), "--format", "json"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert proc.returncode == 0, proc.stderr
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert payload["kind"] == "binary"
+        assert payload["journal"]["commits"] >= 1
+        assert payload["journal"]["entries"] >= 1
+        assert os.path.isdir(tmp_path / "model")
+        assert os.path.exists(tmp_path / "ckpt" / "sweep_journal.json")

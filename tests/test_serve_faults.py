@@ -120,6 +120,33 @@ class TestFaultHarness:
 
         fault_point("device")  # no harness: must not raise
 
+    def test_max_fires_caps_total_injected_failures(self):
+        """PR 20 regression: ``max_fires`` bounds TOTAL injections per point
+        — once hit, remaining schedule entries AND matching predicates pass,
+        so "fail persistently, then let the degraded retry succeed"
+        scenarios script in one line."""
+        from transmogrifai_tpu.serve.faults import fault_point
+
+        h = FaultHarness().script(
+            "device", [TransientScoringError("a"), TransientScoringError("b"),
+                       TransientScoringError("c")], max_fires=2)
+        with h:
+            with pytest.raises(TransientScoringError):
+                fault_point("device")
+            with pytest.raises(TransientScoringError):
+                fault_point("device")
+            fault_point("device")  # schedule entry 2 exists, but cap passes it
+        assert len(h.fired) == 2
+        assert h.calls["device"] == 3
+
+        h2 = FaultHarness().fail_when(
+            "encode", lambda ctx: True, lambda: ValueError("x"), max_fires=1)
+        with h2:
+            with pytest.raises(ValueError):
+                fault_point("encode")
+            fault_point("encode")  # predicate still matches; cap passes it
+        assert len(h2.fired) == 1
+
     def test_is_retryable_classification(self):
         assert is_retryable(TransientScoringError("x"))
         assert not is_retryable(ValueError("bad payload"))

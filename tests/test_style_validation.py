@@ -188,6 +188,7 @@ class TestStyleValidation:
             paths += sorted(os.path.join(d, f) for f in os.listdir(d)
                             if f.endswith(".py"))
         paths += [os.path.join(PKG_ROOT, "workflow", "continual.py"),
+                  os.path.join(PKG_ROOT, "workflow", "resilience.py"),
                   os.path.join(PKG_ROOT, "readers", "prefetch.py"),
                   os.path.join(PKG_ROOT, "data", "chunked.py")]
         analysis = analyze_files(paths)

@@ -65,6 +65,7 @@ THREADED_SURFACE = (
     "transmogrifai_tpu/checkers",
     "transmogrifai_tpu/deploy",
     "transmogrifai_tpu/workflow/continual.py",
+    "transmogrifai_tpu/workflow/resilience.py",
     "transmogrifai_tpu/readers/prefetch.py",
     "transmogrifai_tpu/data/chunked.py",
 )

@@ -28,7 +28,10 @@ Code families:
 - ``TM8xx`` continual    — the streaming retrain control plane
   (workflow/continual.py): covariate drift against the train-time
   snapshot (PSI / mean shift / missing rate), refit failures, shadow
-  promotion-gate refusals, swap commits, and post-swap rollbacks
+  promotion-gate refusals, swap commits, and post-swap rollbacks; the
+  ``TM82x`` sub-range is training resilience (workflow/resilience.py):
+  bounded retries, mesh-shrink / row-bucket degradation ladders, and
+  fail-fast on non-retryable errors with the sweep journal intact
 - ``TM9xx`` telemetry    — runtime observability findings (obs/): an
   unexpected backend recompile observed by the flight recorder inside a
   path declared warm (the dynamic counterpart of the TM602 static
@@ -347,6 +350,33 @@ DIAGNOSTIC_CODES: Dict[str, Tuple[Severity, str, str]] = {
               "new backend compiles were observed; check that the prep "
               "stages are really frozen and the refit window pads to an "
               "already-compiled bucket"),
+    # -- training resilience (workflow/resilience.py) -----------------------
+    "TM820": (Severity.INFO, "retryable training fault; retrying",
+              "a transient training-path failure (chunk read, prefetch, "
+              "stage fit, sweep dispatch, device sync) was retried with "
+              "bounded exponential backoff + jitter; informational unless "
+              "it recurs — persistent retries escalate to a degradation "
+              "ladder (TM821/TM822) or exhaust into the original error"),
+    "TM821": (Severity.WARNING, "training degraded to a shrunk device mesh",
+              "a device fault persisted through every in-place retry under "
+              "a mesh, so the sweep re-dispatched with the data axis halved "
+              "(mesh_token re-keys every executable cache — no aliasing "
+              "with the full mesh's programs); the run completes at reduced "
+              "parallelism — investigate the failing devices before the "
+              "next full-mesh run"),
+    "TM822": (Severity.WARNING, "sweep degraded to a smaller row bucket",
+              "repeated resource exhaustion (OOM) made the dispatched row "
+              "bucket infeasible, so the sweep retried on the next-smaller "
+              "power-of-two row cap; CV metrics for the degraded block are "
+              "computed on the capped rows — lower hbm pressure (smaller "
+              "chunk/bucket, fewer grids per dispatch) to avoid the cap"),
+    "TM823": (Severity.ERROR, "training failed fast on a non-retryable "
+              "error",
+              "a non-retryable error (bad input, poison payload, programming "
+              "error) surfaced inside a resilient training run; it was NOT "
+              "retried — the sweep journal keeps every completed "
+              "(family, fold-block) so a fixed re-run resumes past them "
+              "(train(resume=...) / cli train --resume)"),
     # -- telemetry (flight recorder, obs/flight.py) -------------------------
     "TM901": (Severity.WARNING, "unexpected backend recompile in warm path",
               "a backend compilation fired inside a path declared warm (a "

@@ -89,7 +89,18 @@ def place_grid(arr):
 
 def gather_scores(pending) -> np.ndarray:
     """Host-fetch a pending sweep result: a (g, k) device array or a list of
-    per-grid (k,) device arrays (one async fetch either way)."""
+    per-grid (k,) device arrays (one async fetch either way).
+
+    The ``device_sync`` fault point fires before the blocking fetch — this
+    is where transient device errors from the in-flight sweep surface on
+    the host, so an injected fault here models exactly that (the resilient
+    sweep wrapper in models/tuning.py re-dispatches through its retry
+    ladder)."""
+    from ..serve.faults import fault_point
+
+    fault_point("device_sync",
+                programs=len(pending)
+                if isinstance(pending, (list, tuple)) else 1)
     if isinstance(pending, (list, tuple)):
         return np.stack(jax.device_get(list(pending)))
     return np.asarray(jax.device_get(pending))

@@ -37,6 +37,13 @@ the newest ``capacity`` events of:
   ``dump_dir``, each injected fault auto-dumps the ring buffer (bounded
   count), so the harness run leaves a postmortem artifact without test
   plumbing.
+- ``train_retry`` / ``degrade_mesh_shrink`` / ``degrade_bucket_shrink`` /
+  ``sweep_block_resume`` / ``chunk_resume`` — the training resilience
+  trail (workflow/resilience.py, workflow/ooc.py): every backoff retry
+  with its fault point and delay, every graceful degradation (dp-halved
+  mesh, next-smaller row bucket) with before/after shape, and every
+  resumed sweep block / chunked-epoch prefix a durable journal let a
+  re-run skip (docs/robustness.md).
 
 Like the fault harness, the recorder is process-global while installed (the
 batcher flusher is another thread, so a contextvar would not reach it);

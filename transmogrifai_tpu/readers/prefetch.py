@@ -162,5 +162,16 @@ def prefetch_chunks(chunked, names=None, start: int = 0,
     producer (e.g. to fold host entry-encoding into the background stage)."""
     if loader is None:
         def loader(ci, _c=chunked, _names=names):
-            return _c.chunk(ci, names=_names)
+            # lazy imports: the loader runs on the prefetch worker thread and
+            # the resilience stack is process-global precisely so this wrapper
+            # can reach it from here; a transient read fault retries with
+            # backoff instead of killing the whole epoch
+            from ..serve.faults import fault_point
+            from ..workflow.resilience import retry_call
+
+            def _read():
+                fault_point("prefetch", chunk=ci)
+                return _c.chunk(ci, names=_names)
+
+            return retry_call(_read, "prefetch", chunk=ci)
     return ChunkPrefetcher(loader, chunked.n_chunks, start=start, stats=stats)

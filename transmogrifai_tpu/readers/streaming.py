@@ -203,6 +203,17 @@ class OffsetCheckpoint:
     def __init__(self, path: str):
         self.path = path
 
+    def _read_state(self) -> dict:
+        """The committed store, or {} — a zero-byte file (crash between
+        create and first commit), torn/non-JSON bytes, or valid JSON that is
+        not a dict all read as "no checkpoint", never an exception."""
+        try:
+            with open(self.path) as fh:
+                state = json.load(fh)
+        except (OSError, ValueError):
+            return {}
+        return state if isinstance(state, dict) else {}
+
     def load(self, source_id: str, default: int = 0) -> int:
         # a stale .tmp is a commit that crashed BEFORE its atomic rename —
         # its content never became the committed state; drop it so it can
@@ -212,29 +223,19 @@ class OffsetCheckpoint:
         except OSError:
             pass
         try:
-            with open(self.path) as fh:
-                return int(json.load(fh).get(source_id, default))
-        except (OSError, ValueError):
+            return int(self._read_state().get(source_id, default))
+        except (TypeError, ValueError):
             return default
 
     def load_meta(self, source_id: str) -> Optional[dict]:
         """Source-specific state committed beside the offset (e.g. the tail
         source's rotation pins); None when absent or unreadable."""
-        try:
-            with open(self.path) as fh:
-                meta = json.load(fh).get(source_id + "#meta")
-                return dict(meta) if isinstance(meta, dict) else None
-        except (OSError, ValueError):
-            return None
+        meta = self._read_state().get(source_id + "#meta")
+        return dict(meta) if isinstance(meta, dict) else None
 
     def commit(self, source_id: str, offset: int,
                meta: Optional[dict] = None) -> None:
-        state = {}
-        try:
-            with open(self.path) as fh:
-                state = json.load(fh)
-        except (OSError, ValueError):
-            pass
+        state = self._read_state()
         state[source_id] = int(offset)
         if meta is not None:
             state[source_id + "#meta"] = meta

@@ -43,6 +43,25 @@ other tenant):
 - ``shed``     — the batcher's deadline-then-tier backpressure reclaim
   (fired before any queued entry is evicted).
 
+Training-path fault points (the fault-tolerant fit, workflow/resilience.py
+— each retried with bounded backoff when a ``resilient_training`` context
+is active, a plain raise otherwise; see docs/robustness.md):
+
+- ``ingest_chunk``     — one chunk of the chunked epoch, before its compute
+  dispatches (workflow/ooc.py);
+- ``prefetch``         — the background chunk loader, on its worker thread
+  (readers/prefetch.py);
+- ``stage_fit``        — each estimator fit in the DAG fitter
+  (workflow/fit.py fit_stage_list);
+- ``sweep_dispatch``   — launching one family's fold x grid sweep program
+  (models/tuning.py + workflow_cv_validate; carries family/dp/rows so
+  predicates can model mesh- or size-dependent device faults);
+- ``device_sync``      — the blocking host fetch of a pending sweep result
+  (models/base.py gather_scores);
+- ``checkpoint_write`` — durable training state: a stage checkpoint
+  (workflow/checkpoint.py) or a sweep-journal commit
+  (workflow/resilience.py).
+
 Usage in tests::
 
     harness = FaultHarness(seed=0)
@@ -163,6 +182,12 @@ class FaultHarness:
     - ``fail_when(point, predicate, make_error, times=None)`` — raise
       whenever ``predicate(ctx)`` matches, at most ``times`` times (None =
       unbounded).  Predicate rules run after (and independent of) scripts.
+    - ``max_fires`` (on ``script``/``fail_when``) — a per-point cap on TOTAL
+      injected failures: once ``point`` has fired that many times, every
+      further schedule entry and predicate match passes.  Retrying training
+      loops re-enter their fault points unboundedly, so an uncapped callable
+      schedule (or ``times=None`` rule) would otherwise starve the retry
+      ladder forever.
     - ``calls`` — firings per point; ``fired`` — (point, call index) log of
       every injected failure, for exact-schedule assertions.
 
@@ -176,21 +201,29 @@ class FaultHarness:
         self.fired: List[tuple] = []
         self._scripts: Dict[str, List[Any]] = {}
         self._rules: List[tuple] = []  # (point, predicate, make_error, left)
+        self._max_fires: Dict[str, int] = {}
+        self._fire_counts: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     # -- schedule construction ----------------------------------------------
-    def script(self, point: str, schedule) -> "FaultHarness":
+    def script(self, point: str, schedule,
+               max_fires: Optional[int] = None) -> "FaultHarness":
         # _check fires from serving threads (batcher flusher, shadow
         # worker); schedule edits race with it unless they share its lock
         with self._lock:
             self._scripts.setdefault(point, []).extend(schedule)
+            if max_fires is not None:
+                self._max_fires[point] = int(max_fires)
         return self
 
     def fail_when(self, point: str, predicate: Callable[[dict], bool],
                   make_error: Callable[[], BaseException],
-                  times: Optional[int] = None) -> "FaultHarness":
+                  times: Optional[int] = None,
+                  max_fires: Optional[int] = None) -> "FaultHarness":
         with self._lock:
             self._rules.append([point, predicate, make_error, times])
+            if max_fires is not None:
+                self._max_fires[point] = int(max_fires)
         return self
 
     # -- firing --------------------------------------------------------------
@@ -198,6 +231,9 @@ class FaultHarness:
         with self._lock:
             idx = self.calls.get(point, 0)
             self.calls[point] = idx + 1
+            cap = self._max_fires.get(point)
+            if cap is not None and self._fire_counts.get(point, 0) >= cap:
+                return None
             entry = None
             sched = self._scripts.get(point)
             if sched and idx < len(sched):
@@ -216,6 +252,8 @@ class FaultHarness:
                         break
             if entry is not None:
                 self.fired.append((point, idx))
+                self._fire_counts[point] = \
+                    self._fire_counts.get(point, 0) + 1
             return entry
 
     # -- activation ----------------------------------------------------------

@@ -87,6 +87,9 @@ class StageCheckpointer:
 
     def save_stage(self, model: Transformer,
                    fingerprint: Optional[str] = None) -> None:
+        from ..serve.faults import fault_point
+
+        fault_point("checkpoint_write", stage=model.uid)
         enc = _Encoder()
         state = encode_stage(model, enc, full=True)
         if fingerprint is not None:

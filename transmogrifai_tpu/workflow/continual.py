@@ -637,11 +637,21 @@ class RefitController:
     def load_checkpoint(checkpoint_dir: str):
         """The model the CURRENT pointer names — the last PROMOTED model
         (gate-rejected or rolled-back candidates keep their ``model-NNNN``
-        dirs for postmortem but never become CURRENT)."""
+        dirs for postmortem but never become CURRENT).
+
+        A zero-byte or whitespace-only CURRENT (a crash between creating the
+        pointer file and writing its content — mark_current fsyncs before
+        the rename, but hostile filesystems exist) means "no promoted
+        checkpoint" and returns None instead of attempting to load the
+        checkpoint dir itself as a model."""
         from .workflow import WorkflowModel
 
         with open(os.path.join(checkpoint_dir, "CURRENT")) as fh:
             name = fh.read().strip()
+        if not name:
+            log.warning("CURRENT pointer in %s is empty; treating as no "
+                        "promoted checkpoint", checkpoint_dir)
+            return None
         return WorkflowModel.load(os.path.join(checkpoint_dir, name))
 
 

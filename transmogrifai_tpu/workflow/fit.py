@@ -150,15 +150,26 @@ def fit_stage_list(dataset: Dataset, stages, fitted: Dict[str, Transformer],
                 finish(ds)
         return ds
 
+    from ..serve.faults import fault_point
+    from .resilience import retry_call
+
     pending: list = []
     for stage in stages:
         runner = _resolve(stage, fitted)
         if runner is None:
             dataset = _flush(dataset, pending)
             pending = []
+
+            def _fit_once(_stage=stage, _ds=dataset):
+                fault_point("stage_fit", stage=_stage.uid)
+                return _stage.fit(_ds)
+
             with phase(f"fit.{_name(stage)}"), \
                     stage_timer(stage, "fit", dataset) as finish:
-                model = stage.fit(dataset)
+                # retried with bounded backoff under resilient_training
+                # (a transient device fault mid-fit is retryable; the fit
+                # is pure given its inputs); a plain call otherwise
+                model = retry_call(_fit_once, "stage_fit", stage=stage.uid)
                 finish(None)
             fitted[stage.uid] = model
             runner = model
@@ -257,22 +268,87 @@ def workflow_cv_validate(ds_before: Dataset, during, selector,
                 ds_fold_full = runners[s.uid].transform(ds_fold_full)
             fold_datasets.append(ds_fold_full)
 
-    # metric matrix per (model, grid) across folds
+    # metric matrix per (model, grid) across folds.  Each (family, fold) is
+    # one durable journal unit under resilient_training: a committed block
+    # replays its scores without dispatching, failures retry through the
+    # backoff/degradation ladders, and non-retryable errors fail fast with
+    # every completed block intact (workflow/resilience.py).
+    from ..parallel.mesh import current_mesh, mesh_token
+    from ..serve.faults import fault_point
+    from . import resilience
+
+    res = resilience.active()
+    journal = res.journal if res is not None else None
+    fold_spec = (validator.num_folds, validator.seed, validator.stratify)
+    metric_name = validator.evaluator.default_metric
     per_key: Dict[tuple, list] = {}
     for f in range(k):
         x_f = fold_datasets[f][vec_f.name].data.astype(np.float32)
+        fold_digest = resilience.data_digest(
+            x_f, y, train_w[f:f + 1], val_w[f:f + 1]) \
+            if journal is not None else None
         for est, grids in selector.models:
             grids = grids or [{}]
-            try:
-                scores = est.cv_sweep(x_f, y, train_w[f:f + 1], val_w[f:f + 1],
-                                      grids, metric_fn)
-            except Exception as e:
-                import logging
+            name = type(est).__name__
+            key = None
+            scores = None
+            if journal is not None:
+                key = resilience.sweep_block_key(
+                    name, grids, fold_spec, metric_name, fold_digest,
+                    mesh_token(), block=f"fold{f}")
+                scores = journal.load(key)
+                if scores is not None:
+                    from ..obs import flight as obs_flight
 
-                logging.getLogger(__name__).warning(
-                    "model %s failed in workflow CV fold %d (%s)",
-                    type(est).__name__, f, e)
-                scores = np.full((len(grids), 1), np.nan)
+                    obs_flight.record_event("sweep_block_resume",
+                                            family=name, fold=f, key=key)
+            if scores is None:
+                def _attempt(mesh_override, row_cap, attempt_i, _est=est,
+                             _grids=grids, _name=name, _f=f, _x=x_f):
+                    from contextlib import nullcontext
+
+                    from ..parallel.mesh import use_mesh
+
+                    cm = use_mesh(mesh_override) \
+                        if mesh_override is not None else nullcontext()
+                    with cm:
+                        xa, ya, twa, vwa = resilience.capped_views(
+                            row_cap, _x, y, train_w[_f:_f + 1],
+                            val_w[_f:_f + 1])
+                        fault_point(
+                            "sweep_dispatch", family=_name, fold=_f,
+                            rows=len(ya),
+                            dp=resilience.dp_size(
+                                mesh_override if mesh_override is not None
+                                else current_mesh()),
+                            attempt=attempt_i)
+                        return np.asarray(_est.cv_sweep(
+                            xa, ya, twa, vwa, _grids, metric_fn))
+
+                n_deg = len(res.degradations) if res is not None else 0
+                try:
+                    scores = resilience.run_sweep_block(
+                        _attempt, family=name, rows=len(y), res=res)
+                except Exception as e:
+                    if res is not None:
+                        # run_sweep_block already classified: retryables
+                        # exhausted their ladder, non-retryables fail fast
+                        # — either way the journal keeps completed blocks
+                        raise
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "model %s failed in workflow CV fold %d (%s)",
+                        name, f, e)
+                    scores = np.full((len(grids), 1), np.nan)
+                else:
+                    if res is not None \
+                            and len(res.degradations) > n_deg:
+                        # degraded (shrunk-mesh / capped-rows) scores must
+                        # not journal under the full-fidelity key
+                        key = None
+                    if journal is not None and key is not None:
+                        journal.commit(key, scores, family=name)
             for gi, grid in enumerate(grids):
                 per_key.setdefault(
                     (est.uid, type(est).__name__, gi, tuple(sorted(grid.items()))),

@@ -155,13 +155,21 @@ def chunked_transform_epoch(cds: ChunkedDataset, runners: Sequence[Any],
     """
     from ..perf.timers import phase
     from ..readers.prefetch import PrefetchStats, prefetch_chunks
+    from ..serve.faults import fault_point
     from ..utils.listener import active_listeners
+    from . import resilience
     from .plan import (check_plan_hbm_budget, fused_transforms_enabled,
                        mesh_aligned_tile, plan_for, run_host_stages)
 
     runners = list(runners)
     if not runners:
         return cds
+    if checkpoint is None:
+        # ambient durability: Workflow.train(resume=dir) parks an
+        # OffsetCheckpoint on the active resilience context so the chunked
+        # epochs it reaches (via fused_transform's out-of-core path) commit
+        # progress without threading the handle through every layer
+        checkpoint = resilience.active_chunk_checkpoint()
     stats = stats if stats is not None else EpochStats()
     plan, remainder = None, runners
     if fused is not False and fused_transforms_enabled() \
@@ -249,22 +257,35 @@ def chunked_transform_epoch(cds: ChunkedDataset, runners: Sequence[Any],
                             stats=pf_stats) as chunks:
         for ci, ds_chunk in chunks:
             n = ds_chunk.n_rows
-            if plan is not None:
-                padded = _pad_chunk(ds_chunk, tile) or ds_chunk
-                try:
-                    out = plan.apply_prefix(padded, tile=tile)
-                except Exception as e:  # noqa: BLE001 — fall back, stay correct
-                    log.warning("chunked fused dispatch failed (%s: %s); "
-                                "host path for the rest of the epoch",
-                                type(e).__name__, e)
-                    plan = None
-                    out = _run_host_chunk(ds_chunk, runners)
-                else:
-                    if padded is not ds_chunk:
-                        out = out.take(np.arange(n, dtype=np.intp))
-                    out = run_host_stages(out, remainder)
-            else:
-                out = _run_host_chunk(ds_chunk, runners)
+
+            def _process_chunk(_ci=ci, _chunk=ds_chunk, _n=n):
+                # retryable under resilient_training: a transient chunk-read
+                # or dispatch fault re-runs THIS chunk (outputs overwrite in
+                # place; the offset commits only after success below)
+                nonlocal plan
+                fault_point("ingest_chunk", chunk=_ci, epoch=epoch_id)
+                if plan is not None:
+                    padded = _pad_chunk(_chunk, tile) or _chunk
+                    try:
+                        out = plan.apply_prefix(padded, tile=tile)
+                    except Exception as e:  # noqa: BLE001 — fall back, stay correct
+                        if resilience.active() is not None \
+                                and resilience.is_retryable_training(e):
+                            # transient ≠ broken plan: retry the fused path
+                            # instead of demoting the rest of the epoch
+                            raise
+                        log.warning("chunked fused dispatch failed (%s: %s); "
+                                    "host path for the rest of the epoch",
+                                    type(e).__name__, e)
+                        plan = None
+                        return _run_host_chunk(_chunk, runners)
+                    if padded is not _chunk:
+                        out = out.take(np.arange(_n, dtype=np.intp))
+                    return run_host_stages(out, remainder)
+                return _run_host_chunk(_chunk, runners)
+
+            out = resilience.retry_call(_process_chunk, "ingest_chunk",
+                                        chunk=ci, epoch=epoch_id)
             for name in spillable:
                 writers[name].write(ci, out[name])
             for name in resident_out:

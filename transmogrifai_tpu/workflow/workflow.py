@@ -150,7 +150,7 @@ class Workflow:
               checkpointer=None, strict: bool = False,
               hbm_budget: Optional[float] = None,
               host_budget: Optional[float] = None,
-              telemetry=None) -> "WorkflowModel":
+              telemetry=None, resume: Optional[str] = None) -> "WorkflowModel":
         """Fit the DAG.  ``checkpointer`` (a StageCheckpointer) persists each
         fitted stage as it completes and resumes from disk on re-run —
         sweep-level resume for preemptible hardware (SURVEY §5.4).
@@ -181,38 +181,69 @@ class Workflow:
         span and backend compiles land in the flight recorder, dumped as
         ``trace.json`` / ``flight.json`` / a ``metrics.jsonl`` compile-stats
         line under the directory when the fit finishes.
+
+        ``resume`` (a directory path) makes the fit DURABLE: fitted stages
+        checkpoint under ``resume/stages`` (unless a ``checkpointer`` was
+        passed), every completed sweep fold-block commits to the fsync'd
+        ``resume/sweep_journal.json``, chunked-epoch progress commits to
+        ``resume/chunk_offsets.json``, and the whole fit runs inside
+        :func:`workflow.resilience.resilient_training` — retryable faults
+        retry with bounded backoff and degrade gracefully (shrunk mesh /
+        smaller row bucket), and a killed run re-invoked with the same
+        ``resume`` dir skips every completed block, producing a
+        bitwise-identical model at zero extra warm compiles
+        (docs/robustness.md).
         """
+        import os
+        from contextlib import ExitStack
+
         from ..obs import resolve_telemetry
 
-        tel = resolve_telemetry(telemetry)
-        if tel is None:
-            return self._train(test_fraction=test_fraction, seed=seed,
-                               checkpointer=checkpointer, strict=strict,
-                               hbm_budget=hbm_budget,
-                               host_budget=host_budget)
-        from ..perf import PhaseRecorder, compile_snapshot, record_phases
+        with ExitStack() as stack:
+            if resume is not None:
+                from ..readers.streaming import OffsetCheckpoint
+                from .checkpoint import StageCheckpointer
+                from .resilience import SweepJournal, resilient_training
 
-        # ownership-aware activation: a caller that already started this
-        # bundle keeps its session — we neither stop nor dump over it
-        owned = tel.activate()
-        t0 = compile_snapshot()
-        rec = PhaseRecorder()
-        try:
-            with record_phases(rec):
+                os.makedirs(resume, exist_ok=True)
+                if checkpointer is None:
+                    checkpointer = StageCheckpointer(
+                        os.path.join(resume, "stages"))
+                stack.enter_context(resilient_training(
+                    journal=SweepJournal(
+                        os.path.join(resume, "sweep_journal.json")),
+                    chunk_checkpoint=OffsetCheckpoint(
+                        os.path.join(resume, "chunk_offsets.json")),
+                    seed=seed))
+            tel = resolve_telemetry(telemetry)
+            if tel is None:
                 return self._train(test_fraction=test_fraction, seed=seed,
                                    checkpointer=checkpointer, strict=strict,
                                    hbm_budget=hbm_budget,
                                    host_budget=host_budget)
-        finally:
-            if owned:
-                # dump in the finally so a FAILED fit still leaves its
-                # trace/flight postmortem, with one export (not two)
-                tel.stop()
-                tel.dump(metrics_payload={
-                    "compile": compile_snapshot().minus(t0).to_dict(),
-                    "phases": rec.report(),
-                    "source": "Workflow.train",
-                })
+            from ..perf import PhaseRecorder, compile_snapshot, record_phases
+
+            # ownership-aware activation: a caller that already started this
+            # bundle keeps its session — we neither stop nor dump over it
+            owned = tel.activate()
+            t0 = compile_snapshot()
+            rec = PhaseRecorder()
+            try:
+                with record_phases(rec):
+                    return self._train(test_fraction=test_fraction, seed=seed,
+                                       checkpointer=checkpointer,
+                                       strict=strict, hbm_budget=hbm_budget,
+                                       host_budget=host_budget)
+            finally:
+                if owned:
+                    # dump in the finally so a FAILED fit still leaves its
+                    # trace/flight postmortem, with one export (not two)
+                    tel.stop()
+                    tel.dump(metrics_payload={
+                        "compile": compile_snapshot().minus(t0).to_dict(),
+                        "phases": rec.report(),
+                        "source": "Workflow.train",
+                    })
 
     def _train(self, test_fraction: float = 0.0, seed: int = 42,
                checkpointer=None, strict: bool = False,
